@@ -1,0 +1,100 @@
+"""DeepSpeed-TPU: a TPU-native training & inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of DeepSpeed
+(reference: xylian86/DeepSpeed).  The public entry points mirror the
+reference API (``deepspeed/__init__.py``): ``initialize`` (:78),
+``init_distributed``, ``init_inference`` (:302), ``add_config_arguments``
+(:279) — but the execution model is SPMD over a ``jax.sharding.Mesh``:
+ZeRO stages are sharding rules, collectives are XLA ops over ICI, kernels
+are Pallas.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.engine import DeepSpeedTPUEngine, TrainState  # noqa: F401
+from .runtime.module import ModelSpec  # noqa: F401
+from .parallel.mesh import MeshTopology, initialize_topology, get_topology  # noqa: F401
+from .utils.logging import logger  # noqa: F401
+
+
+def initialize(args: Any = None,
+               model: Any = None,
+               optimizer: Any = None,
+               model_parameters: Any = None,
+               training_data: Any = None,
+               lr_scheduler: Any = None,
+               distributed_port: Optional[int] = None,
+               mpu: Any = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn: Any = None,
+               config: Any = None,
+               config_params: Any = None,
+               example_batch: Any = None,
+               loss_fn: Any = None,
+               partition_rules: Any = None,
+               topology: Optional[MeshTopology] = None,
+               ) -> Tuple[DeepSpeedTPUEngine, Any, Any, Any]:
+    """Create a training engine (reference ``deepspeed.initialize``,
+    __init__.py:78).
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)`` like the
+    reference.  ``optimizer``/``lr_scheduler`` handles are views into the
+    engine (the update itself is compiled into the engine's step program).
+    """
+    config = config if config is not None else config_params
+    if config is None and args is not None and hasattr(args, "deepspeed_config"):
+        config = args.deepspeed_config
+
+    comm.init_distributed()
+    ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
+    if topology is None:
+        topology = initialize_topology(ds_config.mesh)
+
+    engine = DeepSpeedTPUEngine(
+        model=model,
+        config=ds_config,
+        topology=topology,
+        example_batch=example_batch,
+        loss_fn=loss_fn,
+        partition_rules=partition_rules,
+        training_data=training_data,
+        client_optimizer=optimizer,
+        lr_scheduler=lr_scheduler,
+    )
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_distributed(dist_backend: str = "xla", **kwargs) -> None:
+    comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Create an inference engine (reference ``init_inference``,
+    __init__.py:302)."""
+    from .inference.engine import InferenceEngine, InferenceConfig
+
+    cfg = config if isinstance(config, InferenceConfig) else InferenceConfig.from_dict(
+        config if isinstance(config, dict) else {})
+    for k, v in kwargs.items():
+        if hasattr(cfg, k):
+            setattr(cfg, k, v)
+    return InferenceEngine(model, cfg)
+
+
+def add_config_arguments(parser):
+    """Augment an argparse parser with the standard flags (reference
+    ``add_config_arguments``, __init__.py:279)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configuration")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the config JSON")
+    group.add_argument("--local_rank", type=int, default=0,
+                       help="Local process index (set by the launcher)")
+    return parser

@@ -1,0 +1,132 @@
+"""Checkpoint save/load.
+
+Analogue of ``engine.save_checkpoint`` / ``load_checkpoint`` (reference
+runtime/engine.py:3609/2770-style): writes a tagged directory with the full
+TrainState plus client state, and a ``latest`` pointer file.  Arrays are
+stored keyed by pytree path, so a checkpoint can be reloaded into ANY
+ZeRO-stage/mesh layout — each leaf is re-placed with the target engine's
+shardings on load (the seed of universal-checkpoint resharding; the
+partitioned multi-host writer lives in checkpoint/partitioned.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import comm
+from ..utils.logging import log_dist, logger
+
+MODEL_FILE = "model_states.npz"
+META_FILE = "meta.json"
+LATEST = "latest"
+
+
+def _flat_with_paths(tree: Any) -> Dict[str, Any]:
+    flat = {}
+
+    def visit(path, leaf):
+        if leaf is None:
+            return leaf
+        key = jax.tree_util.keystr(path)
+        flat[key] = leaf
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return flat
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[dict] = None) -> str:
+    tag = tag or f"global_step{engine.global_steps}"
+    path = os.path.join(save_dir, tag)
+    if jax.process_count() > 1:
+        # multi-host state is not fully addressable from one process; needs
+        # the per-process partitioned writer (planned: checkpoint/partitioned)
+        raise NotImplementedError(
+            "save_checkpoint currently supports single-host jobs only; "
+            "multi-host partitioned checkpointing is not yet implemented")
+    comm.barrier("pre-save")
+    if jax.process_index() == 0:
+        os.makedirs(path, exist_ok=True)
+        flat = _flat_with_paths(engine.state)
+        arrays = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
+        # bfloat16 has no numpy dtype; store as uint16 view + dtype note
+        dtypes = {}
+        for k, v in list(arrays.items()):
+            if v.dtype.name == "bfloat16":
+                arrays[k] = v.view(np.uint16)
+                dtypes[k] = "bfloat16"
+        np.savez(os.path.join(path, MODEL_FILE), **arrays)
+        meta = {
+            "tag": tag,
+            "global_steps": engine.global_steps,
+            "micro_steps": engine.micro_steps,
+            "lr_scheduler": engine.lr_scheduler.state_dict()
+            if hasattr(engine.lr_scheduler, "state_dict") else None,
+            "client_state": client_state or {},
+            "bfloat16_keys": dtypes,
+            "zero_stage": engine.config.zero_config.stage,
+        }
+        with open(os.path.join(path, META_FILE), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        with open(os.path.join(save_dir, LATEST), "w") as f:
+            f.write(tag)
+    comm.barrier("post-save")
+    log_dist(f"saved checkpoint {path}")
+    return path
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_lr_scheduler_states: bool = True) -> Tuple[Optional[str], dict]:
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST)
+        if not os.path.exists(latest):
+            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(load_dir, tag)
+    with open(os.path.join(path, META_FILE)) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(path, MODEL_FILE))
+    bf16_keys = set(meta.get("bfloat16_keys", {}))
+
+    import jax.numpy as jnp
+
+    def restore(path_key, current):
+        key = jax.tree_util.keystr(path_key)
+        if key not in data.files:
+            logger.warning(f"checkpoint missing {key}; keeping current value")
+            return current
+        arr = data[key]
+        if key in bf16_keys:
+            arr = arr.view(jnp.bfloat16)
+        from jax.sharding import NamedSharding
+
+        target_sharding = getattr(current, "sharding", None)
+        if not isinstance(target_sharding, NamedSharding):
+            # scalars / single-device leaves: re-place replicated on the mesh
+            # so the whole restored state shares one device set
+            target_sharding = engine.topology.replicated()
+        arr = jnp.asarray(arr, dtype=current.dtype).reshape(current.shape)
+        return jax.device_put(arr, target_sharding)
+
+    new_state = jax.tree_util.tree_map_with_path(restore, engine.state)
+    if not load_optimizer_states:
+        import dataclasses
+
+        new_state = dataclasses.replace(new_state, opt_state=engine.state.opt_state)
+    engine.state = new_state
+    engine.global_steps = meta["global_steps"]
+    engine.micro_steps = meta.get("micro_steps", 0)
+    if load_lr_scheduler_states and meta.get("lr_scheduler") and \
+            hasattr(engine.lr_scheduler, "load_state_dict"):
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    log_dist(f"loaded checkpoint {path}")
+    return path, meta.get("client_state", {})

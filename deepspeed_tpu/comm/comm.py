@@ -1,0 +1,204 @@
+"""Communication verb layer.
+
+The reference exposes ``deepspeed.comm`` — a module-level collective API over
+NCCL/Gloo/oneCCL (``deepspeed/comm/comm.py:223-690``).  On TPU the transport
+is XLA: collectives are *compiled into the program* and ride ICI/DCN.  This
+module therefore has two faces:
+
+1. **In-program verbs** (usable inside ``shard_map``/``jit`` bodies): thin
+   wrappers over ``jax.lax`` collectives keyed by mesh axis name instead of a
+   process-group object.  Every verb reports to the ``CommsLogger`` at trace
+   time (op, message size) — the TPU analogue of the reference's ``timed_op``
+   decorator, where wall-time comes from the profiler rather than host timers.
+
+2. **Host-level control**: ``init_distributed`` brings up
+   ``jax.distributed`` for multi-host pods (the reference's rendezvous,
+   comm/comm.py:788), ``barrier`` syncs hosts, ``broadcast_host`` ships
+   host data.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.logging import logger
+from .comms_logger import get_comms_logger
+
+AxisName = Union[str, Sequence[str]]
+
+_INITIALIZED = False
+
+
+# --------------------------------------------------------------------------
+# host-level control plane
+# --------------------------------------------------------------------------
+def init_distributed(dist_backend: str = "xla",
+                     coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     timeout: Optional[int] = None,
+                     **_ignored: Any) -> None:
+    """Join the job's rendezvous (multi-host pod) if configured.
+
+    Single-process (one host, N local devices) needs no rendezvous — this is
+    a no-op then.  Env vars follow the launcher contract
+    (``deepspeed_tpu/launcher``): DSTPU_COORDINATOR, DSTPU_NUM_PROCESSES,
+    DSTPU_PROCESS_ID.  Reference: ``init_distributed`` comm/comm.py:788.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
+    if coordinator_address:
+        num_processes = int(num_processes or os.environ.get("DSTPU_NUM_PROCESSES", "1"))
+        process_id = int(process_id if process_id is not None else os.environ.get("DSTPU_PROCESS_ID", "0"))
+        logger.info(f"init_distributed: joining {coordinator_address} "
+                    f"({process_id}/{num_processes})")
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _INITIALIZED = True
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def get_rank() -> int:
+    """Host-process index.  NOTE: unlike the reference (one rank per
+    accelerator), a JAX process drives many devices; pair this with
+    ``get_world_size()`` (process count).  For device counts use
+    ``get_device_count()``."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Host-process count (pairs with ``get_rank``)."""
+    return jax.process_count()
+
+
+def get_device_count() -> int:
+    """Global accelerator count — the reference's world_size."""
+    return jax.device_count()
+
+
+def get_local_rank() -> int:
+    return int(os.environ.get("DSTPU_LOCAL_RANK", "0"))
+
+
+def barrier(name: str = "barrier") -> None:
+    """Synchronize all hosts (no-op single-host)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def broadcast_host(value, src: int = 0):
+    """Broadcast host-side (pytree of) arrays from process ``src``."""
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value, is_source=jax.process_index() == src)
+
+
+# --------------------------------------------------------------------------
+# in-program collectives (use inside shard_map / pjit bodies)
+# --------------------------------------------------------------------------
+def _log(op: str, tensor, axis: AxisName) -> None:
+    cl = get_comms_logger()
+    if cl is not None and cl.enabled:
+        size = getattr(tensor, "size", 0) * jnp.dtype(getattr(tensor, "dtype", jnp.float32)).itemsize
+        cl.append(op, str(axis), size)
+
+
+def all_reduce(tensor, op: str = "sum", axis: AxisName = "data"):
+    """psum/pmax/pmin/pmean over a named mesh axis (reference comm.all_reduce)."""
+    _log("all_reduce", tensor, axis)
+    if op in ("sum", "SUM"):
+        return lax.psum(tensor, axis)
+    if op in ("avg", "AVG", "mean"):
+        return lax.pmean(tensor, axis)
+    if op in ("max", "MAX"):
+        return lax.pmax(tensor, axis)
+    if op in ("min", "MIN"):
+        return lax.pmin(tensor, axis)
+    raise ValueError(f"Unsupported reduce op {op}")
+
+
+def all_gather(tensor, axis: AxisName = "data", tensor_axis: int = 0, tiled: bool = True):
+    """Gather shards along ``tensor_axis`` from every rank of mesh ``axis``.
+
+    ``tiled=True`` concatenates (reference all_gather_into_tensor); False
+    stacks a new leading dim (reference all_gather list-of-tensors form).
+    """
+    _log("all_gather", tensor, axis)
+    return lax.all_gather(tensor, axis, axis=tensor_axis, tiled=tiled)
+
+
+def reduce_scatter(tensor, op: str = "sum", axis: AxisName = "data", scatter_dim: int = 0):
+    """Reduce then scatter shards (reference reduce_scatter_tensor)."""
+    _log("reduce_scatter", tensor, axis)
+    if op in ("avg", "mean"):
+        n = lax.psum(1, axis)
+        return lax.psum_scatter(tensor, axis, scatter_dimension=scatter_dim, tiled=True) / n
+    return lax.psum_scatter(tensor, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def all_to_all_single(tensor, axis: AxisName = "sequence", split_dim: int = 0,
+                      concat_dim: int = 0):
+    """All-to-all: split ``split_dim`` across ranks, concat received along
+    ``concat_dim`` (reference all_to_all_single, comm.py; the Ulysses
+    primitive, sequence/layer.py:221)."""
+    _log("all_to_all", tensor, axis)
+    return lax.all_to_all(tensor, axis, split_axis=split_dim, concat_axis=concat_dim,
+                          tiled=True)
+
+
+def broadcast(tensor, src_index: int = 0, axis: AxisName = "data"):
+    """Broadcast from rank ``src_index`` of the axis to all ranks of the axis.
+
+    Implemented as a masked psum — the XLA-native pattern (no root concept).
+    """
+    _log("broadcast", tensor, axis)
+    idx = lax.axis_index(axis)
+    mask = (idx == src_index).astype(tensor.dtype)
+    return lax.psum(tensor * mask, axis)
+
+
+def ppermute(tensor, perm, axis: AxisName = "pipe"):
+    """Point-to-point ring shift: the TPU-native send/recv
+    (reference pipe/p2p.py send/recv pairs)."""
+    _log("ppermute", tensor, axis)
+    return lax.ppermute(tensor, axis, perm)
+
+
+def send_recv_next(tensor, axis: AxisName = "pipe"):
+    """Shift +1 along the ring of ``axis`` (stage i -> i+1, wrapping)."""
+    n = lax.psum(1, axis)
+    return ppermute(tensor, [(i, (i + 1) % n) for i in range(n)], axis)
+
+
+def send_recv_prev(tensor, axis: AxisName = "pipe"):
+    """Shift -1 along the ring of ``axis`` (stage i -> i-1, wrapping)."""
+    n = lax.psum(1, axis)
+    return ppermute(tensor, [(i, (i - 1) % n) for i in range(n)], axis)
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+def axis_size_in_program(axis: AxisName):
+    return lax.psum(1, axis)
+
+
+def inference_all_reduce(tensor, axis: AxisName = "model"):
+    """TP partial-sum combine for inference (reference inference_all_reduce)."""
+    return all_reduce(tensor, "sum", axis)

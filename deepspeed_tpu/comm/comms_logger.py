@@ -1,0 +1,81 @@
+"""Communication logger.
+
+Analogue of the reference ``CommsLogger`` (``deepspeed/utils/comms_logging.py``)
+fed by the ``timed_op`` decorator (comm/comm.py:102).  On TPU, collectives are
+compiled into the XLA program, so per-call wall time is not observable from
+Python — instead we record *trace-time* occurrences and message sizes (what
+the program will execute each step) and estimated bus bandwidth is left to the
+profiler.  ``log_summary`` prints per-op totals like the reference.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+                 debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # op name -> axis -> [count, total_bytes]
+        self.comms_dict: Dict[str, Dict[str, List[int]]] = defaultdict(
+            lambda: defaultdict(lambda: [0, 0]))
+
+    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+        if debug is not None:
+            self.debug = debug
+
+    def append(self, op_name: str, axis: str, msg_size_bytes: int) -> None:
+        if not self.enabled:
+            return
+        if not self.prof_all and op_name not in self.prof_ops:
+            return
+        rec = self.comms_dict[op_name][axis]
+        rec[0] += 1
+        rec[1] += int(msg_size_bytes)
+        if self.verbose:
+            logger.info(f"comm: {op_name} axis={axis} bytes={msg_size_bytes}")
+
+    def log_summary(self) -> str:
+        lines = ["Comms summary (trace-time):",
+                 f"{'op':<20}{'axis':<28}{'count':>8}{'total MB':>12}"]
+        for op, axes in sorted(self.comms_dict.items()):
+            for axis, (count, nbytes) in sorted(axes.items()):
+                lines.append(f"{op:<20}{axis:<28}{count:>8}{nbytes / 1e6:>12.2f}")
+        out = "\n".join(lines)
+        logger.info(out)
+        return out
+
+    def reset(self) -> None:
+        self.comms_dict.clear()
+
+
+_COMMS_LOGGER: Optional[CommsLogger] = None
+
+
+def get_comms_logger() -> Optional[CommsLogger]:
+    return _COMMS_LOGGER
+
+
+def configure_comms_logger(**kwargs) -> CommsLogger:
+    global _COMMS_LOGGER
+    if _COMMS_LOGGER is None:
+        _COMMS_LOGGER = CommsLogger()
+    _COMMS_LOGGER.configure(**kwargs)
+    return _COMMS_LOGGER

@@ -1,0 +1,95 @@
+"""Metrics monitor.
+
+Analogue of ``MonitorMaster`` (reference monitor/monitor.py:30): fans
+``(tag, value, step)`` events out to TensorBoard / W&B / CSV writers on
+process 0 only.  TensorBoard and W&B degrade gracefully when the packages
+are absent (CSV always works).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def write_events(self, events: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, output_path: str, job_name: str):
+        self.dir = os.path.join(output_path or "./csv_monitor", job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events: List[Event]) -> None:
+        for tag, value, step in events:
+            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, output_path: str, job_name: str):
+        from torch.utils.tensorboard import SummaryWriter  # torch cpu is baked in
+
+        self.writer = SummaryWriter(log_dir=os.path.join(output_path or "./runs", job_name))
+
+    def write_events(self, events: List[Event]) -> None:
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, project: str, group, team):
+        import wandb
+
+        wandb.init(project=project, group=group, entity=team)
+        self._wandb = wandb
+
+    def write_events(self, events: List[Event]) -> None:
+        for tag, value, step in events:
+            self._wandb.log({tag: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, config):
+        self.monitors: List[Monitor] = []
+        if jax.process_index() != 0:
+            return
+        if config.csv_monitor.enabled:
+            self.monitors.append(CSVMonitor(config.csv_monitor.output_path,
+                                            config.csv_monitor.job_name))
+        if config.tensorboard.enabled:
+            try:
+                self.monitors.append(TensorBoardMonitor(
+                    config.tensorboard.output_path, config.tensorboard.job_name))
+            except Exception as e:  # tensorboard not installed
+                logger.warning(f"TensorBoard monitor unavailable: {e}")
+        if config.wandb.enabled:
+            try:
+                self.monitors.append(WandbMonitor(config.wandb.project,
+                                                  config.wandb.group, config.wandb.team))
+            except Exception as e:
+                logger.warning(f"W&B monitor unavailable: {e}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.monitors)
+
+    def write_events(self, events: List[Event]) -> None:
+        for m in self.monitors:
+            m.write_events(events)

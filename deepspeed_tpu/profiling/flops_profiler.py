@@ -1,0 +1,86 @@
+"""FLOPs profiler.
+
+The reference counts MACs with module hooks and functional patching
+(``profiling/flops_profiler/profiler.py``).  On TPU the compiler already
+knows: ``jax.stage/lower(...).cost_analysis()`` reports exact flops and
+bytes for the compiled program.  This profiler asks XLA for the cost of the
+engine's compiled train step and reports flops/step, params, and achieved
+FLOPS when stepping wall-time is available.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def count_params(params: Any) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+def cost_analysis_of(fn, *args) -> Dict[str, float]:
+    """Lower a jitted function and return XLA's cost analysis."""
+    try:
+        lowered = fn.lower(*args)
+        compiled = lowered.compile()
+        costs = compiled.cost_analysis()
+        if isinstance(costs, list):  # older jax returns [dict]
+            costs = costs[0] if costs else {}
+        return dict(costs or {})
+    except Exception as e:  # pragma: no cover
+        logger.warning(f"cost_analysis failed: {e}")
+        return {}
+
+
+class FlopsProfiler:
+    """Engine plugin (reference FlopsProfiler API: start/stop/print)."""
+
+    def __init__(self, engine, config):
+        self.engine = engine
+        self.config = config
+        self.profile_step = config.profile_step
+        self._active = False
+        self._t0 = 0.0
+        self._last_batch = None
+        self.flops = 0.0
+        self.duration = 0.0
+
+    def start_profile_maybe(self, global_step: int, batch: Any = None) -> None:
+        if batch is not None:
+            self._last_batch = batch
+        if global_step == self.profile_step and not self._active:
+            self._active = True
+            self._t0 = time.perf_counter()
+
+    def stop_profile_maybe(self, global_step: int) -> None:
+        if self._active and global_step >= self.profile_step:
+            self.duration = time.perf_counter() - self._t0
+            self._active = False
+            self.print_profile()
+
+    def get_total_flops(self) -> float:
+        if self._last_batch is None:
+            return 0.0
+        eng = self.engine
+        costs = cost_analysis_of(eng._micro_step, eng.state, self._last_batch,
+                                 jax.random.PRNGKey(0))
+        self.flops = float(costs.get("flops", 0.0))
+        return self.flops
+
+    def get_total_params(self) -> int:
+        return count_params(self.engine.state.params)
+
+    def print_profile(self) -> None:
+        params = self.get_total_params()
+        flops = self.get_total_flops()
+        tput = flops / self.duration if self.duration > 0 else 0.0
+        logger.info(
+            f"flops profiler: params={params / 1e6:.2f}M "
+            f"flops/micro-step={flops / 1e9:.2f}G "
+            f"step_time={self.duration * 1e3:.1f}ms "
+            f"achieved={tput / 1e12:.2f} TFLOPS")

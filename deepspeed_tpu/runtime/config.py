@@ -1,0 +1,361 @@
+"""DeepSpeed-compatible JSON configuration.
+
+Accepts the same ``ds_config.json`` surface as the reference
+(``deepspeed/runtime/config.py``): the batch-size triangle
+(train_batch_size = micro_batch * grad_accum * dp_world_size), optimizer /
+scheduler blocks, fp16/bf16 blocks, zero_optimization, and the feature
+sub-configs.  TPU-specific additions live under the ``"mesh"`` key
+(axis sizes for data/model/pipe/sequence/expert parallelism).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+from .config_utils import AUTO, ConfigModel
+from ..utils.logging import logger
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+
+@dataclasses.dataclass
+class FP16Config(ConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+@dataclasses.dataclass
+class BF16Config(ConfigModel):
+    enabled: bool = False
+    # Keep a master fp32 copy of params for the optimizer (reference
+    # BF16_Optimizer semantics, runtime/bf16_optimizer.py:35).
+    master_weights: bool = True
+
+
+@dataclasses.dataclass
+class OffloadConfig(ConfigModel):
+    """Param/optimizer offload target (reference zero/offload_config.py)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: str = "/tmp/dstpu_nvme"
+    pin_memory: bool = True
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    ratio: float = 1.0
+    max_in_cpu: int = 1_000_000_000
+
+    @property
+    def enabled(self) -> bool:
+        return self.device not in ("none", None)
+
+
+@dataclasses.dataclass
+class ZeroConfig(ConfigModel):
+    """zero_optimization block (reference zero/config.py)."""
+
+    stage: int = 0
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_bucket_size: int = 500_000_000
+    offload_param: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
+    offload_optimizer: OffloadConfig = dataclasses.field(default_factory=OffloadConfig)
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    # ZeRO++ style knobs: quantized weight gather / hierarchical partition
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    # MiCS-style replica-group sharding: shard within groups of this size,
+    # replicate across groups (reference zero/mics.py).
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+
+    def validate(self) -> None:
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+
+    @classmethod
+    def deprecated_fields(cls):
+        return {"cpu_offload": "offload_optimizer"}
+
+
+@dataclasses.dataclass
+class OptimizerConfig(ConfigModel):
+    type: str = "adamw"
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SchedulerConfig(ConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class MeshConfig(ConfigModel):
+    """TPU mesh axis sizes. -1 on ``data`` means 'all remaining devices'."""
+
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    sequence: int = 1
+    model: int = 1
+    # How ICI/DCN axes are stacked for multi-slice: 'ici_major' keeps model/
+    # sequence axes on the fastest links.
+    axis_order: str = "pipe,data,expert,sequence,model"
+
+
+@dataclasses.dataclass
+class ActivationCheckpointingConfig(ConfigModel):
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: jax.remat policy name (see runtime/activation_checkpointing)
+    policy: str = "nothing_saveable"
+
+
+@dataclasses.dataclass
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclasses.dataclass
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class MonitorConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+@dataclasses.dataclass
+class TensorBoardConfig(MonitorConfig):
+    pass
+
+
+@dataclasses.dataclass
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+@dataclasses.dataclass
+class CSVConfig(MonitorConfig):
+    pass
+
+
+@dataclasses.dataclass
+class AIOConfig(ConfigModel):
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+
+
+@dataclasses.dataclass
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False
+
+
+@dataclasses.dataclass
+class GradientCompressionConfig(ConfigModel):
+    """1-bit / compressed-communication style gradient compression."""
+
+    enabled: bool = False
+    bits: int = 8  # int8 compressed allreduce over ICI
+    error_feedback: bool = True
+
+
+@dataclasses.dataclass
+class DeepSpeedConfig:
+    """Parsed top-level config.
+
+    Mirrors reference ``DeepSpeedConfig`` (runtime/config.py): constructed
+    from a dict or a json path, resolves the batch-size triangle against the
+    data-parallel world size.
+    """
+
+    raw: Dict[str, Any]
+    train_batch_size: Optional[int]
+    train_micro_batch_size_per_gpu: Optional[int]
+    gradient_accumulation_steps: Optional[int]
+    steps_per_print: int
+    gradient_clipping: float
+    prescale_gradients: bool
+    gradient_predivide_factor: float
+    communication_data_type: Optional[str]
+    seed: int
+    wall_clock_breakdown: bool
+    memory_breakdown: bool
+    dump_state: bool
+    fp16: FP16Config
+    bf16: BF16Config
+    zero_config: ZeroConfig
+    optimizer: OptimizerConfig
+    scheduler: SchedulerConfig
+    mesh: MeshConfig
+    activation_checkpointing: ActivationCheckpointingConfig
+    flops_profiler: FlopsProfilerConfig
+    comms_logger: CommsLoggerConfig
+    tensorboard: TensorBoardConfig
+    wandb: WandbConfig
+    csv_monitor: CSVConfig
+    aio: AIOConfig
+    checkpoint: CheckpointConfig
+    compression: GradientCompressionConfig
+    zero_allow_untested_optimizer: bool
+    gradient_accumulation_dtype: str
+
+    def __init__(self, config: Any, dp_world_size: Optional[int] = None):
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        if config is None:
+            config = {}
+        if not isinstance(config, dict):
+            raise TypeError(f"config must be a dict or json path, got {type(config)}")
+        self.raw = config
+
+        g = config.get
+        self.train_batch_size = _maybe_int(g(TRAIN_BATCH_SIZE))
+        self.train_micro_batch_size_per_gpu = _maybe_int(g(TRAIN_MICRO_BATCH_SIZE_PER_GPU))
+        self.gradient_accumulation_steps = _maybe_int(g(GRADIENT_ACCUMULATION_STEPS))
+        self.steps_per_print = max(1, int(g("steps_per_print", 10) or 1))
+        self.gradient_clipping = float(g("gradient_clipping", 0.0))
+        self.prescale_gradients = bool(g("prescale_gradients", False))
+        self.gradient_predivide_factor = float(g("gradient_predivide_factor", 1.0))
+        self.communication_data_type = g("communication_data_type")
+        self.seed = int(g("seed", 1234))
+        self.wall_clock_breakdown = bool(g("wall_clock_breakdown", False))
+        self.memory_breakdown = bool(g("memory_breakdown", False))
+        self.dump_state = bool(g("dump_state", False))
+        self.zero_allow_untested_optimizer = bool(g("zero_allow_untested_optimizer", False))
+        self.gradient_accumulation_dtype = g("data_types", {}).get(
+            "grad_accum_dtype", "fp32") or "fp32"
+
+        self.fp16 = FP16Config.from_dict(g("fp16"))
+        self.bf16 = BF16Config.from_dict(g("bf16") or g("bfloat16"))
+        self.zero_config = ZeroConfig.from_dict(g("zero_optimization"))
+        self.optimizer = OptimizerConfig.from_dict(g("optimizer"))
+        self.scheduler = SchedulerConfig.from_dict(g("scheduler"))
+        self.mesh = MeshConfig.from_dict(g("mesh"))
+        self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
+            g("activation_checkpointing"))
+        self.flops_profiler = FlopsProfilerConfig.from_dict(g("flops_profiler"))
+        self.comms_logger = CommsLoggerConfig.from_dict(g("comms_logger"))
+        self.tensorboard = TensorBoardConfig.from_dict(g("tensorboard"))
+        self.wandb = WandbConfig.from_dict(g("wandb"))
+        self.csv_monitor = CSVConfig.from_dict(g("csv_monitor"))
+        self.aio = AIOConfig.from_dict(g("aio"))
+        self.checkpoint = CheckpointConfig.from_dict(g("checkpoint"))
+        self.compression = GradientCompressionConfig.from_dict(g("gradient_compression"))
+
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+
+        if dp_world_size is not None:
+            self.resolve_batch_size(dp_world_size)
+
+    # -- batch-size triangle ------------------------------------------------
+    def resolve_batch_size(self, dp_world_size: int) -> None:
+        """Resolve train_batch = micro_batch * grad_accum * dp_world_size.
+
+        Same rules as reference ``DeepSpeedConfig._configure_train_batch_size``:
+        any two determine the third; one alone assumes the others are 1/derived;
+        none => micro=1, gas=1.
+        """
+        tb, mb, gas = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                       self.gradient_accumulation_steps)
+        if all(v is not None for v in (tb, mb, gas)):
+            if tb != mb * gas * dp_world_size:
+                raise ValueError(
+                    f"Batch-size inconsistency: train_batch_size={tb} != "
+                    f"micro({mb}) * gas({gas}) * dp({dp_world_size})")
+        elif tb is not None and mb is not None:
+            gas = tb // (mb * dp_world_size)
+            if gas * mb * dp_world_size != tb:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by micro*dp = {mb * dp_world_size}")
+        elif tb is not None and gas is not None:
+            mb = tb // (gas * dp_world_size)
+            if mb * gas * dp_world_size != tb:
+                raise ValueError(
+                    f"train_batch_size {tb} not divisible by gas*dp = {gas * dp_world_size}")
+        elif mb is not None:
+            gas = gas or 1
+            tb = mb * gas * dp_world_size
+        elif tb is not None:
+            mb = tb // dp_world_size
+            gas = 1
+            if mb * dp_world_size != tb:
+                raise ValueError(f"train_batch_size {tb} not divisible by dp {dp_world_size}")
+        else:
+            mb, gas = 1, 1
+            tb = mb * gas * dp_world_size
+        self.train_batch_size, self.train_micro_batch_size_per_gpu = tb, mb
+        self.gradient_accumulation_steps = gas
+
+    # ----------------------------------------------------------------------
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def print_config(self) -> None:
+        logger.info(f"DeepSpeedTPU config: {json.dumps(self.raw, indent=2, default=str)}")
+
+
+def _maybe_int(v: Any) -> Optional[int]:
+    if v is None or v == AUTO:
+        return None
+    return int(v)

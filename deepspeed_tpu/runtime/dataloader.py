@@ -1,0 +1,104 @@
+"""Distributed dataloader.
+
+Analogue of ``DeepSpeedDataLoader`` (reference runtime/dataloader.py): shards
+a dataset across the data-parallel ranks and yields device-ready,
+mesh-sharded batches.  Works with numpy arrays, torch datasets (CPU), or any
+indexable; the returned global arrays are laid out with
+``jax.make_array_from_process_local_data`` so multi-host feeding is correct
+(each process only materializes its slice).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import MeshTopology
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in samples]) for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class RepeatingLoader:
+    """Wraps an iterator to repeat forever (reference runtime/dataloader.py
+    RepeatingLoader, used by the pipeline engine)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    def __init__(self, dataset: Any, batch_size: int, topology: MeshTopology,
+                 collate_fn: Optional[Callable] = None, seed: int = 0,
+                 shuffle: bool = True, drop_last: bool = True,
+                 shard_seq_dim: bool = False):
+        self.dataset = dataset
+        self.batch_size = batch_size  # micro-batch per DP rank
+        self.topology = topology
+        self.collate_fn = collate_fn or _default_collate
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.shard_seq_dim = shard_seq_dim
+        self.epoch = 0
+
+        self.dp = topology.dp_world_size
+        self.global_batch = self.batch_size * self.dp
+        n = len(dataset)
+        self.num_batches = n // self.global_batch if drop_last else -(-n // self.global_batch)
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(idx)
+        usable = self.num_batches * self.global_batch
+        if usable > n:  # pad by wrapping (drop_last=False)
+            idx = np.concatenate([idx, idx[:usable - n]])
+        return idx[:usable]
+
+    def __iter__(self) -> Iterator:
+        sharding = self.topology.batch_sharding(with_seq=self.shard_seq_dim)
+        idx = self._indices()
+        for b in range(self.num_batches):
+            batch_idx = idx[b * self.global_batch:(b + 1) * self.global_batch]
+            host = self.collate_fn([self.dataset[int(i)] for i in batch_idx])
+            yield jax.tree_util.tree_map(
+                lambda x: _to_global(np.asarray(x), sharding), host)
+        self.epoch += 1
+
+
+def _to_global(array: np.ndarray, sharding) -> jax.Array:
+    if jax.process_count() == 1:
+        return jax.device_put(array, sharding)
+    # each process holds the full global batch here; hand XLA our slice
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        array, sharding.mesh, sharding.spec)

@@ -1,0 +1,458 @@
+"""The training engine.
+
+TPU-native analogue of ``DeepSpeedEngine`` (reference runtime/engine.py:205).
+The reference wraps a live torch module and orchestrates fwd/bwd/step with
+hooks; here the engine owns a **TrainState pytree** and two compiled
+programs:
+
+  * ``_micro_step``: fwd+bwd of one micro-batch, gradients accumulated into a
+    (ZeRO-sharded) fp32 buffer — the analogue of ``engine.forward`` +
+    ``engine.backward`` (engine.py:2216/2466) with IPG bucketing replaced by
+    XLA-scheduled reduce-scatter.
+  * ``_apply_step``: grad-norm/clip/overflow + optimizer update at the
+    gradient-accumulation boundary — ``_take_model_step`` (engine.py:2568).
+
+Memory partitioning (ZeRO stages) is purely a property of the shardings that
+these programs are compiled with (see zero/strategy.py).
+
+API compatibility: ``engine(batch)`` / ``engine.backward(loss)`` /
+``engine.step()`` drive the same micro/boundary cadence as the reference;
+``train_batch(batch)`` is the native fused path (scan over micro-batches in
+one program) and is what benchmarks should use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import comm
+from ..parallel.mesh import MeshTopology
+from ..utils.logging import log_dist, logger
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
+                           STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
+                           ThroughputTimer)
+from .config import DeepSpeedConfig
+from .lr_schedules import LRSchedulerShim, get_schedule
+from .module import ModelSpec, as_model_spec
+from .optimizers import build_optimizer
+from .precision import (LossScaleState, cast_tree, check_overflow,
+                        clip_by_global_norm, global_grad_norm,
+                        update_loss_scale)
+from .zero.strategy import ZeroShardingPlan
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """All mutable training state, as one pytree."""
+
+    step: jnp.ndarray  # optimizer (global) steps taken
+    micro_step: jnp.ndarray  # micro-steps since last boundary
+    params: Any  # fp32 master (stage>=1: ZeRO-sharded)
+    opt_state: Any
+    grad_acc: Any  # accumulation buffer, grad_accum_dtype
+    loss_scale: Optional[LossScaleState]
+    skipped_steps: jnp.ndarray
+    global_grad_norm: jnp.ndarray  # from the last boundary
+
+
+class DeepSpeedTPUEngine:
+    def __init__(self,
+                 model: Any,
+                 config: DeepSpeedConfig,
+                 topology: Optional[MeshTopology] = None,
+                 example_batch: Any = None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_rules=None,
+                 training_data=None,
+                 client_optimizer=None,
+                 lr_scheduler=None,
+                 seed: Optional[int] = None):
+        self.config = config
+        self.topology = topology or MeshTopology(config.mesh)
+        config.resolve_batch_size(self.topology.dp_world_size)
+        self.model: ModelSpec = as_model_spec(model, example_batch, loss_fn, partition_rules)
+
+        self.zero_plan = ZeroShardingPlan(self.topology, config.zero_config,
+                                          self.model.partition_rules())
+        self.compute_dtype = config.compute_dtype
+        self.grad_accum_dtype = {
+            "fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16,
+        }[config.gradient_accumulation_dtype]
+        self.fp16_enabled = config.fp16.enabled
+        self.bf16_enabled = config.bf16.enabled
+
+        # optimizer + schedule.  A client lr_scheduler must be a pure
+        # ``step -> lr`` callable so it can compile into the update; a client
+        # optimizer must be an optax GradientTransformation.  Anything else
+        # (e.g. a torch optimizer/scheduler from a ported script) cannot
+        # silently take effect — reject it loudly.
+        if lr_scheduler is not None and not callable(lr_scheduler):
+            raise TypeError(
+                "lr_scheduler must be a callable step->lr schedule (it is compiled "
+                "into the update); torch-style scheduler objects are not supported. "
+                f"Got {type(lr_scheduler)}")
+        self.lr_schedule = lr_scheduler if lr_scheduler is not None else get_schedule(
+            config.scheduler.type, config.scheduler.params,
+            float(config.optimizer.params.get("lr", 1e-3)))
+        if client_optimizer is not None:
+            if not isinstance(client_optimizer, optax.GradientTransformation):
+                raise TypeError(
+                    "optimizer must be an optax.GradientTransformation; torch "
+                    f"optimizers are not supported on TPU. Got {type(client_optimizer)}")
+            self.optimizer = client_optimizer
+            self.base_lr = float(config.optimizer.params.get("lr", 1e-3))
+        else:
+            self.optimizer, self.base_lr = build_optimizer(
+                config.optimizer.type, config.optimizer.params, self.lr_schedule)
+        self.lr_scheduler = LRSchedulerShim(self.lr_schedule)
+
+        # observability
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size or 1,
+                                          steps_per_output=config.steps_per_print)
+        self.monitor = None
+        if config.tensorboard.enabled or config.csv_monitor.enabled or config.wandb.enabled:
+            from ..monitor.monitor import MonitorMaster
+
+            self.monitor = MonitorMaster(config)
+        if config.comms_logger.enabled:
+            comm.configure_comms_logger(
+                enabled=True, verbose=config.comms_logger.verbose,
+                prof_all=config.comms_logger.prof_all,
+                prof_ops=config.comms_logger.prof_ops)
+        self.flops_profiler = None
+        if config.flops_profiler.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler(self, config.flops_profiler)
+
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+
+        self.global_steps = 0
+        self.micro_steps = 0
+        self._cached_loss = None
+        self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
+
+        self.state = self._init_state()
+        self._compile_steps()
+        log_dist(f"DeepSpeedTPUEngine initialized: zero_stage={config.zero_config.stage} "
+                 f"dtype={self.compute_dtype.__name__} mesh={self.topology.axis_sizes} "
+                 f"micro_bs={config.train_micro_batch_size_per_gpu} "
+                 f"gas={config.gradient_accumulation_steps}")
+
+    # ------------------------------------------------------------------ init
+    def _init_state(self) -> TrainState:
+        """Initialize params already sharded: the analogue of ``zero.Init``
+        (reference partition_parameters.py:878) — params are *born
+        partitioned*; no full replica ever materializes (jit with
+        out_shardings on the init function)."""
+        init_rng, self._rng = jax.random.split(self._rng)
+
+        abstract = jax.eval_shape(self.model.init_params, init_rng)
+        param_shardings = self.zero_plan.tree_shardings(abstract, "master")
+
+        init_fn = jax.jit(
+            lambda rng: cast_tree(self.model.init_params(rng), jnp.float32),
+            out_shardings=param_shardings)
+        with self.topology.mesh:
+            params = init_fn(init_rng)
+
+        opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=None)(params)  # moments inherit param shardings via XLA
+        grad_acc = jax.jit(
+            lambda p: jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), p),
+            out_shardings=self.zero_plan.tree_shardings(abstract, "grad"))(params)
+
+        loss_scale = LossScaleState.create(self.config.fp16) if self.fp16_enabled else None
+        # scalars live replicated on the mesh so the whole TrainState shares
+        # one device set (mixing committed single-device scalars with mesh
+        # arrays is a jit error)
+        rep = self.topology.replicated()
+        scalar = lambda v, dt: jax.device_put(jnp.asarray(v, dt), rep)  # noqa: E731
+        if loss_scale is not None:
+            loss_scale = jax.device_put(loss_scale, rep)
+        return TrainState(
+            step=scalar(0, jnp.int32),
+            micro_step=scalar(0, jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            grad_acc=grad_acc,
+            loss_scale=loss_scale,
+            skipped_steps=scalar(0, jnp.int32),
+            global_grad_norm=scalar(0.0, jnp.float32),
+        )
+
+    # ------------------------------------------------------------- programs
+    def _compute_params(self, master_params):
+        """fp32 master -> compute-dtype copy, constrained to the live-param
+        sharding (stage 3: still sharded; XLA all-gathers per-layer at use,
+        in compute dtype — the fetch/release of the reference's
+        PartitionedParameterCoordinator, for free)."""
+        p = cast_tree(master_params, self.compute_dtype)
+        return self.zero_plan.constrain(p, "param")
+
+    def _micro_step_body(self, state: TrainState, batch, rng) -> Tuple[TrainState, jnp.ndarray]:
+        compute_params = self._compute_params(state.params)
+
+        def scaled_loss_fn(p):
+            loss = self.model.loss_fn(p, batch, rng)
+            if self.fp16_enabled:
+                # scale in fp32: the default scale (2^16) overflows float16
+                return loss.astype(jnp.float32) * state.loss_scale.cur_scale, loss
+            return loss, loss
+
+        grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
+        grads = cast_tree(grads, self.grad_accum_dtype)
+        grads = self.zero_plan.constrain(grads, "grad")
+        new_acc = jax.tree_util.tree_map(jnp.add, state.grad_acc, grads)
+        state = dataclasses.replace(state, grad_acc=new_acc,
+                                    micro_step=state.micro_step + 1)
+        return state, loss.astype(jnp.float32)
+
+    def _apply_step_body(self, state: TrainState) -> TrainState:
+        gas = self.config.gradient_accumulation_steps or 1
+        denom = jnp.asarray(float(gas), jnp.float32)
+        if self.fp16_enabled:
+            denom = denom * state.loss_scale.cur_scale
+
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) / denom), state.grad_acc)
+        grads = self.zero_plan.constrain(grads, "master")
+
+        norm = global_grad_norm(grads)
+        clip = self.config.gradient_clipping
+        if clip > 0:
+            grads = clip_by_global_norm(grads, norm, clip)
+
+        def do_update(operand):
+            params, opt_state, grads = operand
+            updates, new_opt = self.optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt, jnp.asarray(0, jnp.int32)
+
+        def skip_update(operand):
+            params, opt_state, _ = operand
+            return params, opt_state, jnp.asarray(1, jnp.int32)
+
+        if self.fp16_enabled:
+            overflow = check_overflow(grads)
+            new_params, new_opt, skipped = jax.lax.cond(
+                overflow, skip_update, do_update, (state.params, state.opt_state, grads))
+            new_scale = update_loss_scale(state.loss_scale, overflow, self.config.fp16)
+        else:
+            new_params, new_opt, skipped = do_update(
+                (state.params, state.opt_state, grads))
+            new_scale = state.loss_scale
+
+        zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+        return dataclasses.replace(
+            state,
+            params=new_params,
+            opt_state=new_opt,
+            grad_acc=zero_acc,
+            loss_scale=new_scale,
+            step=state.step + (1 - skipped),
+            micro_step=jnp.asarray(0, jnp.int32),
+            skipped_steps=state.skipped_steps + skipped,
+            global_grad_norm=norm,
+        )
+
+    def _train_batch_body(self, state: TrainState, batches, rng) -> Tuple[TrainState, jnp.ndarray]:
+        """Fused full step: scan micro-batches then apply.  ``batches`` has a
+        leading gradient-accumulation dim."""
+        gas = self.config.gradient_accumulation_steps or 1
+        rngs = jax.random.split(rng, gas)
+
+        def body(st, xs):
+            batch, r = xs
+            st, loss = self._micro_step_body(st, batch, r)
+            return st, loss
+
+        state, losses = jax.lax.scan(body, state, (batches, rngs))
+        state = self._apply_step_body(state)
+        return state, jnp.mean(losses)
+
+    def _compile_steps(self) -> None:
+        donate = dict(donate_argnums=(0,))
+        self._micro_step = jax.jit(self._micro_step_body, **donate)
+        self._apply_step = jax.jit(self._apply_step_body, **donate)
+        self._train_batch = jax.jit(self._train_batch_body, **donate)
+        self._eval_fn = None
+
+    # ------------------------------------------------------------ public API
+    def _next_rng(self):
+        self._rng, out = jax.random.split(self._rng)
+        return out
+
+    def train_batch(self, batch=None, data_iter: Optional[Iterator] = None):
+        """One full optimizer step (the native fused path).
+
+        ``batch`` leaves must carry a leading dim of
+        ``gradient_accumulation_steps`` (use ``stack_microbatches``), or pass
+        ``data_iter`` to pull gas micro-batches.
+        """
+        if batch is None:
+            it = data_iter or self.training_dataloader
+            if it is None:
+                raise ValueError("train_batch needs a batch or a data iterator")
+            gas = self.config.gradient_accumulation_steps or 1
+            micro = [next(it) for _ in range(gas)]
+            batch = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micro)
+        if self.flops_profiler is not None:
+            self.flops_profiler.start_profile_maybe(self.global_steps, batch)
+        self.tput_timer.start()
+        with self.topology.mesh:
+            self.state, loss = self._train_batch(self.state, batch, self._next_rng())
+        self.global_steps += 1
+        self.micro_steps += self.config.gradient_accumulation_steps or 1
+        # dispatch is async: drain the device queue at reporting boundaries so
+        # the throughput window [boundary, boundary] measures real wall time
+        if self.global_steps % self.config.steps_per_print == 0 or \
+                self.config.wall_clock_breakdown:
+            jax.block_until_ready(loss)
+        self.tput_timer.stop()
+        if self.flops_profiler is not None:
+            self.flops_profiler.stop_profile_maybe(self.global_steps)
+        self._report(loss)
+        return loss
+
+    def forward(self, batch):
+        """DeepSpeed-compat micro-step: computes loss AND gradients in one
+        fused fwd+bwd (cached); ``backward`` then only accounts the
+        micro-step.  Matches reference cadence, avoids double forward."""
+        if self.flops_profiler is not None:
+            self.flops_profiler.start_profile_maybe(self.global_steps, batch)
+        self.timers(FORWARD_GLOBAL_TIMER).start()
+        with self.topology.mesh:
+            self.state, loss = self._micro_step(self.state, batch, self._next_rng())
+        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        self._cached_loss = loss
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None):
+        """Gradient work already fused into forward (XLA compiles fwd+bwd as
+        one program); this advances the micro-step counter (reference
+        engine.backward, engine.py:2466)."""
+        self.timers(BACKWARD_GLOBAL_TIMER).start()
+        self.micro_steps += 1
+        self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        return loss if loss is not None else self._cached_loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        gas = self.config.gradient_accumulation_steps or 1
+        return self.micro_steps % gas == 0
+
+    def step(self):
+        """Apply the optimizer at the gas boundary (reference engine.step,
+        engine.py:2641)."""
+        self.timers(STEP_GLOBAL_TIMER).start()
+        if self.is_gradient_accumulation_boundary():
+            with self.topology.mesh:
+                self.state = self._apply_step(self.state)
+            self.global_steps += 1
+            self.lr_scheduler.step()
+            if self.config.wall_clock_breakdown:
+                jax.block_until_ready(self.state.step)
+            self._report(self._cached_loss)
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        if self.flops_profiler is not None:
+            self.flops_profiler.stop_profile_maybe(self.global_steps)
+
+    def eval_batch(self, batch):
+        if self._eval_fn is None:
+            def _eval(params, batch):
+                p = self._compute_params(params)
+                if self.model.apply_fn is not None:
+                    return self.model.apply_fn(p, batch)
+                return self.model.loss_fn(p, batch, None)
+
+            self._eval_fn = jax.jit(_eval)
+        with self.topology.mesh:
+            return self._eval_fn(self.state.params, batch)
+
+    # ------------------------------------------------------------- data path
+    def deepspeed_io(self, dataset, batch_size: Optional[int] = None,
+                     collate_fn=None, num_local_io_workers=None, data_sampler=None):
+        """Build the distributed dataloader (reference deepspeed_io,
+        engine.py:2029)."""
+        from .dataloader import DeepSpeedDataLoader
+
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or self.config.train_micro_batch_size_per_gpu,
+            topology=self.topology,
+            collate_fn=collate_fn,
+            seed=self.config.seed)
+
+    def stack_microbatches(self, micro_batches):
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micro_batches)
+
+    # ---------------------------------------------------------- observability
+    def _report(self, loss) -> None:
+        cfg = self.config
+        if self.monitor is not None and loss is not None:
+            step = self.global_steps
+            self.monitor.write_events([
+                ("Train/Samples/train_loss", float(loss), step),
+                ("Train/Samples/lr", self.get_lr()[0], step),
+            ])
+        if cfg.wall_clock_breakdown and self.global_steps % cfg.steps_per_print == 0:
+            self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER])
+
+    def get_lr(self):
+        return [float(self.lr_schedule(int(self.state.step)))]
+
+    def get_global_grad_norm(self) -> float:
+        return float(self.state.global_grad_norm)
+
+    def loss_scale(self) -> float:
+        if self.state.loss_scale is None:
+            return 1.0
+        return float(self.state.loss_scale.cur_scale)
+
+    @property
+    def skipped_steps(self) -> int:
+        return int(self.state.skipped_steps)
+
+    def get_params(self, dtype=None):
+        p = self.state.params
+        return cast_tree(p, dtype) if dtype is not None else p
+
+    # -------------------------------------------------------------- ckpt API
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None, **kw):
+        from ..checkpoint.saving import save_checkpoint
+
+        return save_checkpoint(self, save_dir, tag=tag, client_state=client_state or {})
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
+        from ..checkpoint.saving import load_checkpoint
+
+        return load_checkpoint(self, load_dir, tag=tag)
+
+    # batch-size accessors (reference engine API)
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    def train_batch_size(self) -> int:
+        return self.config.train_batch_size
+
+    def zero_optimization_stage(self) -> int:
+        return self.config.zero_config.stage

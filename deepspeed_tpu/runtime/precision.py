@@ -1,0 +1,111 @@
+"""Mixed precision: loss scaling and dtype policy.
+
+Reference parity: ``DynamicLossScaler`` (runtime/fp16/loss_scaler.py:99),
+``FP16_Optimizer`` overflow semantics (fp16/fused_optimizer.py), and
+``BF16_Optimizer`` master-weight accumulation (bf16_optimizer.py:35).
+
+On TPU everything lives *inside* the jitted step: the overflow check is a
+``jnp.isfinite`` reduction over gradients and the skip-step is a
+``lax.cond`` — no host round-trip, no torch-style ``.item()`` sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import FP16Config
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LossScaleState:
+    """Dynamic loss-scale state, carried in the TrainState pytree."""
+
+    cur_scale: jnp.ndarray  # f32 scalar
+    growth_tracker: jnp.ndarray  # i32: good steps since last overflow
+    hysteresis_tracker: jnp.ndarray  # i32
+
+    @staticmethod
+    def create(config: FP16Config) -> "LossScaleState":
+        init = config.loss_scale if config.loss_scale > 0 else 2.0 ** config.initial_scale_power
+        return LossScaleState(
+            cur_scale=jnp.asarray(init, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+            hysteresis_tracker=jnp.asarray(config.hysteresis, jnp.int32),
+        )
+
+
+def check_overflow(grads: Any) -> jnp.ndarray:
+    """True if any grad is inf/nan (reference has_overflow_serial +
+    cross-rank max; here the grads are already globally reduced)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    out = jnp.asarray(False)
+    for f in flags:
+        out = jnp.logical_or(out, f)
+    return out
+
+
+def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray,
+                      config: FP16Config) -> LossScaleState:
+    """Dynamic scaling: on overflow halve (respecting hysteresis) and reset
+    the growth tracker; after ``loss_scale_window`` clean steps double.
+    Static scaling (loss_scale > 0) never changes."""
+    if config.loss_scale > 0:  # static
+        return state
+
+    def on_overflow(s: LossScaleState) -> LossScaleState:
+        # reference semantics: hysteresis decrements on EVERY overflow; the
+        # scale halves once it is exhausted.  It is replenished only by a
+        # clean step (unless consecutive_hysteresis).
+        hyst = s.hysteresis_tracker - 1
+        new_scale = jnp.where(
+            hyst <= 0,
+            jnp.maximum(s.cur_scale / 2.0, config.min_loss_scale),
+            s.cur_scale)
+        return LossScaleState(
+            cur_scale=new_scale,
+            growth_tracker=jnp.zeros_like(s.growth_tracker),
+            hysteresis_tracker=jnp.maximum(hyst, 0).astype(jnp.int32),
+        )
+
+    def on_clean(s: LossScaleState) -> LossScaleState:
+        tracker = s.growth_tracker + 1
+        grow = tracker >= config.loss_scale_window
+        return LossScaleState(
+            cur_scale=jnp.where(grow, s.cur_scale * 2.0, s.cur_scale),
+            growth_tracker=jnp.where(grow, 0, tracker).astype(jnp.int32),
+            hysteresis_tracker=s.hysteresis_tracker if config.consecutive_hysteresis
+            else jnp.asarray(config.hysteresis, jnp.int32),
+        )
+
+    return jax.lax.cond(overflow, on_overflow, on_clean, state)
+
+
+def cast_tree(tree: Any, dtype) -> Any:
+    """Cast floating-point leaves only (ints/bools pass through)."""
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def global_grad_norm(grads: Any) -> jnp.ndarray:
+    """L2 norm over the whole (already globally-reduced) gradient pytree
+    (reference runtime/utils.py clip_grad_norm_)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(total)
+
+
+def clip_by_global_norm(grads: Any, norm: jnp.ndarray, clip: float) -> Any:
+    scale = jnp.minimum(1.0, clip / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype), grads)

@@ -1,0 +1,169 @@
+"""ZeRO as sharding strategy.
+
+The reference implements ZeRO with runtime hooks and hand-written bucketed
+collectives (``runtime/zero/stage_1_and_2.py``, ``stage3.py``).  On TPU the
+same memory partitioning is expressed *declaratively*: each stage is a rule
+for which pieces of training state carry a sharded ``PartitionSpec`` over the
+ZeRO mesh axes, and XLA-SPMD schedules the all-gathers / reduce-scatters that
+the reference issues by hand (IPG buckets -> latency-hiding scheduler).
+
+  stage 0: params/grads/optimizer replicated; grads psum over data.
+  stage 1: optimizer state (and fp32 master weights) sharded.
+  stage 2: + gradient accumulation buffer sharded (reduce-scatter not
+           all-reduce — XLA derives this because the only consumer is the
+           sharded update).
+  stage 3: + parameters themselves sharded (FSDP); XLA all-gathers each
+           layer's params just before use, frees after (the reference's
+           fetch/release hooks, partitioned_param_coordinator.py:285/425).
+
+MiCS (reference zero/mics.py): ``mics_shard_size`` limits sharding to
+subgroups of the data axis — expressed by splitting the data axis logically.
+Cited parity: DeepSpeedZeroOptimizer (stage_1_and_2.py:125),
+DeepSpeedZeroOptimizer_Stage3 (stage3.py:129).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...parallel.mesh import EXPERT_AXIS, MeshTopology, ZERO_AXES
+from ...utils.logging import logger
+from ..config import ZeroConfig
+
+#: params whose leading dim is an expert dim are sharded over the expert axis
+#: by the model's partition rules; their ZeRO axes exclude "expert".
+PartitionRule = Tuple[str, P]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _spec_axes(spec: P) -> set:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+class ZeroShardingPlan:
+    """Computes NamedShardings for params / master+optimizer / gradients."""
+
+    def __init__(self, topology: MeshTopology, config: Optional[ZeroConfig] = None,
+                 partition_rules: Optional[Sequence[PartitionRule]] = None):
+        self.topology = topology
+        self.config = config or ZeroConfig()
+        self.stage = self.config.stage
+        self.partition_rules = list(partition_rules or [])
+        # effective shard group size (MiCS): -1 => whole zero axis group
+        self._zero_axes = [a for a in ZERO_AXES if topology.axis_size(a) > 1]
+
+    # -- model-parallel (TP/EP) base spec -----------------------------------
+    def base_spec(self, path_str: str, ndim: int) -> P:
+        for pattern, spec in self.partition_rules:
+            if re.search(pattern, path_str):
+                if len(spec) > ndim:
+                    raise ValueError(
+                        f"Partition rule {pattern} spec {spec} has more dims than "
+                        f"param {path_str} with ndim {ndim}")
+                return P(*(tuple(spec) + (None,) * (ndim - len(spec))))
+        return P(*((None,) * ndim))
+
+    # -- zero extension ------------------------------------------------------
+    def _extend_with_zero(self, spec: P, shape: Tuple[int, ...], path_str: str) -> P:
+        """Insert the ZeRO axes on the largest dim they divide evenly."""
+        zero_axes = [a for a in self._zero_axes if a not in _spec_axes(spec)]
+        # expert params: their replicas only exist within an expert group, so
+        # the expert axis is already consumed by the rule; nothing special.
+        if not zero_axes:
+            return spec
+        zsize = int(np.prod([self.topology.axis_size(a) for a in zero_axes]))
+        if zsize == 1:
+            return spec
+        # candidate dims: unsharded first (add axes alone), then sharded dims
+        # (append zero axes after the existing model axes on that dim).
+        best_dim, best_len, best_combined = -1, -1, None
+        mesh_sizes = self.topology.axis_sizes
+        for dim, dim_size in enumerate(shape):
+            entry = spec[dim] if dim < len(spec) else None
+            existing = () if entry is None else (tuple(entry) if isinstance(entry, (tuple, list)) else (entry,))
+            existing_size = int(np.prod([mesh_sizes[a] for a in existing])) if existing else 1
+            if dim_size % (existing_size * zsize) == 0 and dim_size > best_len:
+                best_dim, best_len = dim, dim_size
+                best_combined = existing + tuple(zero_axes)
+        if best_dim < 0:
+            logger.debug(f"ZeRO: param {path_str} shape {shape} not divisible by "
+                         f"{zsize}; replicating")
+            return spec
+        entries = list(spec) + [None] * (len(shape) - len(spec))
+        entries[best_dim] = best_combined if len(best_combined) > 1 else best_combined[0]
+        return P(*entries)
+
+    # -- public API ----------------------------------------------------------
+    def param_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
+        """Sharding of the live (compute) parameters."""
+        spec = self.base_spec(path_str, len(shape))
+        if self.stage >= 3:
+            spec = self._extend_with_zero(spec, shape, path_str)
+        return spec
+
+    def master_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
+        """Sharding of fp32 master weights + optimizer moments."""
+        spec = self.base_spec(path_str, len(shape))
+        if self.stage >= 1:
+            spec = self._extend_with_zero(spec, shape, path_str)
+        return spec
+
+    def grad_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
+        """Sharding of the gradient-accumulation buffer."""
+        spec = self.base_spec(path_str, len(shape))
+        if self.stage >= 2:
+            spec = self._extend_with_zero(spec, shape, path_str)
+        return spec
+
+    # -- tree-level helpers --------------------------------------------------
+    def _kind_fn(self, kind: str) -> Callable[[str, Tuple[int, ...]], P]:
+        return {"param": self.param_spec, "master": self.master_spec,
+                "grad": self.grad_spec}[kind]
+
+    def tree_specs(self, tree: Any, kind: str) -> Any:
+        """PartitionSpec pytree (same structure as ``tree``) for a
+        parameter-shaped pytree.  kind in {"param", "master", "grad"}."""
+        fn = self._kind_fn(kind)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: fn(_path_str(path), tuple(getattr(leaf, "shape", ()))), tree)
+
+    def tree_shardings(self, tree: Any, kind: str) -> Any:
+        fn = self._kind_fn(kind)
+        mesh = self.topology.mesh
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: NamedSharding(
+                mesh, fn(_path_str(path), tuple(getattr(leaf, "shape", ())))), tree)
+
+    def constrain(self, tree: Any, kind: str) -> Any:
+        """Apply with_sharding_constraint to a pytree inside jit."""
+        fn = self._kind_fn(kind)
+        mesh = self.topology.mesh
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(mesh, fn(_path_str(path), tuple(leaf.shape)))), tree)

@@ -1,0 +1,64 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``: a
+process-wide logger plus ``log_dist`` which only emits on the requested
+process indices (JAX process index replaces torch.distributed rank).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name: str = "DeepSpeedTPU", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+def _process_index() -> int:
+    # Deferred import: logging must be importable before jax initializes.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (default: process 0).
+
+    ``ranks=[-1]`` logs on every process.
+    """
+    ranks = list(ranks) if ranks is not None else [0]
+    my_rank = _process_index()
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,45 @@
+"""Test harness configuration.
+
+The reference simulates multi-node as multi-process-single-host with a
+file-store rendezvous (tests/unit/common.py DistributedTest).  The TPU
+analogue: ONE process with 8 virtual CPU devices
+(``--xla_force_host_platform_device_count``) and real XLA collectives over a
+``jax.sharding.Mesh`` — the "Gloo-equivalent" device-free CI mode
+(SURVEY.md §4).
+"""
+
+import os
+
+# Must happen before any CPU backend is created.  Tests always run on the
+# virtual CPU mesh (set DSTPU_TEST_PLATFORM to override, e.g. to run on a
+# real chip).  jax.config.update is needed (not just the env var) because a
+# site plugin may have already pinned jax_platforms.
+_platform = os.environ.get("DSTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", _platform)
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Each test builds its own mesh topology."""
+    from deepspeed_tpu.parallel import mesh
+
+    mesh.reset_topology()
+    yield
+    mesh.reset_topology()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

@@ -1,0 +1,62 @@
+"""Tiny model fixtures (analogue of reference tests/unit/simple_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.module import ModelSpec
+
+HIDDEN = 16
+
+
+def simple_mlp_spec(hidden_dim: int = HIDDEN, nlayers: int = 2) -> ModelSpec:
+    """An MLP regression model returning MSE loss — the SimpleModel of the
+    reference test suite."""
+
+    def init_params(rng):
+        keys = jax.random.split(rng, nlayers)
+        params = {}
+        for i, k in enumerate(keys):
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(k, (hidden_dim, hidden_dim)) * 0.1,
+                "b": jnp.zeros((hidden_dim,)),
+            }
+        return params
+
+    def forward(params, x):
+        for i in range(nlayers):
+            layer = params[f"layer_{i}"]
+            x = x @ layer["w"] + layer["b"]
+            if i < nlayers - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        out = forward(params, x)
+        return jnp.mean((out - y.astype(out.dtype)) ** 2)
+
+    return ModelSpec(init_params, loss_fn, apply_fn=lambda p, b: forward(p, b[0]))
+
+
+def _true_map(hidden_dim: int) -> np.ndarray:
+    """Fixed ground-truth linear map so the regression task is learnable."""
+    rng = np.random.RandomState(42)
+    return (rng.randn(hidden_dim, hidden_dim) * 0.3).astype(np.float32)
+
+
+def random_dataset(n_samples: int = 128, hidden_dim: int = HIDDEN, seed: int = 0):
+    """List of (x, y) numpy pairs (reference random_dataloader)."""
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n_samples, hidden_dim).astype(np.float32)
+    ys = xs @ _true_map(hidden_dim)
+    return [(xs[i], ys[i]) for i in range(n_samples)]
+
+
+def random_batch(batch_size: int = 8, hidden_dim: int = HIDDEN, seed: int = 0,
+                 gas: int = 0):
+    rng = np.random.RandomState(seed)
+    shape = (gas, batch_size, hidden_dim) if gas else (batch_size, hidden_dim)
+    xs = rng.randn(*shape).astype(np.float32)
+    ys = xs @ _true_map(hidden_dim)
+    return jnp.asarray(xs), jnp.asarray(ys)

@@ -1,0 +1,73 @@
+"""Config system tests (reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_batch_triangle_all_given():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+    }, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_triangle_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2},
+                          dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangle_infer_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triangle_inconsistent():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({
+            "train_batch_size": 33,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+        }, dp_world_size=8)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_config():
+    cfg = DeepSpeedConfig({"zero_optimization": {"stage": 3,
+                                                 "stage3_prefetch_bucket_size": 1000}})
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.stage3_prefetch_bucket_size == 1000
+    assert cfg.zero_enabled
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"zero_optimization": {"stage": 5}})
+
+
+def test_deprecated_key_warns():
+    cfg = DeepSpeedConfig({"zero_optimization": {"stage": 1, "cpu_offload": {"device": "cpu"}}})
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig({
+        "optimizer": {"type": "AdamW", "params": {"lr": 0.001, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_mesh_config():
+    cfg = DeepSpeedConfig({"mesh": {"model": 2, "data": -1}})
+    assert cfg.mesh.model == 2
+    assert cfg.mesh.data == -1

@@ -1,0 +1,159 @@
+"""Engine end-to-end tests: the minimum slice (SURVEY §7 build order #2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.unit.simple_model import random_batch, random_dataset, simple_mlp_spec
+
+
+def _make_engine(config_overrides=None, **kw):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(config_overrides or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=simple_mlp_spec(), config=cfg, **kw)
+    return engine
+
+
+def _loss_decreases(engine, steps=20, gas=1):
+    losses = []
+    for i in range(steps):
+        batch = random_batch(batch_size=16, seed=i % 4, gas=gas)
+        loss = engine.train_batch(batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses[0]} -> {losses[-1]}"
+    return losses
+
+
+def test_train_fp32():
+    engine = _make_engine()
+    _loss_decreases(engine)
+    assert int(engine.state.step) == 20
+
+
+def test_train_bf16():
+    engine = _make_engine({"bf16": {"enabled": True}})
+    _loss_decreases(engine)
+
+
+def test_train_fp16_loss_scaling():
+    engine = _make_engine({"fp16": {"enabled": True, "initial_scale_power": 8}})
+    _loss_decreases(engine)
+    assert engine.loss_scale() > 0
+
+
+def test_grad_accumulation():
+    engine = _make_engine({"gradient_accumulation_steps": 4})
+    _loss_decreases(engine, steps=8, gas=4)
+    assert int(engine.state.step) == 8
+
+
+def test_forward_backward_step_compat():
+    """The DeepSpeed-style training loop."""
+    engine = _make_engine({"gradient_accumulation_steps": 2})
+    losses = []
+    for i in range(16):
+        batch = random_batch(batch_size=16, seed=i % 4)
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert int(engine.state.step) == 8  # 16 micro / gas 2
+    # compare same-seed batches: 12 is seed 0, as is 0
+    assert losses[12] < losses[0]
+
+
+def test_gradient_clipping():
+    engine = _make_engine({"gradient_clipping": 0.01})
+    engine.train_batch(random_batch(batch_size=16, gas=1))
+    assert engine.get_global_grad_norm() >= 0
+
+
+def test_scheduler_warmup():
+    engine = _make_engine({
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                                 "warmup_num_steps": 10}}})
+    lr0 = engine.get_lr()[0]
+    for i in range(5):
+        engine.train_batch(random_batch(batch_size=8, seed=i, gas=1))
+    assert engine.get_lr()[0] > lr0
+
+
+def test_dataloader_training():
+    data = random_dataset(64)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}},
+        training_data=data)
+    assert loader is not None
+    it = iter(deepspeed_tpu.runtime.dataloader.RepeatingLoader(loader))
+    l0 = float(engine.train_batch(data_iter=it))
+    for _ in range(10):
+        l1 = float(engine.train_batch(data_iter=it))
+    assert np.isfinite(l1)
+
+
+def test_eval_batch():
+    engine = _make_engine()
+    out = engine.eval_batch(random_batch(batch_size=4))
+    assert out.shape == (4, 16)
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_train(stage, devices8):
+    engine = _make_engine({"zero_optimization": {"stage": stage},
+                           "bf16": {"enabled": True}})
+    _loss_decreases(engine, steps=10)
+
+
+def test_zero_stage3_params_sharded(devices8):
+    engine = _make_engine({"zero_optimization": {"stage": 3}})
+    # master params must be sharded over the data axis
+    leaf = engine.state.params["layer_0"]["w"]
+    spec = leaf.sharding.spec
+    assert any(s is not None for s in spec), f"stage-3 param not sharded: {spec}"
+
+
+def test_zero_stage0_params_replicated(devices8):
+    engine = _make_engine({"zero_optimization": {"stage": 0}})
+    leaf = engine.state.params["layer_0"]["w"]
+    assert all(s is None for s in leaf.sharding.spec)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    engine = _make_engine()
+    for i in range(3):
+        engine.train_batch(random_batch(batch_size=8, seed=i, gas=1))
+    params_before = jax.device_get(engine.state.params)
+    engine.save_checkpoint(str(tmp_path), client_state={"foo": 1})
+
+    engine2 = _make_engine()
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert client == {"foo": 1}
+    assert engine2.global_steps == 3
+    after = jax.device_get(engine2.state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), params_before, after)
+    # resumed training works
+    engine2.train_batch(random_batch(batch_size=8, gas=1))
+
+
+def test_checkpoint_reshard_across_zero_stage(tmp_path, devices8):
+    """Save at stage 0, load at stage 3 (the universal-checkpoint promise)."""
+    e0 = _make_engine({"zero_optimization": {"stage": 0}})
+    e0.train_batch(random_batch(batch_size=8, gas=1))
+    e0.save_checkpoint(str(tmp_path))
+
+    e3 = _make_engine({"zero_optimization": {"stage": 3}})
+    e3.load_checkpoint(str(tmp_path))
+    a = jax.device_get(e0.state.params["layer_0"]["w"])
+    b = jax.device_get(e3.state.params["layer_0"]["w"])
+    np.testing.assert_allclose(a, b, rtol=1e-6)

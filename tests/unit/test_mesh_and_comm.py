@@ -1,0 +1,119 @@
+"""Mesh topology + comm verb tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, MeshTopology,
+                                         SEQ_AXIS)
+
+
+def test_mesh_sizes(devices8):
+    topo = MeshTopology(MeshConfig(data=-1, model=2), devices8)
+    assert topo.axis_size("data") == 4
+    assert topo.model_parallel_size == 2
+    assert topo.world_size == 8
+
+
+def test_mesh_all_fixed(devices8):
+    topo = MeshTopology(MeshConfig(pipe=2, data=2, model=2), devices8)
+    assert topo.axis_size("data") == 2
+    with pytest.raises(ValueError):
+        MeshTopology(MeshConfig(pipe=3, data=-1), devices8)
+
+
+def test_all_reduce_psum(devices8):
+    topo = MeshTopology(MeshConfig(data=-1), devices8)
+
+    def body(x):
+        return comm.all_reduce(x, "sum", DATA_AXIS)
+
+    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS),
+                  out_specs=P(DATA_AXIS))
+    x = jnp.arange(8.0)
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+
+
+def test_all_gather_and_reduce_scatter(devices8):
+    topo = MeshTopology(MeshConfig(data=-1), devices8)
+
+    def gather_body(x):
+        return comm.all_gather(x, DATA_AXIS, tensor_axis=0)
+
+    f = jax.shard_map(gather_body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS, None),
+                  out_specs=P(None, None))
+    x = jnp.arange(16.0).reshape(8, 2)
+    out = f(x)
+    # per-rank result is the full (8, 2); replicated -> global (8, 2)
+    assert out.shape == (8, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+    def rs_body(x):
+        return comm.reduce_scatter(x, "sum", DATA_AXIS, scatter_dim=0)
+
+    g = jax.shard_map(rs_body, check_vma=False, mesh=topo.mesh, in_specs=P(None, None),
+                  out_specs=P(DATA_AXIS, None))
+    y = jnp.ones((8, 2))
+    out = g(y)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 8.0))
+
+
+def test_all_to_all(devices8):
+    topo = MeshTopology(MeshConfig(data=1, sequence=8), devices8)
+
+    def body(x):
+        # x per-rank: [seq_shard, heads] -> [full seq, heads/ranks]
+        return comm.all_to_all_single(x, SEQ_AXIS, split_dim=1, concat_dim=0)
+
+    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(SEQ_AXIS, None),
+                  out_specs=P(None, SEQ_AXIS))
+    x = jnp.arange(64.0).reshape(8, 8)
+    out = f(x)
+    assert out.shape == (8, 8)
+    # round trip back
+    def inv(x):
+        return comm.all_to_all_single(x, SEQ_AXIS, split_dim=0, concat_dim=1)
+
+    finv = jax.shard_map(inv, check_vma=False, mesh=topo.mesh, in_specs=P(None, SEQ_AXIS),
+                     out_specs=P(SEQ_AXIS, None))
+    np.testing.assert_allclose(np.asarray(finv(out)), np.asarray(x))
+
+
+def test_broadcast(devices8):
+    topo = MeshTopology(MeshConfig(data=-1), devices8)
+
+    def body(x):
+        return comm.broadcast(x, src_index=3, axis=DATA_AXIS)
+
+    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
+    x = jnp.arange(8.0)
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+
+def test_ppermute_ring(devices8):
+    topo = MeshTopology(MeshConfig(data=1, pipe=8), devices8)
+
+    def body(x):
+        return comm.send_recv_next(x, "pipe")
+
+    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P("pipe"), out_specs=P("pipe"))
+    x = jnp.arange(8.0)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_comms_logger(devices8):
+    logger = comm.configure_comms_logger(enabled=True)
+    logger.reset()
+    topo = MeshTopology(MeshConfig(data=-1), devices8)
+    f = jax.shard_map(lambda x: comm.all_reduce(x, "sum", DATA_AXIS), check_vma=False,
+                      mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
+    f(jnp.arange(8.0))
+    assert "all_reduce" in logger.comms_dict
+    logger.configure(enabled=False)

@@ -1,0 +1,69 @@
+"""Loss-scaler semantics (reference tests/unit/runtime/half_precision)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config import FP16Config
+from deepspeed_tpu.runtime.precision import (LossScaleState, check_overflow,
+                                             update_loss_scale)
+from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+
+def test_default_scale_is_representable_in_fp32_path():
+    cfg = FP16Config.from_dict({"enabled": True})
+    s = LossScaleState.create(cfg)
+    assert float(s.cur_scale) == 65536.0
+
+
+def test_persistent_overflow_halves_scale():
+    """With default hysteresis=2, repeated overflow must eventually halve."""
+    cfg = FP16Config.from_dict({"enabled": True, "initial_scale_power": 16})
+    s = LossScaleState.create(cfg)
+    overflow = jnp.asarray(True)
+    s = update_loss_scale(s, overflow, cfg)  # consumes hysteresis 2->1
+    assert float(s.cur_scale) == 65536.0
+    s = update_loss_scale(s, overflow, cfg)  # 1->0: halves
+    assert float(s.cur_scale) == 32768.0
+    s = update_loss_scale(s, overflow, cfg)  # keeps halving
+    assert float(s.cur_scale) == 16384.0
+
+
+def test_clean_steps_replenish_hysteresis_and_grow():
+    cfg = FP16Config.from_dict({"enabled": True, "loss_scale_window": 2, "hysteresis": 2})
+    s = LossScaleState.create(cfg)
+    s = update_loss_scale(s, jnp.asarray(True), cfg)  # hyst 2->1
+    s = update_loss_scale(s, jnp.asarray(False), cfg)  # replenishes to 2
+    assert int(s.hysteresis_tracker) == 2
+    s = update_loss_scale(s, jnp.asarray(False), cfg)  # window hit: doubles
+    assert float(s.cur_scale) == 2 * 65536.0
+
+
+def test_static_scale_never_changes():
+    cfg = FP16Config.from_dict({"enabled": True, "loss_scale": 128.0})
+    s = LossScaleState.create(cfg)
+    s = update_loss_scale(s, jnp.asarray(True), cfg)
+    assert float(s.cur_scale) == 128.0
+
+
+def test_check_overflow():
+    good = {"a": jnp.ones(3)}
+    bad = {"a": jnp.asarray([1.0, jnp.inf])}
+    assert not bool(check_overflow(good))
+    assert bool(check_overflow(bad))
+
+
+def test_fp16_training_default_scale_not_inf():
+    """fp16 with DEFAULT initial_scale_power=16 must not produce inf loss
+    (scale multiply must happen in fp32)."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "fp16": {"enabled": True},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    for i in range(5):
+        loss = engine.train_batch(random_batch(batch_size=8, seed=i, gas=1))
+        assert np.isfinite(float(loss))
+    # defaults must not skip every step
+    assert int(engine.state.step) > 0
