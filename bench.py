@@ -1,0 +1,94 @@
+"""Benchmark: llama causal-LM training throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The comparator: the reference's headline sustained utilization is 54% of
+hardware peak (Ulysses blog, BASELINE.md) — ``vs_baseline`` is our achieved
+model-flops-utilization divided by 0.54, i.e. >1.0 means we beat the
+reference's utilization on our hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak bf16 FLOP/s
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 1e12,  # nominal, so CPU runs still report something
+}
+
+
+def _peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for name, peak in PEAK_BF16_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            return peak
+    return PEAK_BF16_FLOPS["cpu"]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.models.transformer import flops_per_token
+
+    on_tpu = jax.default_backend() != "cpu"
+    size = "160m" if on_tpu else "tiny"
+    seq = 1024 if on_tpu else 64
+    micro_bs = 8 if on_tpu else 2
+    steps = 20 if on_tpu else 3
+
+    model = llama_model(size, max_seq_len=seq)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+    dp = engine.topology.dp_world_size
+    n_chips = engine.topology.world_size
+
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+
+    def batch():
+        ids = rng.randint(0, vocab, (1, micro_bs * dp, seq)).astype(np.int32)
+        return {"input_ids": jnp.asarray(ids)}
+
+    # warmup / compile
+    loss = engine.train_batch(batch())
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch())
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = steps * micro_bs * dp * seq
+    tok_per_sec_chip = tokens / dt / n_chips
+    model_flops = flops_per_token(model.config, seq) * tokens
+    mfu = model_flops / dt / (n_chips * _peak_for(jax.devices()[0]))
+
+    print(json.dumps({
+        "metric": f"llama-{size} bf16 zero1 tokens/sec/chip (seq={seq}, mfu={mfu:.3f})",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.54, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+from .bert import bert_config, bert_model
+from .gpt2 import gpt2_config, gpt2_model
+from .llama import llama_config, llama_model
+from .mixtral import mixtral_config, mixtral_model
+from .transformer import TransformerConfig
+
+__all__ = ["bert_config", "bert_model", "gpt2_config", "gpt2_model",
+           "llama_config", "llama_model", "mixtral_config", "mixtral_model",
+           "TransformerConfig"]

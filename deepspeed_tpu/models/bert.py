@@ -1,0 +1,66 @@
+"""BERT family with MLM pretraining loss (BASELINE config #2: BERT-base
+ZeRO-1 bf16).
+
+Parity: reference bert container (``module_inject/containers/bert.py``) and
+the BingBert convergence baseline (tests/model/).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.module import ModelSpec
+from .transformer import (TransformerConfig, flops_per_token,
+                          init_transformer_params, transformer_forward,
+                          transformer_partition_rules)
+
+SIZES = {
+    "tiny": (64, 2, 4, 128, 256),
+    "base": (768, 12, 12, 512, 30522),
+    "large": (1024, 24, 16, 512, 30522),
+}
+
+
+def bert_config(size: str = "base", **overrides) -> TransformerConfig:
+    h, l, nh, seq, vocab = SIZES[size]
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh,
+        intermediate_size=4 * h, max_seq_len=seq, norm="layernorm",
+        activation="gelu", position="learned", causal=False, use_bias=True,
+        tie_embeddings=True)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def mlm_loss(cfg: TransformerConfig, params, batch, rng=None):
+    """Masked-LM cross entropy.  batch: dict(input_ids, labels,
+    optional attention_mask); label -100 = not predicted (HF convention)."""
+    ids = batch["input_ids"]
+    labels = batch["labels"]
+    mask = batch.get("attention_mask")
+    hidden, aux = transformer_forward(cfg, params, ids, mask)
+    logits = hidden @ params["embed"]["tok"].T
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    safe = jnp.maximum(labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    sel = (labels >= 0).astype(jnp.float32)
+    return jnp.sum(nll * sel) / jnp.maximum(jnp.sum(sel), 1.0) + aux
+
+
+def bert_model(size: str = "base", config: Optional[TransformerConfig] = None,
+               **overrides) -> ModelSpec:
+    cfg = config or bert_config(size, **overrides)
+    spec = ModelSpec(
+        init_params=lambda rng: init_transformer_params(cfg, rng),
+        loss_fn=lambda params, batch, rng: mlm_loss(cfg, params, batch, rng),
+        partition_rules=transformer_partition_rules(cfg),
+        apply_fn=lambda params, batch: transformer_forward(
+            cfg, params, batch["input_ids"] if isinstance(batch, dict) else batch)[0],
+        flops_per_sample=flops_per_token(cfg, cfg.max_seq_len) * cfg.max_seq_len,
+    )
+    spec.config = cfg
+    return spec

@@ -1,0 +1,51 @@
+"""GPT-2 family (BASELINE config #3: GPT-2 1.3B ZeRO-2).
+
+Parity: reference megatron/gpt containers (``module_inject/containers/
+gpt2.py``, ``megatron_gpt.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..runtime.module import ModelSpec
+from .transformer import (TransformerConfig, causal_lm_loss, flops_per_token,
+                          init_transformer_params, logits_fn,
+                          transformer_forward, transformer_partition_rules)
+
+SIZES = {
+    "tiny": (64, 2, 4, 256, 256),
+    "124m": (768, 12, 12, 1024, 50257),
+    "350m": (1024, 24, 16, 1024, 50257),
+    "774m": (1280, 36, 20, 1024, 50257),
+    "1.3b": (2048, 24, 16, 2048, 50257),
+    "1.5b": (1600, 48, 25, 1024, 50257),
+}
+
+
+def gpt2_config(size: str = "124m", **overrides) -> TransformerConfig:
+    h, l, nh, seq, vocab = SIZES[size]
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh,
+        intermediate_size=4 * h, max_seq_len=seq, norm="layernorm",
+        activation="gelu", position="learned", causal=True, use_bias=True,
+        tie_embeddings=True)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def gpt2_model(size: str = "124m", config: Optional[TransformerConfig] = None,
+               **overrides) -> ModelSpec:
+    cfg = config or gpt2_config(size, **overrides)
+    spec = ModelSpec(
+        init_params=lambda rng: init_transformer_params(cfg, rng),
+        loss_fn=lambda params, batch, rng: causal_lm_loss(cfg, params, batch, rng),
+        partition_rules=transformer_partition_rules(cfg),
+        apply_fn=lambda params, batch: logits_fn(
+            cfg, params, transformer_forward(
+                cfg, params, batch["input_ids"] if isinstance(batch, dict) else batch)[0]),
+        flops_per_sample=flops_per_token(cfg, cfg.max_seq_len) * cfg.max_seq_len,
+    )
+    spec.config = cfg
+    return spec

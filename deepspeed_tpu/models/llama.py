@@ -1,0 +1,56 @@
+"""Llama family (the flagship training model).
+
+Parity target: the reference's llama containers/implementations
+(``module_inject/containers/llama.py``, ``inference/v2/model_implementations/
+llama_v2``) and BASELINE config #4 (Llama-2-7B ZeRO-3 bf16).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..runtime.module import ModelSpec
+from .transformer import (TransformerConfig, causal_lm_loss, flops_per_token,
+                          init_transformer_params, logits_fn,
+                          transformer_forward, transformer_partition_rules)
+
+SIZES = {
+    # name: (hidden, layers, heads, kv_heads, ffn, vocab)
+    "tiny": (64, 2, 4, 4, 128, 256),  # test fixture
+    "160m": (768, 12, 12, 12, 2048, 32000),
+    "1b": (2048, 16, 32, 8, 5504, 32000),
+    "7b": (4096, 32, 32, 32, 11008, 32000),
+    "13b": (5120, 40, 40, 40, 13824, 32000),
+    "70b": (8192, 80, 64, 8, 28672, 32000),
+}
+
+
+def llama_config(size: str = "7b", max_seq_len: int = 2048,
+                 **overrides) -> TransformerConfig:
+    h, l, nh, kvh, ffn, vocab = SIZES[size]
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh, n_kv_heads=kvh,
+        intermediate_size=ffn, max_seq_len=max_seq_len, norm="rmsnorm",
+        activation="swiglu", position="rope", causal=True)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def llama_model(size: str = "7b", max_seq_len: int = 2048,
+                config: Optional[TransformerConfig] = None, **overrides) -> ModelSpec:
+    cfg = config or llama_config(size, max_seq_len, **overrides)
+
+    spec = ModelSpec(
+        init_params=lambda rng: init_transformer_params(cfg, rng),
+        loss_fn=lambda params, batch, rng: causal_lm_loss(cfg, params, batch, rng),
+        partition_rules=transformer_partition_rules(cfg),
+        apply_fn=lambda params, batch: logits_fn(
+            cfg, params, transformer_forward(
+                cfg, params, batch["input_ids"] if isinstance(batch, dict) else batch)[0]),
+        flops_per_sample=flops_per_token(cfg, max_seq_len) * max_seq_len,
+    )
+    spec.config = cfg
+    return spec

@@ -1,0 +1,51 @@
+"""Mixtral-style MoE decoder (BASELINE config #5: Mixtral 8x7B, ZeRO-3 +
+expert parallelism + Ulysses SP).
+
+Parity: reference MoE stack (``deepspeed/moe/``) + mixtral inference impl
+(``inference/v2/model_implementations/mixtral``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..runtime.module import ModelSpec
+from .transformer import (TransformerConfig, causal_lm_loss, flops_per_token,
+                          init_transformer_params, logits_fn,
+                          transformer_forward, transformer_partition_rules)
+
+SIZES = {
+    # name: (hidden, layers, heads, kv_heads, ffn, vocab, experts, top_k)
+    "tiny": (64, 2, 4, 4, 128, 256, 4, 2),
+    "8x160m": (768, 12, 12, 12, 2048, 32000, 8, 2),
+    "8x7b": (4096, 32, 32, 8, 14336, 32000, 8, 2),
+}
+
+
+def mixtral_config(size: str = "8x7b", max_seq_len: int = 2048,
+                   **overrides) -> TransformerConfig:
+    h, l, nh, kvh, ffn, vocab, experts, top_k = SIZES[size]
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh, n_kv_heads=kvh,
+        intermediate_size=ffn, max_seq_len=max_seq_len, norm="rmsnorm",
+        activation="swiglu", position="rope", causal=True,
+        moe_experts=experts, moe_top_k=top_k)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def mixtral_model(size: str = "8x7b", max_seq_len: int = 2048,
+                  config: Optional[TransformerConfig] = None, **overrides) -> ModelSpec:
+    cfg = config or mixtral_config(size, max_seq_len, **overrides)
+    spec = ModelSpec(
+        init_params=lambda rng: init_transformer_params(cfg, rng),
+        loss_fn=lambda params, batch, rng: causal_lm_loss(cfg, params, batch, rng),
+        partition_rules=transformer_partition_rules(cfg),
+        apply_fn=lambda params, batch: logits_fn(
+            cfg, params, transformer_forward(
+                cfg, params, batch["input_ids"] if isinstance(batch, dict) else batch)[0]),
+        flops_per_sample=flops_per_token(cfg, max_seq_len) * max_seq_len,
+    )
+    spec.config = cfg
+    return spec

@@ -1,0 +1,355 @@
+"""Transformer model core.
+
+The reference ships no model zoo for training (users bring torch modules) but
+its inference engine implements llama/gpt/bert/mixtral families
+(``inference/v2/model_implementations``, ``module_inject/containers``).  Here
+models are first-class: a single configurable decoder/encoder core that the
+family front-ends (llama.py, gpt2.py, bert.py, mixtral.py) instantiate.
+
+TPU-first choices:
+  * layer params are STACKED on a leading [n_layers, ...] dim and executed
+    with ``lax.scan`` — one compiled block regardless of depth.
+  * attention/MLP keep everything in [B, S, H] bf16 matmuls for the MXU;
+    rotary embeddings are computed inline (fuses into the QK matmul chain).
+  * TP is a set of partition rules over the "model" mesh axis (column-
+    parallel QKV/up, row-parallel O/down — Megatron layout, the same
+    sharding AutoTP infers in the reference, module_inject/auto_tp.py:193).
+  * activation checkpointing = ``jax.checkpoint`` policy on the scanned
+    block (reference runtime/activation_checkpointing/checkpointing.py).
+  * sequence parallelism (Ulysses all-to-all / ring attention) plugs in via
+    ``attn_impl`` (see sequence/ and ops/pallas/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+MODEL_AXIS = "model"
+SEQ_AXIS = "sequence"
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None  # GQA; None => MHA
+    intermediate_size: Optional[int] = None  # None => 4x (gelu) / llama 8/3 rule
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"  # rmsnorm | layernorm
+    activation: str = "swiglu"  # swiglu | gelu
+    position: str = "rope"  # rope | learned | none
+    causal: bool = True
+    tie_embeddings: bool = False
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    use_bias: bool = False  # gpt2/bert style proj biases
+    dtype: Any = jnp.float32  # params storage dtype at init (engine recasts)
+    remat: bool = False
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"  # auto | xla | flash | ulysses | ring
+    scan_layers: bool = True
+    # MoE (mixtral-style: every layer's MLP is replaced when num_experts > 0)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.n_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.intermediate_size:
+            return self.intermediate_size
+        if self.activation == "swiglu":
+            # llama 8/3 rule rounded to 256
+            return ((int(self.hidden_size * 8 / 3) + 255) // 256) * 256
+        return 4 * self.hidden_size
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
+    H, L = cfg.hidden_size, cfg.n_layers
+    D, NH, KVH = cfg.head_dim, cfg.n_heads, cfg.kv_heads
+    F, V = cfg.ffn_size, cfg.vocab_size
+    keys = jax.random.split(rng, 12)
+    dt = cfg.dtype
+    std = 0.02
+
+    def nrm(k, *shape, s=std):
+        return (jax.random.normal(k, shape) * s).astype(dt)
+
+    p: Dict[str, Any] = {
+        "embed": {"tok": nrm(keys[0], V, H)},
+        "final_norm": {"scale": jnp.ones((H,), dt)},
+    }
+    if cfg.norm == "layernorm":
+        p["final_norm"]["bias"] = jnp.zeros((H,), dt)
+    if cfg.position == "learned":
+        p["embed"]["pos"] = nrm(keys[1], cfg.max_seq_len, H)
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"w": nrm(keys[2], H, V)}
+
+    proj_out_std = std / math.sqrt(2 * L)
+    layers = {
+        "attn": {
+            "wq": nrm(keys[3], L, H, NH * D),
+            "wk": nrm(keys[4], L, H, KVH * D),
+            "wv": nrm(keys[5], L, H, KVH * D),
+            "wo": nrm(keys[6], L, NH * D, H, s=proj_out_std),
+        },
+        "mlp": {},
+        "norm1": {"scale": jnp.ones((L, H), dt)},
+        "norm2": {"scale": jnp.ones((L, H), dt)},
+    }
+    if cfg.moe_experts > 0:
+        E = cfg.moe_experts
+        layers["mlp"]["router"] = nrm(keys[7], L, H, E)
+        layers["mlp"]["w_gate"] = nrm(keys[8], L, E, H, F)
+        layers["mlp"]["w_up"] = nrm(keys[10], L, E, H, F)
+        layers["mlp"]["w_down"] = nrm(keys[9], L, E, F, H, s=proj_out_std)
+    elif cfg.activation == "swiglu":
+        layers["mlp"]["w_gate"] = nrm(keys[7], L, H, F)
+        layers["mlp"]["w_up"] = nrm(keys[8], L, H, F)
+        layers["mlp"]["w_down"] = nrm(keys[9], L, F, H, s=proj_out_std)
+    else:
+        layers["mlp"]["w_up"] = nrm(keys[8], L, H, F)
+        layers["mlp"]["w_down"] = nrm(keys[9], L, F, H, s=proj_out_std)
+    if cfg.use_bias:
+        layers["attn"]["bq"] = jnp.zeros((L, NH * D), dt)
+        layers["attn"]["bk"] = jnp.zeros((L, KVH * D), dt)
+        layers["attn"]["bv"] = jnp.zeros((L, KVH * D), dt)
+        layers["attn"]["bo"] = jnp.zeros((L, H), dt)
+        layers["mlp"]["b_up"] = jnp.zeros((L, F), dt)
+        layers["mlp"]["b_down"] = jnp.zeros((L, H), dt)
+    if cfg.norm == "layernorm":
+        layers["norm1"]["bias"] = jnp.zeros((L, H), dt)
+        layers["norm2"]["bias"] = jnp.zeros((L, H), dt)
+    p["layers"] = layers
+    return p
+
+
+# ---------------------------------------------------------------------------
+# partition rules: Megatron TP layout over the "model" axis
+# ---------------------------------------------------------------------------
+def transformer_partition_rules(cfg: TransformerConfig) -> List[Tuple[str, P]]:
+    lead = (None,)  # stacked layer dim
+    rules = [
+        (r"embed/tok", P(MODEL_AXIS, None)),  # vocab-sharded embedding
+        (r"embed/pos", P(None, None)),
+        (r"attn/w[qkv]$", P(*lead, None, MODEL_AXIS)),  # column parallel
+        (r"attn/b[qkv]$", P(*lead, MODEL_AXIS)),
+        (r"attn/wo$", P(*lead, MODEL_AXIS, None)),  # row parallel
+        (r"lm_head/w", P(None, MODEL_AXIS)),
+    ]
+    if cfg.moe_experts > 0:
+        rules += [
+            (r"mlp/router$", P(*lead, None, None)),  # gate replicated
+            (r"mlp/w_(gate|up)$", P(*lead, "expert", None, MODEL_AXIS)),
+            (r"mlp/w_down$", P(*lead, "expert", MODEL_AXIS, None)),
+        ]
+    else:
+        rules += [
+            (r"mlp/w_(gate|up)$", P(*lead, None, MODEL_AXIS)),
+            (r"mlp/b_up$", P(*lead, MODEL_AXIS)),
+            (r"mlp/w_down$", P(*lead, MODEL_AXIS, None)),
+        ]
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _norm(x, scale, bias, kind: str, eps: float):
+    xf = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        xf = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True) + eps)
+        out = xf * scale.astype(jnp.float32)
+    else:
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.var(xf, -1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _rope(x, theta: float, positions):
+    """Rotary embedding on [..., S, NH, D]."""
+    d = x.shape[-1]
+    freqs = jnp.exp(-jnp.arange(0, d, 2, dtype=jnp.float32) / d * math.log(theta))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,S,1,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def xla_attention(q, k, v, causal: bool, mask=None):
+    """Plain attention in XLA: [B, S, NH, D].  fp32 softmax."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) / math.sqrt(d)
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        cmask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(cmask, scores, -1e30)
+    if mask is not None:  # [B, S_k] padding mask, 1 = keep
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+def _repeat_kv(k, n_rep: int):
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def _pick_attn(cfg: TransformerConfig) -> Callable:
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash":
+        try:
+            from ..ops.pallas.flash_attention import flash_attention
+
+            return lambda q, k, v, causal, mask=None: flash_attention(
+                q, k, v, causal=causal, segment_mask=mask)
+        except Exception:
+            return xla_attention
+    if impl == "ulysses":
+        from ..sequence.ulysses import ulysses_attention
+
+        return ulysses_attention
+    if impl == "ring":
+        from ..sequence.ring_attention import ring_attention
+
+        return ring_attention
+    return xla_attention
+
+
+def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
+    """One transformer block, [B, S, H] -> [B, S, H]."""
+    B, S, H = x.shape
+    NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    a = layer["attn"]
+
+    h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
+    q = h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)
+    k = h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)
+    v = h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)
+    q = q.reshape(B, S, NH, D)
+    k = k.reshape(B, S, KVH, D)
+    v = v.reshape(B, S, KVH, D)
+    if cfg.position == "rope":
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+    k = _repeat_kv(k, NH // KVH)
+    v = _repeat_kv(v, NH // KVH)
+    attn = attn_fn(q, k, v, cfg.causal, mask)
+    attn = attn.reshape(B, S, NH * D)
+    x = x + (attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0))
+
+    h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"), cfg.norm, cfg.norm_eps)
+    m = layer["mlp"]
+    aux = jnp.asarray(0.0, jnp.float32)
+    if cfg.moe_experts > 0:
+        from ..moe.sharded_moe import MoEConfig, moe_ffn
+
+        moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            aux_loss_coef=cfg.moe_aux_coef)
+        h, aux = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation)
+    elif cfg.activation == "swiglu":
+        h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
+    else:
+        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
+        if cfg.use_bias:
+            h = h + m["b_down"]
+    return x + h, aux
+
+
+def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None):
+    """[B, S] int tokens -> ([B, S, H] final hidden states, aux loss)."""
+    x = params["embed"]["tok"][input_ids]
+    B, S = input_ids.shape
+    positions = jnp.broadcast_to(jnp.arange(S), (B, S))
+    if cfg.position == "learned":
+        x = x + params["embed"]["pos"][:S][None]
+    attn_fn = _pick_attn(cfg)
+
+    block = lambda x, layer: _block(cfg, x, layer, positions, mask, attn_fn)  # noqa: E731
+    if cfg.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        block = jax.checkpoint(block, policy=policy)
+
+    if cfg.scan_layers:
+        def scan_body(carry, layer):
+            y, aux = block(carry, layer)
+            return y, aux
+
+        x, auxs = jax.lax.scan(scan_body, x, params["layers"])
+        aux = jnp.sum(auxs)
+    else:
+        aux = jnp.asarray(0.0, jnp.float32)
+        for i in range(cfg.n_layers):
+            layer = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+            x, a = block(x, layer)
+            aux = aux + a
+
+    hidden = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
+                   cfg.norm, cfg.norm_eps)
+    return hidden, aux
+
+
+def logits_fn(cfg: TransformerConfig, params, hidden):
+    if cfg.tie_embeddings:
+        return hidden @ params["embed"]["tok"].T
+    return hidden @ params["lm_head"]["w"]
+
+
+def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
+    """Next-token cross entropy.  batch: dict(input_ids, optional labels,
+    optional attention_mask) or a raw [B, S] token array."""
+    if isinstance(batch, dict):
+        ids = batch["input_ids"]
+        labels = batch.get("labels", ids)
+        mask = batch.get("attention_mask")
+    else:
+        ids, labels, mask = batch, batch, None
+    hidden, aux = transformer_forward(cfg, params, ids, mask)
+    logits = logits_fn(cfg, params, hidden[:, :-1])
+    targets = labels[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0) + aux
+    return jnp.mean(nll) + aux
+
+
+def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    """6*N + attention flops per token (training fwd+bwd)."""
+    n_params = (cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
+                + cfg.n_layers * (
+                    cfg.hidden_size * cfg.head_dim * (cfg.n_heads + 2 * cfg.kv_heads)
+                    + cfg.n_heads * cfg.head_dim * cfg.hidden_size
+                    + cfg.hidden_size * cfg.ffn_size * (3 if cfg.activation == "swiglu" else 2)))
+    attn = 12 * cfg.n_layers * cfg.hidden_size * seq_len
+    return 6.0 * n_params + attn

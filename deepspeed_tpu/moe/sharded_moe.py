@@ -1,0 +1,121 @@
+"""Mixture-of-Experts: top-k gating + expert-parallel dispatch.
+
+Reference parity: ``TopKGate`` (moe/sharded_moe.py:452), top-1/2/k gating
+(:183/:290/:374) with capacity, load-balance aux loss and drop-tokens;
+``MOELayer`` einsum dispatch (:536); expert-parallel all-to-all
+(``_AllToAll``, :96).
+
+TPU-native design: dispatch is expressed as dense einsums against a
+[tokens, experts, capacity] one-hot — the same formulation the reference
+uses on GPU — and the expert dimension of the stacked expert weights is
+sharded over the "expert" mesh axis, so XLA lowers the dispatch/combine
+einsums to the expert all-to-all over ICI (no hand-written _AllToAll).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    aux_loss_coef: float = 0.01
+    z_loss_coef: float = 0.0
+    drop_tokens: bool = True
+    noisy_gate_policy: Optional[str] = None  # None | 'Jitter' | 'RSample'
+
+
+def compute_capacity(tokens: int, cfg: MoEConfig, training: bool = True) -> int:
+    factor = cfg.capacity_factor if training else cfg.eval_capacity_factor
+    cap = int(tokens * factor * cfg.top_k / cfg.num_experts)
+    return max(cap, cfg.min_capacity)
+
+
+def top_k_gating(logits: jnp.ndarray, cfg: MoEConfig, capacity: int,
+                 rng=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Compute dispatch/combine tensors.
+
+    logits: [T, E].  Returns (combine [T, E, C], dispatch_mask [T, E, C] bool,
+    aux_loss scalar).  Tokens beyond capacity are dropped (reference
+    drop_tokens=True path).
+    """
+    T, E = logits.shape
+    if cfg.noisy_gate_policy == "Jitter" and rng is not None:
+        logits = logits * jax.random.uniform(rng, logits.shape, minval=0.98, maxval=1.02)
+    elif cfg.noisy_gate_policy == "RSample" and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) / E
+
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+
+    # top-k expert indices per token
+    _, expert_idx = jax.lax.top_k(gates, cfg.top_k)  # [T, K]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, K, E]
+
+    # load-balancing aux loss (reference sharded_moe.py top2gating): uses the
+    # top-1 assignment fraction x mean gate prob
+    me = jnp.mean(gates, axis=0)  # [E]
+    ce = jnp.mean(onehot[:, 0, :], axis=0)  # fraction routed top-1
+    aux = jnp.sum(me * ce) * E * cfg.aux_loss_coef
+    if cfg.z_loss_coef > 0:
+        aux = aux + cfg.z_loss_coef * jnp.mean(
+            jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)))
+
+    # position of each (token, k) within its expert's buffer: cumulative count
+    # over tokens for that expert, k-major so k=0 assignments take priority
+    flat = onehot.transpose(1, 0, 2).reshape(cfg.top_k * T, E)  # [K*T, E]
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # slot index per assignment
+    pos = pos_flat.reshape(cfg.top_k, T, E).transpose(1, 0, 2)  # [T, K, E]
+    position = jnp.sum(pos * onehot, axis=-1)  # [T, K]
+    keep = position < capacity  # dropped beyond capacity
+
+    gate_k = jnp.take_along_axis(gates, expert_idx, axis=1)  # [T, K]
+    gate_k = gate_k * keep.astype(gates.dtype)
+    # renormalize kept top-k gates (reference normalize_gate_probabilities)
+    denom = jnp.sum(gate_k, axis=-1, keepdims=True)
+    gate_k = gate_k / jnp.maximum(denom, 1e-9)
+
+    cap_onehot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)  # [T,K,C]
+    # combine[t,e,c] = sum_k gate_k[t,k] * onehot[t,k,e] * cap_onehot[t,k,c]
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_k, onehot,
+                         cap_onehot * keep[..., None].astype(jnp.float32))
+    dispatch = combine > 0
+    return combine, dispatch, aux
+
+
+def moe_ffn(x: jnp.ndarray, gate_w: jnp.ndarray, experts: Dict[str, jnp.ndarray],
+            cfg: MoEConfig, activation: str = "swiglu", rng=None,
+            training: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE feed-forward over [B, S, H] (reference MOELayer.forward).
+
+    experts: stacked weights {w_gate/w_up: [E, H, F], w_down: [E, F, H]}
+    (w_gate only for swiglu).  Returns (out [B, S, H], aux_loss).
+    """
+    B, S, H = x.shape
+    T = B * S
+    xt = x.reshape(T, H)
+    capacity = compute_capacity(T, cfg, training)
+
+    logits = xt @ gate_w  # [T, E] — gate in fp32 for stable routing
+    combine, dispatch, aux = top_k_gating(logits, cfg, capacity, rng)
+
+    # dispatch: [E, C, H] — expert dim sharded over the "expert" mesh axis in
+    # the stacked weights drives XLA to all-to-all these buffers over ICI
+    expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    if activation == "swiglu":
+        h = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in, experts["w_gate"]))
+        h = h * jnp.einsum("ech,ehf->ecf", expert_in, experts["w_up"])
+    else:
+        h = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in, experts["w_up"]))
+    expert_out = jnp.einsum("ecf,efh->ech", h, experts["w_down"])
+
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+    return out.reshape(B, S, H), aux
