@@ -1,0 +1,290 @@
+"""Flash attention (Pallas TPU kernel, custom VJP).
+
+The TPU-native replacement for the reference's fused attention CUDA kernels
+(``csrc/transformer/*.cu`` softmax/attention path and
+``csrc/transformer/inference/csrc/softmax.cu``): blocked online-softmax
+forward that never materializes the [S, S] score matrix, and a
+recompute-based backward (dq / dk / dv kernels) using the saved
+log-sum-exp — the memory behavior that makes long sequences feasible.
+
+Layout: kernels work on [BH, S, D] (batch*heads merged); the public API
+takes [B, S, NH, D] to match models/transformer.py.  Falls back to the
+stock jax pallas kernel (``jax.experimental.pallas.ops.tpu.flash_attention``)
+via ``impl="jax"``, and runs in interpreter mode off-TPU so the same tests
+cover CPU CI.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_k, seq_k):
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, D]
+    bq, d = q.shape
+    iq = pl.program_id(1)
+    q_start = iq * bq
+
+    nk = pl.cdiv(seq_k, block_k)
+    if causal:
+        # only k blocks whose start is <= last q row
+        nk = pl.cdiv(q_start + bq, block_k)
+
+    def body(j, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k_blk.T  # [bq, bk]
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        valid = cols < seq_k  # last k block may be padded
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + p @ v_blk
+        return acc, m_new, l_new
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m0, l0))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)  # [bq, 1]
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q=None, valid_k=None):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    valid_k = valid_k if valid_k is not None else seq_k
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+    grid = (bh, pl.cdiv(seq_q, bq))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=bk, seq_k=valid_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (recompute p from q,k + lse)
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   sm_scale, causal, block_k, seq_k):
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [bq, 1]
+    delta = delta_ref[0]
+    bq, d = q.shape
+    iq = pl.program_id(1)
+    q_start = iq * bq
+    nk = pl.cdiv(q_start + bq, block_k) if causal else pl.cdiv(seq_k, block_k)
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k_blk.T) * sm_scale
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        valid = cols < seq_k
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)  # [bq, bk]
+        dp = do @ v_blk.T
+        ds = p * (dp - delta) * sm_scale
+        return dq + ds @ k_blk
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q, seq_k):
+    k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    bk, d = k_blk.shape
+    jk = pl.program_id(1)
+    k_start = jk * bk
+    k_valid_until = seq_k
+    nq = pl.cdiv(seq_q, block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]  # [bq, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = (q @ k_blk.T) * sm_scale  # [bq, bk]
+        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        # guard padded q rows (garbage q/lse) and padded k cols
+        valid = (rows < seq_q) & (cols < k_valid_until)
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dv = dv + p.T @ do
+        dp = do @ v_blk.T
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    start = 0
+    if causal:
+        # q blocks strictly before this k block contribute nothing
+        start = k_start // block_q
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
+    q, k, v, out, lse = res
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    bq = min(block_q, seq_q)
+    bk = min(block_k, seq_k)
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [BH, Sq, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=bk, seq_k=valid_k),
+        grid=(bh, pl.cdiv(seq_q, bq)),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, seq_q=valid_q, seq_k=valid_k),
+        grid=(bh, pl.cdiv(seq_k, bk)),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
+    return _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512, impl: str = "pallas"):
+    """Public API on [B, S, NH, D] (matching models/transformer.py).
+
+    ``segment_mask``: optional [B, S_k] padding mask (1 = keep); falls back
+    to the XLA path when given (masked flash variant: future work).
+    """
+    if segment_mask is not None:
+        from ...models.transformer import xla_attention
+
+        return xla_attention(q, k, v, causal, segment_mask)
+    B, Sq, NH, D = q.shape
+    Sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    if impl == "jax":  # stock kernel for comparison
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_fa)
+
+        out = jax_fa(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                     v.transpose(0, 2, 1, 3), causal=causal, sm_scale=scale)
+        return out.transpose(0, 2, 1, 3)
+
+    qh = q.transpose(0, 2, 1, 3).reshape(B * NH, Sq, D)
+    kh = k.transpose(0, 2, 1, 3).reshape(B * NH, Sk, D)
+    vh = v.transpose(0, 2, 1, 3).reshape(B * NH, Sk, D)
+    # pad to block multiples: pl.ds clamps out-of-bounds starts, which would
+    # silently mislabel columns in edge blocks; masks use the true lengths
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    pad_q = (-Sq) % bq
+    pad_k = (-Sk) % bk
+    if pad_q or pad_k:
+        qh = jnp.pad(qh, ((0, 0), (0, pad_q), (0, 0)))
+        kh = jnp.pad(kh, ((0, 0), (0, pad_k), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, pad_k), (0, 0)))
+    out = _flash_bhsd(qh, kh, vh, scale, causal, block_q, block_k, Sq, Sk)
+    out = out[:, :Sq]
+    return out.reshape(B, NH, Sq, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,57 @@
+"""Numeric parity of the Pallas flash attention vs the XLA reference
+(reference test style: tests/unit/ops numeric parity vs torch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(b=2, s=128, nh=4, d=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, s, nh, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_xla(causal):
+    q, k, v = _qkv()
+    ref = xla_attention(q, k, v, causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+def test_forward_uneven_blocks():
+    # seq not a multiple of block size exercises edge blocks
+    q, k, v = _qkv(s=96)
+    ref = xla_attention(q, k, v, True)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_xla(causal):
+    q, k, v = _qkv(b=1, s=64, nh=2, d=32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=32, block_k=32) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")
+
+
+def test_gqa_via_repeat():
+    # models repeat kv heads before calling attention; just check shape flow
+    q, k, v = _qkv(s=64)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    assert out.shape == q.shape

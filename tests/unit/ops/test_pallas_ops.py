@@ -1,0 +1,78 @@
+"""Numeric parity for fused Adam and int8 quantization kernels
+(reference tests/unit/ops/{adam,quantizer})."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.ops.pallas.fused_adam import fused_adam_update
+from deepspeed_tpu.ops.pallas.quantization import dequantize_int8, quantize_int8
+
+
+def _ref_adamw(p, g, m, v, step, lr, b1, b2, eps, wd):
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1 ** step)
+    vhat = v / (1 - b2 ** step)
+    p = p - lr * (mhat / (np.sqrt(vhat) + eps) + wd * p)
+    return p, m, v
+
+
+@pytest.mark.parametrize("n", [1000, 128 * 50])
+def test_fused_adam_matches_reference(n):
+    rng = np.random.RandomState(0)
+    p = rng.randn(n).astype(np.float32)
+    g = rng.randn(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    lr, b1, b2, eps, wd = 1e-3, 0.9, 0.999, 1e-8, 0.01
+
+    p1, m1, v1 = p, m, v
+    for step in (1, 2, 3):
+        p1, m1, v1 = _ref_adamw(p1, g, m1, v1, step, lr, b1, b2, eps, wd)
+
+    p2, m2, v2 = jnp.asarray(p), jnp.asarray(m), jnp.asarray(v)
+    for step in (1, 2, 3):
+        p2, m2, v2 = fused_adam_update(p2, jnp.asarray(g), m2, v2,
+                                       jnp.asarray(step), lr, b1, b2, eps, wd)
+    np.testing.assert_allclose(np.asarray(p2), p1, atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m2), m1, atol=1e-6, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v2), v1, atol=1e-6, rtol=1e-5)
+
+
+def test_fused_adam_plain_adam_l2_mode():
+    rng = np.random.RandomState(1)
+    n = 512
+    p = jnp.asarray(rng.randn(n).astype(np.float32))
+    g = jnp.asarray(rng.randn(n).astype(np.float32))
+    m = jnp.zeros(n)
+    v = jnp.zeros(n)
+    p2, _, _ = fused_adam_update(p, g, m, v, jnp.asarray(1), 1e-3,
+                                 weight_decay=0.01, adam_w_mode=False)
+    # L2 mode folds decay into the gradient
+    g_l2 = g + 0.01 * p
+    mm = 0.1 * g_l2
+    vv = 0.001 * g_l2 * g_l2
+    ref = p - 1e-3 * (mm / 0.1) / (jnp.sqrt(vv / 0.001) + 1e-8)
+    np.testing.assert_allclose(np.asarray(p2), np.asarray(ref), atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [1000, 4096])
+def test_int8_quant_roundtrip(n):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray((rng.randn(n) * 3).astype(np.float32))
+    q, s, orig = quantize_int8(x)
+    assert q.dtype == jnp.int8
+    out = dequantize_int8(q, s, orig)
+    # per-128-block symmetric int8: error bounded by scale/2 per element
+    err = np.abs(np.asarray(out) - np.asarray(x))
+    bound = np.repeat(np.asarray(s)[:, 0], 128)[:n] * 0.5 + 1e-6
+    assert np.all(err <= bound)
+
+
+def test_int8_quant_compresses():
+    x = jnp.ones(128 * 8, jnp.float32)
+    q, s, _ = quantize_int8(x)
+    assert q.size + 4 * s.size < x.size * 4 / 3
