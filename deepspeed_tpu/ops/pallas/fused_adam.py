@@ -34,8 +34,11 @@ def _adam_kernel(p_ref, g_ref, m_ref, v_ref, step_ref,
     m = beta1 * m + (1.0 - beta1) * g
     v = beta2 * v + (1.0 - beta2) * g * g
     if bias_correction:
-        bc1 = 1.0 - beta1 ** step
-        bc2 = 1.0 - beta2 ** step
+        # beta**step via exp/log: Mosaic has no powf legalization
+        import math
+
+        bc1 = 1.0 - jnp.exp(step * math.log(beta1))
+        bc2 = 1.0 - jnp.exp(step * math.log(beta2))
         update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
     else:
         update = m / (jnp.sqrt(v) + eps)
