@@ -1,0 +1,87 @@
+"""Ring attention (context parallelism).
+
+The reference has NO ring attention (SURVEY.md §2.3 confirms); its sequence
+scaling is Ulysses all-to-all + FPDT chunking.  On TPU, the ICI torus makes
+the ring the natural long-context strategy (scaling-book recipe), so this is
+a first-class addition: K/V blocks rotate around the "sequence" axis ring
+via ppermute while each rank's Q stays resident, merging partial attention
+with the online-softmax rule (same math as the flash kernel's inner loop).
+
+Causal correctness: block (i attends j) is masked by global chunk offsets,
+so the result equals full-sequence causal attention, at 1/sp the activation
+memory per rank and compute that overlaps the ppermute transfers.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, SEQ_AXIS, get_topology
+
+NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, scale):
+    # q: [B, Sq, NH, D], k: [B, Sk, NH, D] -> [B, NH, Sq, Sk] fp32
+    return jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) * scale
+
+
+def _ring_body(qkv, causal: bool):
+    """shard_map body: per-rank q,k,v chunks [B, S_local, NH, D]."""
+    q, k, v = qkv
+    sp = jax.lax.psum(1, SEQ_AXIS)
+    my = jax.lax.axis_index(SEQ_AXIS)
+    B, S, NH, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        src = (my - t) % sp  # global chunk index of the kv currently held
+        s = _chunk_scores(q, k_cur, scale)  # [B, NH, S, S]
+        if causal:
+            rows = my * S + jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+            cols = src * S + jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+            s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [B, NH, S, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bnst,btnd->bsnd", p, v_cur.astype(jnp.float32))
+        acc = acc * jnp.moveaxis(alpha, 1, 2) + pv
+        k_nxt = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
+        v_nxt = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        return acc, m_new, l_new, k_nxt, v_nxt
+
+    acc0 = jnp.zeros((B, S, NH, D), jnp.float32)
+    m0 = jnp.full((B, NH, S, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, NH, S, 1), jnp.float32)
+    acc, m, l, _, _ = jax.lax.fori_loop(0, sp, step, (acc0, m0, l0, k, v))
+    l = jnp.maximum(jnp.moveaxis(l, 1, 2), 1e-30)  # [B, S, NH, 1]
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal: bool = True, mask=None):
+    """Drop-in ``attn_fn`` ([B, S, NH, D] global); seq dim sharded over the
+    "sequence" axis ring."""
+    topo = get_topology()
+    if topo.seq_parallel_size <= 1:
+        from ..models.transformer import xla_attention
+
+        return xla_attention(q, k, v, causal, mask)
+    if mask is not None:
+        raise NotImplementedError("ring attention with padding masks: use "
+                                  "ulysses or pad to full blocks")
+    spec = P(BATCH_AXES, SEQ_AXIS, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_body, causal=causal),
+        mesh=topo.mesh, in_specs=(spec,), out_specs=spec, check_vma=False)
+    return fn((q, k, v))

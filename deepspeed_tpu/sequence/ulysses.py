@@ -1,0 +1,55 @@
+"""Ulysses sequence parallelism.
+
+Reference: ``DistributedAttention`` (deepspeed/sequence/layer.py:331) —
+all-to-all scatters the sequence dim and gathers the head dim before
+attention, then the inverse after, so each rank runs full-sequence attention
+on a subset of heads.
+
+TPU-native: the two all-to-alls are *sharding constraints*.  Activations
+arrive sequence-sharded (P(batch, "sequence", heads, d)); constraining q/k/v
+to P(batch, None, "sequence", d) makes XLA emit exactly the head-scatter /
+seq-gather all-to-all over ICI, and the output constraint restores
+seq-sharding.  Requires n_heads % sequence_parallel_size == 0 (the even-head
+case of the reference; uneven heads fall back to replicated attention).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, SEQ_AXIS, get_topology
+
+
+def _constrain(x, spec):
+    topo = get_topology()
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(topo.mesh, spec))
+
+
+def ulysses_attention(q, k, v, causal: bool = True, mask=None, inner=None):
+    """Drop-in ``attn_fn`` for models/transformer.py ([B, S, NH, D])."""
+    topo = get_topology()
+    sp = topo.seq_parallel_size
+    nh = q.shape[2]
+    if inner is None:
+        from ..models.transformer import xla_attention
+
+        try:
+            from ..ops.pallas.flash_attention import flash_attention
+
+            inner = (lambda q, k, v, causal, mask=None:
+                     flash_attention(q, k, v, causal=causal, segment_mask=mask)) \
+                if jax.default_backend() == "tpu" else xla_attention
+        except Exception:
+            inner = xla_attention
+    if sp <= 1 or nh % sp != 0:
+        return inner(q, k, v, causal, mask)
+
+    seq_spec = P(BATCH_AXES, SEQ_AXIS, None, None)
+    head_spec = P(BATCH_AXES, None, SEQ_AXIS, None)
+    # all-to-all #1: seq-sharded -> head-sharded (full sequence per rank)
+    q, k, v = (_constrain(t, head_spec) for t in (q, k, v))
+    out = inner(q, k, v, causal, mask)
+    # all-to-all #2: back to seq-sharded
+    return _constrain(out, seq_spec)
