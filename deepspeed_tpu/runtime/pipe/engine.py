@@ -1,0 +1,180 @@
+"""Pipeline parallelism.
+
+Reference: ``PipelineModule`` partitions a LayerSpec list across stages and
+``PipelineEngine`` executes a 1F1B instruction schedule with p2p send/recv
+(``runtime/pipe/engine.py:60``, ``schedule.py:189``, ``p2p.py``).
+
+TPU-native design: the pipeline is ONE SPMD program.  Layer parameters are
+stacked [L, ...] with the leading dim sharded over the "pipe" mesh axis
+(each stage holds L/P layers); a ``shard_map`` body runs the classic
+pipelined loop — at step t every stage applies its layers to its current
+micro-batch activation and ``ppermute``s the result to the next stage.
+``lax.scan`` over the T = M + P - 1 steps makes the whole schedule
+differentiable: the backward pass is the reversed pipeline (the 1F1B
+backward wave), with per-stage remat bounding activation memory.
+
+Composition: pairs with DP (batch dim sharded over data axes inside the
+same shard_map) and ZeRO-1 optimizer sharding outside — the same pairing
+the reference uses (bf16+ZeRO-1 with PP, runtime/bf16_optimizer.py).
+Embedding / final-norm / LM-head weights are replicated across pipe and
+applied at the boundary stages.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...models.transformer import (TransformerConfig, _block, _norm,
+                                   _pick_attn, init_transformer_params,
+                                   transformer_partition_rules)
+from ...parallel.mesh import BATCH_AXES, PIPE_AXIS, get_topology
+from ...runtime.module import ModelSpec
+
+
+def pipeline_partition_rules(cfg: TransformerConfig):
+    """Transformer rules with the stacked-layer dim sharded over 'pipe'."""
+    rules = []
+    for pattern, spec in transformer_partition_rules(cfg):
+        entries = list(spec)
+        if pattern.startswith(r"mlp/") or pattern.startswith(r"attn/") or \
+                "norm1" in pattern or "norm2" in pattern:
+            entries[0] = PIPE_AXIS
+        if pattern.startswith("layers/"):
+            entries[0] = PIPE_AXIS
+        rules.append((pattern, P(*entries)))
+    # norms inside layers aren't in the base rules (they default replicated);
+    # add explicit pipe-sharded rules for every stacked layer tensor
+    rules.insert(0, (r"layers/.*norm", P(PIPE_AXIS, None)))
+    rules.insert(0, (r"layers/attn/b[qkvo]$", P(PIPE_AXIS, None)))
+    rules.insert(0, (r"layers/mlp/b_(up|down)$", P(PIPE_AXIS, None)))
+    out = []
+    for pattern, spec in rules:
+        if pattern.startswith(("attn/", "mlp/")):
+            pattern = "layers/" + pattern
+        out.append((pattern, spec))
+    return out
+
+
+def _stage_apply(cfg: TransformerConfig, local_layers, x, positions, attn_fn):
+    """Apply this stage's L/P layers (inner scan)."""
+
+    def body(carry, layer):
+        y, _aux = _block(cfg, carry, layer, positions, None, attn_fn)
+        return y, _aux
+
+    block = jax.checkpoint(body) if cfg.remat else body
+    x, auxs = jax.lax.scan(block, x, local_layers)
+    return x, jnp.sum(auxs)
+
+
+def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
+               pp: int):
+    """shard_map body.  ids/labels: local [b, S] batch shard; params: local
+    slices (layers: [L/pp, ...], embed/head: replicated)."""
+    stage = jax.lax.axis_index(PIPE_AXIS)
+    attn_fn = _pick_attn(cfg)
+    M, T = num_micro, num_micro + pp - 1
+    b = ids.shape[0] // M
+    S = ids.shape[1]
+    mb_ids = ids.reshape(M, b, S)
+    mb_labels = labels.reshape(M, b, S)
+    positions = jnp.broadcast_to(jnp.arange(S), (b, S))
+
+    def embed(tok_ids):
+        x = params["embed"]["tok"][tok_ids]
+        if cfg.position == "learned":
+            x = x + params["embed"]["pos"][:S][None]
+        return x
+
+    def head_loss(x, tok_labels):
+        h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
+                  cfg.norm, cfg.norm_eps)
+        if cfg.tie_embeddings:
+            logits = h @ params["embed"]["tok"].T
+        else:
+            logits = h @ params["lm_head"]["w"]
+        logits = logits[:, :-1]
+        targets = tok_labels[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def step(carry, t):
+        buf, loss_acc, aux_acc = carry
+        # stage 0 injects micro-batch t (clamped; masked once t >= M)
+        inject = embed(mb_ids[jnp.minimum(t, M - 1)])
+        x = jnp.where(stage == 0, inject, buf)
+        x, aux = _stage_apply(cfg, params["layers"], x, positions, attn_fn)
+        # last stage consumes output of micro-batch t - (pp - 1)
+        mb_out = t - (pp - 1)
+        valid = jnp.logical_and(stage == pp - 1, mb_out >= 0)
+        loss_t = head_loss(x, mb_labels[jnp.maximum(mb_out, 0)])
+        loss_acc = loss_acc + jnp.where(valid, loss_t, 0.0)
+        aux_acc = aux_acc + jnp.where(stage == pp - 1, aux, 0.0)
+        buf = jax.lax.ppermute(x, PIPE_AXIS, perm)
+        return (buf, loss_acc, aux_acc), None
+
+    H = cfg.hidden_size
+    buf0 = jnp.zeros((b, S, H), params["embed"]["tok"].dtype)
+    (buf, loss, aux), _ = jax.lax.scan(
+        step, (buf0, jnp.asarray(0.0, jnp.float32), jnp.asarray(0.0, jnp.float32)),
+        jnp.arange(T))
+    # only the last stage holds the loss; share it across the pipe ring
+    loss = jax.lax.psum(loss, PIPE_AXIS) / M
+    aux = jax.lax.psum(aux, PIPE_AXIS) / M
+    # average over data-parallel batch shards
+    for ax in BATCH_AXES:
+        loss = jax.lax.pmean(loss, ax)
+        aux = jax.lax.pmean(aux, ax)
+    return loss + aux
+
+
+def pipelined_causal_lm(cfg: TransformerConfig, num_microbatches: int = 4,
+                        name: str = "pipelined-lm") -> ModelSpec:
+    """Build a ModelSpec whose loss_fn runs the full pipeline schedule.
+
+    The engine uses it like any model; ``gradient_accumulation`` inside the
+    pipeline = ``num_microbatches`` (set engine gas=1).
+    """
+    rules = pipeline_partition_rules(cfg)
+
+    def loss_fn(params, batch, rng):
+        topo = get_topology()
+        pp = topo.pipe_parallel_size
+        if isinstance(batch, dict):
+            ids = batch["input_ids"]
+            labels = batch.get("labels", ids)
+        else:
+            ids, labels = batch, batch
+        if pp == 1:
+            from ...models.transformer import causal_lm_loss
+
+            return causal_lm_loss(cfg, params, batch, rng)
+
+        from ...runtime.zero.strategy import ZeroShardingPlan
+
+        plan = ZeroShardingPlan(topo, None, rules)
+        param_specs = plan.tree_specs(params, "param")
+        body = functools.partial(_pipe_body, cfg=cfg, num_micro=num_microbatches,
+                                 pp=pp)
+        fn = jax.shard_map(
+            body, mesh=topo.mesh,
+            in_specs=(param_specs, P(BATCH_AXES, None), P(BATCH_AXES, None)),
+            out_specs=P(), check_vma=False)
+        return fn(params, ids, labels)
+
+    spec = ModelSpec(
+        init_params=lambda rng: init_transformer_params(cfg, rng),
+        loss_fn=loss_fn,
+        partition_rules=rules,
+    )
+    spec.config = cfg
+    spec.num_microbatches = num_microbatches
+    return spec

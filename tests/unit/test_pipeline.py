@@ -1,0 +1,95 @@
+"""Pipeline engine tests (reference tests/unit/pipe/).
+
+The key correctness property: the pipelined loss/gradients equal the
+non-pipelined model's (same params, same data), because the pipeline is
+just an execution schedule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.llama import llama_config
+from deepspeed_tpu.models.transformer import causal_lm_loss
+from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+from deepspeed_tpu.runtime.pipe.engine import pipelined_causal_lm
+
+SEQ = 16
+VOCAB = 64
+
+
+def _cfg():
+    return llama_config("tiny", max_seq_len=SEQ, vocab_size=VOCAB,
+                        n_layers=4, attn_impl="xla")
+
+
+def _ids(m=4, b=2, seed=0):
+    return np.random.RandomState(seed).randint(0, VOCAB, (m * b, SEQ)).astype(np.int32)
+
+
+def test_pipeline_loss_matches_dense(devices8):
+    initialize_topology(MeshConfig(pipe=4, data=-1), jax.devices()[:8])
+    cfg = _cfg()
+    model = pipelined_causal_lm(cfg, num_microbatches=4)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jnp.asarray(_ids())
+
+    with deepspeed_tpu.get_topology().mesh:
+        pipe_loss = jax.jit(model.loss_fn)(params, {"input_ids": ids}, None)
+    dense_loss = causal_lm_loss(cfg, params, {"input_ids": ids}, None)
+    np.testing.assert_allclose(float(pipe_loss), float(dense_loss), rtol=1e-5)
+
+
+def test_pipeline_grads_match_dense(devices8):
+    initialize_topology(MeshConfig(pipe=4, data=-1), jax.devices()[:8])
+    cfg = _cfg()
+    model = pipelined_causal_lm(cfg, num_microbatches=2)
+    params = model.init_params(jax.random.PRNGKey(1))
+    ids = jnp.asarray(_ids(m=2))
+
+    with deepspeed_tpu.get_topology().mesh:
+        g_pipe = jax.jit(jax.grad(
+            lambda p: model.loss_fn(p, {"input_ids": ids}, None)))(params)
+    g_dense = jax.grad(
+        lambda p: causal_lm_loss(cfg, p, {"input_ids": ids}, None))(params)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+    flat_d, _ = jax.tree_util.tree_flatten_with_path(g_dense)
+    for (kp, a), (_, b) in zip(flat_p, flat_d):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=2e-3,
+            err_msg=jax.tree_util.keystr(kp))
+
+
+def test_pipeline_trains_end_to_end(devices8):
+    initialize_topology(MeshConfig(pipe=2, data=-1), jax.devices()[:8])
+    cfg = _cfg()
+    model = pipelined_causal_lm(cfg, num_microbatches=2)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"pipe": 2, "data": -1}},
+        topology=deepspeed_tpu.get_topology())
+    # global batch per step: micro_bs(2) * dp(4) * num_micro... engine sees
+    # [1, dp*micro, seq]; pipeline splits micro dim internally
+    ids = _ids(m=2, b=4, seed=3).reshape(1, 8, SEQ)
+    losses = [float(engine.train_batch({"input_ids": jnp.asarray(ids)}))
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_param_sharded_over_pipe(devices8):
+    initialize_topology(MeshConfig(pipe=4, data=-1), jax.devices()[:8])
+    cfg = _cfg()
+    model = pipelined_causal_lm(cfg, num_microbatches=2)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": {"pipe": 4, "data": -1}},
+        topology=deepspeed_tpu.get_topology())
+    wq = engine.state.params["layers"]["attn"]["wq"]
+    assert wq.sharding.spec[0] == "pipe"
