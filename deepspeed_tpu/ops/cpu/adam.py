@@ -1,0 +1,85 @@
+"""Host-offloaded Adam (ZeRO-Offload equivalent).
+
+Reference: ``DeepSpeedCPUAdam`` (deepspeed/ops/adam/cpu_adam.py) over the
+AVX kernel (csrc/adam/cpu_adam_impl.cpp).  Keeps fp32 master params +
+moments in host RAM as numpy arrays; each boundary receives device grads,
+runs the SIMD C++ step, and returns updated (optionally bf16) params for
+transfer back to HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 bias_correction: bool = True):
+        self.lib = CPUAdamBuilder().load()
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+
+    def _state_for(self, key: int, n: int):
+        if key not in self._m:
+            self._m[key] = np.zeros(n, np.float32)
+            self._v[key] = np.zeros(n, np.float32)
+        return self._m[key], self._v[key]
+
+    def step(self, params: np.ndarray, grads: np.ndarray, key: int = 0,
+             lr: Optional[float] = None) -> np.ndarray:
+        """In-place Adam step on a contiguous fp32 shard; returns params."""
+        assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
+        grads = np.ascontiguousarray(grads, np.float32)
+        m, v = self._state_for(key, params.size)
+        self.step_count += 1
+        rc = self.lib.dstpu_adam_step(
+            params.ctypes.data, grads.ctypes.data, m.ctypes.data, v.ctypes.data,
+            params.size, self.step_count, np.float32(lr or self.lr),
+            np.float32(self.beta1), np.float32(self.beta2), np.float32(self.eps),
+            np.float32(self.weight_decay), int(self.adamw_mode),
+            int(self.bias_correction))
+        if rc != 0:
+            raise RuntimeError(f"cpu adam step failed rc={rc}")
+        return params
+
+    def step_bf16_grads(self, params: np.ndarray, grads_bf16: np.ndarray,
+                        key: int = 0, lr: Optional[float] = None) -> np.ndarray:
+        """Adam step with bf16 grads (uint16 view); returns bf16 param copy
+        (uint16 view) for the device transfer, master stays fp32."""
+        assert params.dtype == np.float32
+        g = np.ascontiguousarray(grads_bf16.view(np.uint16))
+        m, v = self._state_for(key, params.size)
+        out_bf16 = np.empty(params.size, np.uint16)
+        self.step_count += 1
+        rc = self.lib.dstpu_adam_step_bf16g(
+            params.ctypes.data, g.ctypes.data, m.ctypes.data, v.ctypes.data,
+            out_bf16.ctypes.data, params.size, self.step_count,
+            np.float32(lr or self.lr), np.float32(self.beta1),
+            np.float32(self.beta2), np.float32(self.eps),
+            np.float32(self.weight_decay), int(self.adamw_mode),
+            int(self.bias_correction))
+        if rc != 0:
+            raise RuntimeError(f"cpu adam step failed rc={rc}")
+        return out_bf16
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"step": self.step_count,
+                "m": {k: v.copy() for k, v in self._m.items()},
+                "v": {k: v.copy() for k, v in self._v.items()}}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.step_count = sd["step"]
+        self._m = {k: np.asarray(v) for k, v in sd["m"].items()}
+        self._v = {k: np.asarray(v) for k, v in sd["v"].items()}

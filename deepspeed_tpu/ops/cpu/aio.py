@@ -1,0 +1,70 @@
+"""Async IO handle (DeepNVMe-equivalent Python surface).
+
+Reference: ``AsyncIOBuilder`` ops (csrc/aio/py_lib/deepspeed_aio_thread.cpp,
+``deepspeed.ops.op_builder.AsyncIOBuilder``): submit pread/pwrite of host
+buffers against NVMe-backed files, overlap with compute, drain for
+completion.  Backs swap-tensor (ZeRO-Infinity) and the fast checkpoint
+writer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    def __init__(self, thread_count: int = 4, block_size: int = 1 << 20,
+                 use_odirect: bool = False):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.dstpu_aio_create(thread_count, block_size,
+                                             int(use_odirect))
+        self._bufs = {}  # op id -> buffer keep-alive
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.dstpu_aio_destroy(self._h)
+        except Exception:
+            pass
+
+    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> int:
+        buf = np.ascontiguousarray(array)
+        op = self._lib.dstpu_aio_pwrite(self._h, os.fspath(path).encode(),
+                                        buf.ctypes.data, buf.nbytes, offset)
+        self._bufs[op] = buf
+        return op
+
+    def async_pread(self, array: np.ndarray, path: str, offset: int = 0) -> int:
+        assert array.flags["C_CONTIGUOUS"]
+        op = self._lib.dstpu_aio_pread(self._h, os.fspath(path).encode(),
+                                       array.ctypes.data, array.nbytes, offset)
+        self._bufs[op] = array
+        return op
+
+    def drain(self) -> None:
+        """Block until all submitted ops complete; raises on IO errors."""
+        errs = self._lib.dstpu_aio_drain(self._h)
+        self._bufs.clear()
+        if errs:
+            raise IOError(f"aio: {errs} operations failed")
+
+    # reference API names
+    wait = drain
+
+    def pending(self) -> int:
+        return self._lib.dstpu_aio_pending(self._h)
+
+
+_DEFAULT: Optional[AsyncIOHandle] = None
+
+
+def default_aio_handle(**kw) -> AsyncIOHandle:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = AsyncIOHandle(**kw)
+    return _DEFAULT

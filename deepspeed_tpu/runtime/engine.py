@@ -134,6 +134,22 @@ class DeepSpeedTPUEngine:
 
             self.flops_profiler = FlopsProfiler(self, config.flops_profiler)
 
+        # optimizer-state host offload (ZeRO-Offload / -Infinity)
+        self.offload_optimizer = None
+        off_cfg = config.zero_config.offload_optimizer
+        if off_cfg.enabled:
+            if self.fp16_enabled:
+                raise NotImplementedError("offload_optimizer with fp16 loss "
+                                          "scaling is not supported; use bf16")
+            from .zero.offload import HostOffloadedOptimizer
+
+            self.offload_optimizer = HostOffloadedOptimizer(
+                abstract_params=None,  # set in _init_state
+                optimizer_config={"type": config.optimizer.type,
+                                  "params": config.optimizer.params},
+                grad_clip=config.gradient_clipping,
+                nvme_path=(off_cfg.nvme_path if off_cfg.device == "nvme" else None))
+
         self.training_dataloader = None
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
@@ -161,15 +177,32 @@ class DeepSpeedTPUEngine:
         abstract = jax.eval_shape(self.model.init_params, init_rng)
         param_shardings = self.zero_plan.tree_shardings(abstract, "master")
 
-        init_fn = jax.jit(
-            lambda rng: cast_tree(self.model.init_params(rng), jnp.float32),
-            out_shardings=param_shardings)
-        with self.topology.mesh:
-            params = init_fn(init_rng)
+        if self.offload_optimizer is not None:
+            # compute-dtype params on device; fp32 master + moments on host
+            compute_shardings = self.zero_plan.tree_shardings(abstract, "param")
+            init_fn = jax.jit(
+                lambda rng: cast_tree(self.model.init_params(rng), jnp.float32),
+                out_shardings=param_shardings)
+            with self.topology.mesh:
+                master = init_fn(init_rng)
+            self.offload_optimizer.leaves, self.offload_optimizer.treedef = \
+                jax.tree_util.tree_flatten(jax.eval_shape(lambda: master))
+            self.offload_optimizer.initialize_master(master)
+            with self.topology.mesh:
+                params = jax.jit(lambda p: cast_tree(p, self.compute_dtype),
+                                 out_shardings=compute_shardings)(master)
+            del master
+            opt_state = ()
+        else:
+            init_fn = jax.jit(
+                lambda rng: cast_tree(self.model.init_params(rng), jnp.float32),
+                out_shardings=param_shardings)
+            with self.topology.mesh:
+                params = init_fn(init_rng)
 
-        opt_state = jax.jit(
-            self.optimizer.init,
-            out_shardings=None)(params)  # moments inherit param shardings via XLA
+            opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=None)(params)  # moments inherit param shardings via XLA
         grad_acc = jax.jit(
             lambda p: jax.tree_util.tree_map(
                 lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), p),
@@ -272,6 +305,11 @@ class DeepSpeedTPUEngine:
     def _train_batch_body(self, state: TrainState, batches, rng) -> Tuple[TrainState, jnp.ndarray]:
         """Fused full step: scan micro-batches then apply.  ``batches`` has a
         leading gradient-accumulation dim."""
+        state, loss = self._micro_scan_body(state, batches, rng)
+        state = self._apply_step_body(state)
+        return state, loss
+
+    def _micro_scan_body(self, state: TrainState, batches, rng):
         gas = self.config.gradient_accumulation_steps or 1
         rngs = jax.random.split(rng, gas)
 
@@ -281,15 +319,49 @@ class DeepSpeedTPUEngine:
             return st, loss
 
         state, losses = jax.lax.scan(body, state, (batches, rngs))
-        state = self._apply_step_body(state)
         return state, jnp.mean(losses)
 
     def _compile_steps(self) -> None:
         donate = dict(donate_argnums=(0,))
         self._micro_step = jax.jit(self._micro_step_body, **donate)
-        self._apply_step = jax.jit(self._apply_step_body, **donate)
-        self._train_batch = jax.jit(self._train_batch_body, **donate)
+        if self.offload_optimizer is not None:
+            # the boundary update runs on host (C++ SIMD Adam); the device
+            # program is micro-steps only
+            self._train_batch = jax.jit(self._micro_scan_body, **donate)
+            self._apply_step = None
+        else:
+            self._apply_step = jax.jit(self._apply_step_body, **donate)
+            self._train_batch = jax.jit(self._train_batch_body, **donate)
         self._eval_fn = None
+
+    # ------------------------------------------------------- offloaded step
+    def _apply_step_offload(self) -> None:
+        """Boundary update on the host: pull reduced grads, run C++ Adam on
+        the fp32 master, push compute-dtype params back (reference
+        ZeRO-Offload data path, stage3 _optimizer_step with CPU-Adam)."""
+        import dataclasses as _dc
+
+        import numpy as np
+
+        state = self.state
+        gas = float(self.config.gradient_accumulation_steps or 1)
+        lr = float(self.lr_schedule(int(state.step)))
+        grads_flat = [np.asarray(jax.device_get(g)) for g in
+                      jax.tree_util.tree_leaves(state.grad_acc)]
+        master, norm = self.offload_optimizer.apply_step(grads_flat, lr, gas)
+
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        new_leaves = []
+        for m, old in zip(master, leaves):
+            arr = jnp.asarray(m.reshape(old.shape), old.dtype)
+            new_leaves.append(jax.device_put(arr, old.sharding))
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        zero_acc = jax.tree_util.tree_map(
+            lambda g: jnp.zeros_like(g), state.grad_acc)
+        self.state = _dc.replace(
+            state, params=new_params, grad_acc=zero_acc,
+            step=state.step + 1, micro_step=jnp.asarray(0, jnp.int32),
+            global_grad_norm=jnp.asarray(norm, jnp.float32))
 
     # ------------------------------------------------------------ public API
     def _next_rng(self):
@@ -315,6 +387,8 @@ class DeepSpeedTPUEngine:
         self.tput_timer.start()
         with self.topology.mesh:
             self.state, loss = self._train_batch(self.state, batch, self._next_rng())
+        if self.offload_optimizer is not None:
+            self._apply_step_offload()
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps or 1
         # dispatch is async: drain the device queue at reporting boundaries so
@@ -361,8 +435,11 @@ class DeepSpeedTPUEngine:
         engine.py:2641)."""
         self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
-            with self.topology.mesh:
-                self.state = self._apply_step(self.state)
+            if self.offload_optimizer is not None:
+                self._apply_step_offload()
+            else:
+                with self.topology.mesh:
+                    self.state = self._apply_step(self.state)
             self.global_steps += 1
             self.lr_scheduler.step()
             if self.config.wall_clock_breakdown:
