@@ -1,0 +1,240 @@
+"""Partitioned (multi-host / ZeRO-layout) checkpointing + universal format.
+
+Reference layout (engine.py:3609): per-mp-rank model files + per-dp-rank
+ZeRO optimizer partition files; ``ds_to_universal.py`` merges them into
+per-parameter "atom" files loadable into ANY new dp/tp/pp layout
+(``deepspeed/checkpoint/universal_checkpoint.py:146``).
+
+TPU layout: every *process* writes the shards it owns for every leaf of the
+TrainState, keyed by pytree path with the global index of each shard
+(``zero_shard_rank_{proc}.npz`` + shard index json).  ``to_universal``
+assembles shard files into one full array per parameter (atom files);
+``load_partitioned`` goes straight from shard files to a differently-meshed
+engine — the resharding promise, without torch-style reshape heuristics
+because the index metadata is exact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .. import comm
+from ..utils.logging import log_dist, logger
+
+SHARD_FILE = "zero_shard_rank_{rank}.npz"
+INDEX_FILE = "shard_index_rank_{rank}.json"
+META_FILE = "partitioned_meta.json"
+
+
+def _leaf_items(state: Any):
+    flat = []
+
+    def visit(path, leaf):
+        if leaf is not None:
+            flat.append((jax.tree_util.keystr(path), leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, state)
+    return flat
+
+
+def save_partitioned(engine, save_dir: str, tag: str,
+                     client_state: Optional[dict] = None,
+                     checkpoint_engine=None) -> str:
+    """Each process writes its addressable shards (one file per process —
+    the analogue of per-dp-rank optim_states files)."""
+    from ..runtime.checkpoint_engine.engines import NumpyCheckpointEngine
+
+    ce = checkpoint_engine or NumpyCheckpointEngine()
+    rank = jax.process_index()
+    path = os.path.join(save_dir, tag)
+    os.makedirs(path, exist_ok=True)
+
+    arrays: Dict[str, np.ndarray] = {}
+    index: Dict[str, Any] = {}
+    for key, leaf in _leaf_items(engine.state):
+        entries = []
+        seen = set()
+        for shard in leaf.addressable_shards:
+            idx = shard.index  # tuple of slices into the global shape
+            norm = tuple((s.start or 0, s.stop if s.stop is not None else dim)
+                         for s, dim in zip(idx, leaf.shape)) if idx else ()
+            if norm in seen:  # replicated across devices: store once
+                continue
+            seen.add(norm)
+            skey = f"{key}::{len(entries)}"
+            data = np.asarray(shard.data)
+            if data.dtype.name == "bfloat16":
+                data = data.view(np.uint16)
+                bf16 = True
+            else:
+                bf16 = False
+            arrays[skey] = data
+            entries.append({"key": skey, "start": [s[0] for s in norm],
+                            "stop": [s[1] for s in norm], "bf16": bf16})
+        index[key] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                      "shards": entries}
+
+    ce.save(arrays, os.path.join(path, SHARD_FILE.format(rank=rank).replace(".npz", "")))
+    with open(os.path.join(path, INDEX_FILE.format(rank=rank)), "w") as f:
+        json.dump(index, f)
+    if rank == 0:
+        meta = {
+            "tag": tag, "format": "partitioned-v1",
+            "world": jax.process_count(),
+            "global_steps": engine.global_steps,
+            "micro_steps": engine.micro_steps,
+            "lr_scheduler": engine.lr_scheduler.state_dict()
+            if hasattr(engine.lr_scheduler, "state_dict") else None,
+            "client_state": client_state or {},
+            "zero_stage": engine.config.zero_config.stage,
+            "mesh": engine.topology.axis_sizes,
+        }
+        with open(os.path.join(path, META_FILE), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(tag)
+    comm.barrier("partitioned-save")
+    log_dist(f"saved partitioned checkpoint {path}")
+    return path
+
+
+def _assemble(path: str, keys: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+    """Merge all ranks' shards into full arrays keyed by pytree path."""
+    import glob
+
+    from ..runtime.checkpoint_engine.engines import NumpyCheckpointEngine
+
+    ce = NumpyCheckpointEngine()
+    full: Dict[str, np.ndarray] = {}
+    for idx_file in sorted(glob.glob(os.path.join(path, "shard_index_rank_*.json"))):
+        rank = int(os.path.basename(idx_file).split("_rank_")[1].split(".")[0])
+        with open(idx_file) as f:
+            index = json.load(f)
+        arrays = ce.load(os.path.join(path, SHARD_FILE.format(rank=rank).replace(".npz", "")))
+        for key, info in index.items():
+            if keys is not None and key not in keys:
+                continue
+            if key not in full:
+                dtype = info["dtype"]
+                np_dtype = np.uint16 if dtype == "bfloat16" else np.dtype(dtype)
+                full[key] = np.zeros(info["shape"], np_dtype)
+            for entry in info["shards"]:
+                data = arrays[entry["key"]]
+                if entry["start"]:
+                    sl = tuple(slice(a, b) for a, b in zip(entry["start"], entry["stop"]))
+                    full[key][sl] = data.reshape(full[key][sl].shape)
+                else:
+                    full[key] = data.reshape(info["shape"]) if info["shape"] else data
+    return full
+
+
+def load_partitioned(engine, load_dir: str, tag: Optional[str] = None,
+                     load_lr_scheduler_states: bool = True) -> Tuple[Optional[str], dict]:
+    """Load a partitioned checkpoint into an engine with ANY mesh/stage."""
+    import jax.numpy as jnp
+
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest):
+            logger.warning(f"no 'latest' in {load_dir}")
+            return None, {}
+        tag = open(latest).read().strip()
+    path = os.path.join(load_dir, tag)
+    with open(os.path.join(path, META_FILE)) as f:
+        meta = json.load(f)
+    full = _assemble(path)
+
+    from jax.sharding import NamedSharding
+
+    def restore(path_key, current):
+        key = jax.tree_util.keystr(path_key)
+        if key not in full:
+            logger.warning(f"partitioned ckpt missing {key}; keeping current")
+            return current
+        arr = full[key]
+        if str(current.dtype) == "bfloat16" and arr.dtype == np.uint16:
+            arr = arr.view(jnp.bfloat16)
+        sh = current.sharding if isinstance(current.sharding, NamedSharding) \
+            else engine.topology.replicated()
+        return jax.device_put(
+            jnp.asarray(arr, current.dtype).reshape(current.shape), sh)
+
+    engine.state = jax.tree_util.tree_map_with_path(restore, engine.state)
+    engine.global_steps = meta["global_steps"]
+    engine.micro_steps = meta.get("micro_steps", 0)
+    if load_lr_scheduler_states and meta.get("lr_scheduler") and \
+            hasattr(engine.lr_scheduler, "load_state_dict"):
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    log_dist(f"loaded partitioned checkpoint {path}")
+    return path, meta.get("client_state", {})
+
+
+# --------------------------------------------------------------------------
+# universal checkpoint (atom files) + fp32 export
+# --------------------------------------------------------------------------
+def to_universal(ckpt_dir: str, tag: str, out_dir: str) -> str:
+    """Merge a partitioned checkpoint into per-parameter atom files
+    (reference ds_to_universal.py)."""
+    path = os.path.join(ckpt_dir, tag)
+    full = _assemble(path)
+    os.makedirs(out_dir, exist_ok=True)
+    atoms = {}
+    for key, arr in full.items():
+        fname = key.strip("[]'").replace("']['", "__").replace("/", "_") + ".npy"
+        np.save(os.path.join(out_dir, fname), arr)
+        atoms[key] = fname
+    with open(os.path.join(path, META_FILE)) as f:
+        meta = json.load(f)
+    meta["format"] = "universal-v1"
+    meta["atoms"] = atoms
+    with open(os.path.join(out_dir, "universal_meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    return out_dir
+
+
+def load_universal(engine, universal_dir: str) -> None:
+    """Load atom files into any engine layout (reference --load_universal)."""
+    import jax.numpy as jnp
+
+    with open(os.path.join(universal_dir, "universal_meta.json")) as f:
+        meta = json.load(f)
+    atoms = meta["atoms"]
+
+    def restore(path_key, current):
+        key = jax.tree_util.keystr(path_key)
+        if key not in atoms:
+            return current
+        arr = np.load(os.path.join(universal_dir, atoms[key]))
+        if str(current.dtype) == "bfloat16" and arr.dtype == np.uint16:
+            arr = arr.view(jnp.bfloat16)
+        from jax.sharding import NamedSharding
+
+        sh = current.sharding if isinstance(current.sharding, NamedSharding) \
+            else engine.topology.replicated()
+        return jax.device_put(jnp.asarray(arr, current.dtype).reshape(current.shape), sh)
+
+    engine.state = jax.tree_util.tree_map_with_path(restore, engine.state)
+    engine.global_steps = meta["global_steps"]
+
+
+def zero_to_fp32(ckpt_dir: str, tag: str, output_file: str) -> str:
+    """Export consolidated fp32 model params from a partitioned checkpoint
+    (reference utils/zero_to_fp32.py)."""
+    path = os.path.join(ckpt_dir, tag)
+    full = _assemble(path)
+    params = {}
+    for key, arr in full.items():
+        if ".params" in key or key.startswith("['params']") or "params" in key.split("']")[0]:
+            if arr.dtype == np.uint16:  # stored bf16
+                import jax.numpy as jnp
+
+                arr = np.asarray(arr.view(jnp.bfloat16), np.float32)
+            params[key] = np.asarray(arr, np.float32)
+    np.savez(output_file, **params)
+    return output_file

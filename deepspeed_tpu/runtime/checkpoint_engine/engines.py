@@ -1,0 +1,130 @@
+"""Pluggable checkpoint engines.
+
+Reference: ``runtime/checkpoint_engine/checkpoint_engine.py`` with torch
+(sync), fast (AIO writer), decoupled (async background commit), nebula,
+datastates variants.  Here:
+
+  * ``NumpyCheckpointEngine`` — synchronous .npz writer (torch-equivalent).
+  * ``FastCheckpointEngine``  — raw per-array writes through the C++ AIO
+    engine (deepspeed/io fast_file_writer role).
+  * ``DecoupledCheckpointEngine`` — hands the save to a background thread;
+    ``commit()`` joins at the next boundary (reference
+    decoupled_checkpoint_engine.py semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+    def save(self, arrays: Dict[str, np.ndarray], path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+
+class NumpyCheckpointEngine(CheckpointEngine):
+    def save(self, arrays, path):
+        np.savez(path, **arrays)
+
+    def load(self, path):
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path)
+        return {k: data[k] for k in data.files}
+
+
+class FastCheckpointEngine(CheckpointEngine):
+    """Raw binary per-tensor files + a json manifest, written through the
+    AIO thread pool so large checkpoints overlap serialization with disk."""
+
+    def __init__(self, thread_count: int = 4, block_size: int = 1 << 22):
+        from ...ops.cpu.aio import AsyncIOHandle
+
+        self.aio = AsyncIOHandle(thread_count=thread_count, block_size=block_size)
+
+    def save(self, arrays, path):
+        os.makedirs(path, exist_ok=True)
+        manifest = {}
+        for i, (key, arr) in enumerate(arrays.items()):
+            arr = np.ascontiguousarray(arr)
+            fname = f"t{i:05d}.bin"
+            manifest[key] = {"file": fname, "dtype": str(arr.dtype),
+                             "shape": list(arr.shape)}
+            self.aio.async_pwrite(arr, os.path.join(path, fname))
+        self.aio.drain()
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+
+    def load(self, path):
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        out = {}
+        arrs = []
+        for key, info in manifest.items():
+            arr = np.empty(info["shape"], np.dtype(info["dtype"]))
+            self.aio.async_pread(arr.reshape(-1).view(np.uint8)
+                                 if arr.size else arr, os.path.join(path, info["file"]))
+            arrs.append((key, arr))
+        self.aio.drain()
+        for key, arr in arrs:
+            out[key] = arr
+        return out
+
+
+class DecoupledCheckpointEngine(CheckpointEngine):
+    """Async save: snapshot is taken synchronously (host copies), the write
+    happens on a background thread; ``commit`` blocks until durable."""
+
+    def __init__(self, inner: Optional[CheckpointEngine] = None):
+        self.inner = inner or NumpyCheckpointEngine()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, arrays, path):
+        self.commit("previous")  # one in flight at a time
+        snapshot = {k: np.array(v, copy=True) for k, v in arrays.items()}
+
+        def _run():
+            try:
+                self.inner.save(snapshot, path)
+            except BaseException as e:  # surfaced at commit
+                self._error = e
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def load(self, path):
+        self.commit("pre-load")
+        return self.inner.load(path)
+
+    def commit(self, tag: str) -> bool:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+        return True
+
+
+def make_checkpoint_engine(config) -> CheckpointEngine:
+    """From the ``checkpoint`` config block."""
+    if getattr(config.checkpoint, "async_save", False):
+        return DecoupledCheckpointEngine()
+    if getattr(config.checkpoint, "parallel_write_pipeline", False):
+        return FastCheckpointEngine(thread_count=config.aio.thread_count,
+                                    block_size=config.aio.block_size)
+    return NumpyCheckpointEngine()

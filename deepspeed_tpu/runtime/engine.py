@@ -511,14 +511,37 @@ class DeepSpeedTPUEngine:
 
     # -------------------------------------------------------------- ckpt API
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
-                        client_state: Optional[dict] = None, **kw):
+                        client_state: Optional[dict] = None,
+                        partitioned: Optional[bool] = None, **kw):
+        """Partitioned layout (per-process shard files, reference per-rank
+        zero partition files) when multi-host or requested; simple
+        consolidated layout otherwise."""
+        tag = tag or f"global_step{self.global_steps}"
+        if partitioned is None:
+            partitioned = jax.process_count() > 1
+        if partitioned:
+            from ..checkpoint.partitioned import save_partitioned
+            from .checkpoint_engine.engines import make_checkpoint_engine
+
+            return save_partitioned(self, save_dir, tag, client_state or {},
+                                    checkpoint_engine=make_checkpoint_engine(self.config))
         from ..checkpoint.saving import save_checkpoint
 
         return save_checkpoint(self, save_dir, tag=tag, client_state=client_state or {})
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
+        import os
+
+        from ..checkpoint.partitioned import META_FILE, load_partitioned
         from ..checkpoint.saving import load_checkpoint
 
+        resolved = tag
+        if resolved is None:
+            latest = os.path.join(load_dir, "latest")
+            if os.path.exists(latest):
+                resolved = open(latest).read().strip()
+        if resolved and os.path.exists(os.path.join(load_dir, resolved, META_FILE)):
+            return load_partitioned(self, load_dir, tag=resolved)
         return load_checkpoint(self, load_dir, tag=tag)
 
     # batch-size accessors (reference engine API)

@@ -1,0 +1,135 @@
+"""Partitioned / universal checkpoint tests
+(reference tests/unit/checkpoint/: save->load->compare roundtrips incl.
+layout changes)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.partitioned import (load_universal, to_universal,
+                                                  zero_to_fp32)
+from deepspeed_tpu.runtime.checkpoint_engine.engines import (
+    DecoupledCheckpointEngine, FastCheckpointEngine, NumpyCheckpointEngine)
+from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+
+def _engine(stage=3, mesh=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+    }
+    if mesh:
+        cfg["mesh"] = mesh
+    engine, *_ = deepspeed_tpu.initialize(model=simple_mlp_spec(), config=cfg)
+    return engine
+
+
+def _params_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(np.asarray(jax.device_get(x)),
+                                                np.asarray(jax.device_get(y)),
+                                                rtol=1e-6), a, b)
+
+
+def test_partitioned_roundtrip_sharded(tmp_path, devices8):
+    e1 = _engine(stage=3)
+    for i in range(3):
+        e1.train_batch(random_batch(batch_size=8, seed=i, gas=1))
+    e1.save_checkpoint(str(tmp_path), partitioned=True)
+    files = os.listdir(tmp_path / "global_step3")
+    assert any(f.startswith("zero_shard_rank_") for f in files)
+
+    e2 = _engine(stage=3)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert e2.global_steps == 3
+    _params_equal(e1.state.params, e2.state.params)
+    e2.train_batch(random_batch(batch_size=8, gas=1))
+
+
+def test_partitioned_reshard_stage3_to_stage0(tmp_path, devices8):
+    e1 = _engine(stage=3)
+    e1.train_batch(random_batch(batch_size=8, gas=1))
+    e1.save_checkpoint(str(tmp_path), partitioned=True)
+
+    e0 = _engine(stage=0)
+    e0.load_checkpoint(str(tmp_path))
+    _params_equal(e1.state.params, e0.state.params)
+
+
+def test_partitioned_reshard_across_mesh(tmp_path, devices8):
+    e1 = _engine(stage=2, mesh={"data": 8})
+    e1.train_batch(random_batch(batch_size=8, gas=1))
+    e1.save_checkpoint(str(tmp_path), partitioned=True)
+
+    from deepspeed_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.reset_topology()
+    e2 = _engine(stage=3, mesh={"data": 4, "model": 2})
+    e2.load_checkpoint(str(tmp_path))
+    _params_equal(e1.state.params, e2.state.params)
+
+
+def test_universal_conversion_and_load(tmp_path, devices8):
+    e1 = _engine(stage=3)
+    e1.train_batch(random_batch(batch_size=8, gas=1))
+    e1.save_checkpoint(str(tmp_path / "ckpt"), partitioned=True)
+
+    out = to_universal(str(tmp_path / "ckpt"), "global_step1",
+                       str(tmp_path / "universal"))
+    assert os.path.exists(os.path.join(out, "universal_meta.json"))
+
+    e2 = _engine(stage=0)
+    load_universal(e2, out)
+    _params_equal(e1.state.params, e2.state.params)
+    assert e2.global_steps == 1
+
+
+def test_zero_to_fp32_export(tmp_path, devices8):
+    e1 = _engine(stage=3)
+    e1.train_batch(random_batch(batch_size=8, gas=1))
+    e1.save_checkpoint(str(tmp_path / "c"), partitioned=True)
+    out = zero_to_fp32(str(tmp_path / "c"), "global_step1",
+                       str(tmp_path / "fp32.npz"))
+    data = np.load(out)
+    assert any("params" in k for k in data.files)
+    w = [data[k] for k in data.files if "layer_0" in k and "/w" in k.replace("']['", "/")]
+    assert w, f"missing layer_0 w in {data.files}"
+
+
+def test_fast_checkpoint_engine_roundtrip(tmp_path):
+    ce = FastCheckpointEngine(thread_count=2)
+    arrays = {"a": np.arange(1000, dtype=np.float32).reshape(10, 100),
+              "b": np.ones(7, np.int32)}
+    ce.save(arrays, str(tmp_path / "fast"))
+    out = ce.load(str(tmp_path / "fast"))
+    np.testing.assert_array_equal(out["a"], arrays["a"])
+    np.testing.assert_array_equal(out["b"], arrays["b"])
+
+
+def test_decoupled_engine_commits_in_background(tmp_path):
+    ce = DecoupledCheckpointEngine()
+    arrays = {"x": np.random.RandomState(0).randn(256, 256).astype(np.float32)}
+    ce.save(arrays, str(tmp_path / "async_ckpt.npz"))
+    assert ce.commit("tag")
+    out = NumpyCheckpointEngine().load(str(tmp_path / "async_ckpt.npz"))
+    np.testing.assert_array_equal(out["x"], arrays["x"])
+
+
+def test_async_save_config_roundtrip(tmp_path, devices8):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "checkpoint": {"async_save": True},
+    }
+    e1, *_ = deepspeed_tpu.initialize(model=simple_mlp_spec(), config=cfg)
+    e1.train_batch(random_batch(batch_size=8, gas=1))
+    e1.save_checkpoint(str(tmp_path), partitioned=True)
+    e2, *_ = deepspeed_tpu.initialize(model=simple_mlp_spec(), config=cfg)
+    e2.load_checkpoint(str(tmp_path))
+    _params_equal(e1.state.params, e2.state.params)
