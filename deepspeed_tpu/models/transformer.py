@@ -344,6 +344,100 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
     return jnp.mean(nll) + aux
 
 
+# ---------------------------------------------------------------------------
+# KV-cache decode path (inference)
+# ---------------------------------------------------------------------------
+def init_kv_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None):
+    """[L, B, max_len, KVH, D] per k/v (reference inference KV handling,
+    csrc/transformer/inference kv path / inference/v2 blocked KV)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "length": jnp.zeros((), jnp.int32)}
+
+
+def _block_decode(cfg: TransformerConfig, x, layer, k_cache, v_cache, position):
+    """One block for one new token slice x: [B, T, H] attending to the cache
+    (which already contains this token's k/v after update).  Returns
+    (y, new_k, new_v) where new_k/new_v are this layer's updated cache."""
+    B, T, H = x.shape
+    NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    a = layer["attn"]
+
+    h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
+    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, T, NH, D)
+    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
+    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
+    positions = position[:, None] + jnp.arange(T)[None, :]
+    if cfg.position == "rope":
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+
+    # write new k/v into the cache at [position, position+T)
+    def upd(cache, new):
+        return jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype), (0, position[0], 0, 0))
+
+    k_cache = upd(k_cache, k)
+    v_cache = upd(v_cache, v)
+
+    kk = _repeat_kv(k_cache, NH // KVH)
+    vv = _repeat_kv(v_cache, NH // KVH)
+    S = kk.shape[1]
+    scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32) / math.sqrt(D)
+    # causal vs cache: token t may see cache slots <= position + t
+    limit = (position[:, None, None, None] + jnp.arange(T)[None, None, :, None])
+    slot = jnp.arange(S)[None, None, None, :]
+    scores = jnp.where(slot <= limit, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, T, NH * D)
+    x = x + (attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0))
+
+    h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"), cfg.norm, cfg.norm_eps)
+    m = layer["mlp"]
+    if cfg.moe_experts > 0:
+        from ..moe.sharded_moe import MoEConfig, moe_ffn
+
+        moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            aux_loss_coef=cfg.moe_aux_coef)
+        h, _ = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
+                       training=False)
+    elif cfg.activation == "swiglu":
+        h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
+    else:
+        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
+        if cfg.use_bias:
+            h = h + m["b_down"]
+    return x + h, k_cache, v_cache
+
+
+def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache,
+                       position):
+    """Prefill or decode: run [B, T] tokens against/into the cache starting
+    at ``position`` ([B] int32, same value per batch row for dense decode).
+    Returns (logits [B, T, V], new_cache)."""
+    x = params["embed"]["tok"][input_ids]
+    B, T = input_ids.shape
+    if cfg.position == "learned":
+        pos_idx = position[0] + jnp.arange(T)
+        x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)[None]
+
+    def scan_body(carry, inputs):
+        x = carry
+        layer, k_c, v_c = inputs
+        y, k_c, v_c = _block_decode(cfg, x, layer, k_c, v_c, position)
+        return y, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"]))
+    hidden = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
+                   cfg.norm, cfg.norm_eps)
+    logits = logits_fn(cfg, params, hidden)
+    new_cache = {"k": new_k, "v": new_v, "length": position[0] + T}
+    return logits, new_cache
+
+
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
     """6*N + attention flops per token (training fwd+bwd)."""
     n_params = (cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
