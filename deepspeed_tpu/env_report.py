@@ -1,0 +1,48 @@
+"""Environment report (reference env_report.py / ``ds_report`` CLI)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> int:
+    import jax
+
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    print(f"python ................ {sys.version.split()[0]}")
+    print(f"jax ................... {jax.__version__}")
+    try:
+        import flax
+
+        print(f"flax .................. {flax.__version__}")
+    except ImportError:
+        print("flax .................. MISSING")
+    try:
+        import optax
+
+        print(f"optax ................. {optax.__version__}")
+    except ImportError:
+        print("optax ................. MISSING")
+    print(f"backend ............... {jax.default_backend()}")
+    devs = jax.devices()
+    print(f"devices ............... {len(devs)} x {devs[0].device_kind if devs else '-'}")
+    print(f"process count ......... {jax.process_count()}")
+    print("-" * 60)
+    print("native ops:")
+    from .ops.op_builder import BUILDERS
+
+    for name, cls in BUILDERS.items():
+        b = cls()
+        ok = b.is_compatible()
+        extra = ""
+        if ok and name == "CPUAdamBuilder":
+            extra = f" (simd width {b.load().dstpu_simd_width()})"
+        print(f"  {b.name:<14} {'OK' if ok else 'UNAVAILABLE'}{extra}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""Aux subsystem tests: launcher, elasticity, autotuner, activation
+checkpointing, eigenvalue (reference tests/unit/{launcher,elasticity,
+autotuning})."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 ensure_immutable_elastic_config,
+                                                 get_compatible_gpus)
+from deepspeed_tpu.launcher.runner import (build_launch_commands, filter_hosts,
+                                           parse_hostfile)
+
+
+# ------------------------------ launcher -----------------------------------
+def test_parse_hostfile():
+    hosts = parse_hostfile("worker-1 slots=4\nworker-2 slots=8\n# comment\n",
+                           is_text=True)
+    assert hosts == {"worker-1": 4, "worker-2": 8}
+
+
+def test_parse_hostfile_duplicate_raises():
+    with pytest.raises(ValueError):
+        parse_hostfile("a slots=1\na slots=2", is_text=True)
+
+
+def test_filter_include_exclude():
+    hosts = parse_hostfile("a slots=1\nb slots=1\nc slots=1", is_text=True)
+    assert list(filter_hosts(hosts, include="a@c")) == ["a", "c"]
+    assert list(filter_hosts(hosts, exclude="b")) == ["a", "c"]
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, include="zzz")
+    with pytest.raises(ValueError):
+        filter_hosts(hosts, exclude="a@b@c")
+
+
+def test_build_launch_commands_env():
+    hosts = parse_hostfile("h1 slots=4\nh2 slots=4", is_text=True)
+    cmds = build_launch_commands(hosts, "train.py", ["--foo", "1"])
+    assert len(cmds) == 2
+    joined = " ".join(cmds[0])
+    assert "DSTPU_COORDINATOR=h1:29500" in joined
+    assert "DSTPU_NUM_PROCESSES=2" in joined
+    assert "DSTPU_PROCESS_ID=0" in joined
+    assert "DSTPU_PROCESS_ID=1" in " ".join(cmds[1])
+    assert cmds[0][0] == "ssh"
+
+
+def test_single_host_local_command():
+    cmds = build_launch_commands({"localhost": 8}, "t.py", [])
+    assert cmds[0][0] == "bash"
+
+
+# ------------------------------ elasticity ---------------------------------
+def test_elastic_batch_divisibility():
+    batch, gpus = get_compatible_gpus([2, 4], max_train_batch_size=64,
+                                      min_gpus=1, max_gpus=64)
+    assert batch <= 64
+    for g in gpus:
+        assert batch % g == 0
+
+
+def test_compute_elastic_config_resolves_micro_batch():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 128,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 32}}
+    batch, gpus, info = compute_elastic_config(cfg, world_size=gpus_pick(cfg))
+    assert info["micro_batch_per_gpu"] in (2, 4)
+    assert batch == info["micro_batch_per_gpu"] * \
+        info["gradient_accumulation_steps"] * gpus_pick(cfg)
+
+
+def gpus_pick(cfg):
+    batch, gpus, _ = compute_elastic_config(cfg)
+    return gpus[len(gpus) // 2]
+
+
+def test_elastic_disabled_raises():
+    with pytest.raises(ValueError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_elastic_immutability():
+    a = {"elasticity": {"enabled": True, "max_train_batch_size": 100}}
+    b = {"elasticity": {"enabled": True, "max_train_batch_size": 200}}
+    ensure_immutable_elastic_config(a, a)
+    with pytest.raises(ValueError):
+        ensure_immutable_elastic_config(a, b)
+
+
+# ------------------------------ autotuner ----------------------------------
+def test_autotuner_picks_working_config():
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    tuner = Autotuner(
+        model_factory=simple_mlp_spec,
+        base_config={"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        batch_factory=lambda mb: random_batch(batch_size=mb * 8, gas=1),
+        tuning_space={"zero_stage": [0, 1], "micro_batch": [2, 4]},
+        steps_per_trial=1)
+    result = tuner.tune()
+    assert result["best"] is not None
+    assert result["throughput"] > 0
+    assert len(result["trials"]) == 4
+
+
+# -------------------------- activation checkpointing ------------------------
+def test_checkpoint_module_api():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+    checkpointing.configure(policy="nothing_saveable")
+
+    def f(x):
+        return jnp.sum(jnp.tanh(x @ x.T))
+
+    x = jnp.ones((8, 8))
+    out = checkpointing.checkpoint(f, x)
+    g = jax.grad(lambda x: checkpointing.checkpoint(f, x))(x)
+    assert np.isfinite(float(out))
+    assert g.shape == x.shape
