@@ -1,0 +1,127 @@
+"""Model compression: quantization-aware training and pruning.
+
+Reference: ``compression/`` — init_compression wraps layers with
+quantize/prune behaviors per a config (basic_layer.py LinearLayer_Compress),
+scheduled by offsets; redundancy_clean folds the masks in.
+
+TPU-native: compression transforms are pure functions over the param pytree
+plus loss-time "fake" ops: ``fake_quantize`` (straight-through estimator via
+stop_gradient) for QAT and magnitude ``prune_mask`` applied to weights.
+``CompressionScheduler`` gates each method by global step like the
+reference's offset machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+@dataclasses.dataclass
+class QuantizeConfig:
+    enabled: bool = False
+    bits: int = 8
+    schedule_offset: int = 0
+    groups: int = 1  # per-row groups
+    modules: List[str] = dataclasses.field(default_factory=lambda: ["*"])
+
+
+@dataclasses.dataclass
+class PruneConfig:
+    enabled: bool = False
+    method: str = "l1"  # l1 | topk
+    ratio: float = 0.5
+    schedule_offset: int = 0
+    modules: List[str] = dataclasses.field(default_factory=lambda: ["*"])
+
+
+def _matches(key: str, patterns: List[str]) -> bool:
+    for p in patterns:
+        if p == "*" or re.search(p, key):
+            return True
+    return False
+
+
+def fake_quantize(w: jnp.ndarray, bits: int = 8) -> jnp.ndarray:
+    """Symmetric per-tensor fake quant with straight-through gradients
+    (reference fake-quant QAT path)."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-12) / qmax
+    q = jnp.round(w / scale) * scale
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def prune_mask(w: jnp.ndarray, ratio: float, method: str = "l1") -> jnp.ndarray:
+    """Boolean keep-mask by magnitude (reference SparsePruning_Compress)."""
+    if ratio <= 0:
+        return jnp.ones_like(w, jnp.bool_)
+    flat = jnp.abs(w).reshape(-1)
+    k = max(1, int(flat.size * (1.0 - ratio)))
+    thresh = jnp.sort(flat)[-k]
+    return jnp.abs(w) >= thresh
+
+
+class CompressionScheduler:
+    """Applies configured compressions to params each step (reference
+    compression/scheduler.py check_and_apply)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        wq = (config.get("weight_quantization", {})
+              .get("shared_parameters", {}))
+        sp = (config.get("sparse_pruning", {}).get("shared_parameters", {}))
+        self.quant = QuantizeConfig(
+            enabled=wq.get("enabled", False),
+            bits=int(wq.get("quantize_weight_in_forward", 8)
+                     if isinstance(wq.get("quantize_weight_in_forward"), int)
+                     else wq.get("bits", 8)),
+            schedule_offset=int(wq.get("schedule_offset", 0)))
+        self.prune = PruneConfig(
+            enabled=sp.get("enabled", False),
+            method=sp.get("method", "l1"),
+            ratio=float(sp.get("ratio", 0.5)),
+            schedule_offset=int(sp.get("schedule_offset", 0)))
+        self._masks: Optional[Any] = None
+
+    def transform_params(self, params: Any, global_step: int) -> Any:
+        """Forward-time parameter transform (compile-friendly: the branch on
+        step happens host-side per boundary)."""
+        out = params
+        if self.quant.enabled and global_step >= self.quant.schedule_offset:
+            def q(path, w):
+                key = jax.tree_util.keystr(path)
+                if w.ndim >= 2 and _matches(key, self.quant.modules):
+                    return fake_quantize(w, self.quant.bits)
+                return w
+
+            out = jax.tree_util.tree_map_with_path(q, out)
+        if self.prune.enabled and global_step >= self.prune.schedule_offset:
+            if self._masks is None:
+                self._masks = jax.tree_util.tree_map_with_path(
+                    lambda path, w: prune_mask(w, self.prune.ratio, self.prune.method)
+                    if w.ndim >= 2 and _matches(jax.tree_util.keystr(path),
+                                                self.prune.modules) else None,
+                    params, is_leaf=lambda x: hasattr(x, "ndim"))
+            out = jax.tree_util.tree_map(
+                lambda w, m: w * m.astype(w.dtype) if m is not None else w,
+                out, self._masks,
+                is_leaf=lambda x: hasattr(x, "ndim") or x is None)
+        return out
+
+
+def init_compression(params: Any, deepspeed_config: Dict[str, Any],
+                     global_step: int = 0) -> Tuple[Any, CompressionScheduler]:
+    """Reference init_compression: returns (transformed params, scheduler)."""
+    sched = CompressionScheduler(deepspeed_config.get("compression_training", {}))
+    return sched.transform_params(params, global_step), sched
+
+
+def redundancy_clean(params: Any, scheduler: CompressionScheduler) -> Any:
+    """Fold pruning masks permanently into weights (reference
+    redundancy_clean)."""
+    return scheduler.transform_params(params, global_step=10 ** 9)

@@ -1,0 +1,74 @@
+"""Optimized / LoRA linear layers.
+
+Reference: ``deepspeed/linear/optimized_linear.py`` — OptimizedLinear with
+LoRAConfig (low-rank adapters over an optionally quantized frozen base) and
+QuantizationConfig.
+
+Functional TPU form: params are a dict {base (frozen, optionally int8),
+lora_a, lora_b}; ``lora_linear`` applies y = x @ dequant(base) +
+(x @ a) @ b * (alpha/r).  The engine trains only the lora leaves when the
+partition-rule path is wrapped in ``trainable_lora_params``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    q_bits: int = 8
+    group_size: int = 128
+
+
+def init_lora_linear(rng, in_dim: int, out_dim: int, lora: LoRAConfig,
+                     quantize: Optional[QuantizationConfig] = None,
+                     base: Optional[jnp.ndarray] = None,
+                     dtype=jnp.float32) -> Dict[str, Any]:
+    k1, k2 = jax.random.split(rng)
+    if base is None:
+        base = jax.random.normal(k1, (in_dim, out_dim), dtype) * 0.02
+    params: Dict[str, Any] = {"lora_a": jax.random.normal(
+        k2, (in_dim, lora.lora_r), dtype) * (1.0 / lora.lora_r),
+        "lora_b": jnp.zeros((lora.lora_r, out_dim), dtype)}
+    if quantize is not None:
+        from ..ops.pallas.quantization import quantize_int8
+
+        q, s, n = quantize_int8(base.reshape(-1))
+        params["base_q"] = q
+        params["base_scale"] = s
+        params["base_meta"] = jnp.asarray([in_dim, out_dim, n], jnp.int32)
+    else:
+        params["base"] = base
+    return params
+
+
+def lora_linear(params: Dict[str, Any], x: jnp.ndarray, lora: LoRAConfig) -> jnp.ndarray:
+    if "base" in params:
+        base = params["base"]
+    else:
+        from ..ops.pallas.quantization import dequantize_int8
+
+        meta = params["base_meta"]
+        base = dequantize_int8(params["base_q"], params["base_scale"],
+                               int(meta[2]), x.dtype).reshape(int(meta[0]), int(meta[1]))
+    y = x @ jax.lax.stop_gradient(base)  # frozen base
+    scale = lora.lora_alpha / lora.lora_r
+    return y + (x @ params["lora_a"]) @ params["lora_b"] * scale
+
+
+def trainable_lora_params(params: Any) -> Any:
+    """optax mask: True only for lora leaves (freeze everything else)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "lora_" in jax.tree_util.keystr(path), params)

@@ -1,0 +1,44 @@
+"""Hessian eigenvalue estimation by power iteration.
+
+Reference: ``runtime/eigenvalue.py`` — per-block curvature estimates used to
+schedule compression quantization.  JAX makes this clean: hessian-vector
+products are ``jax.jvp`` over ``jax.grad`` (no double-backward hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _normalize(tree: Any) -> Tuple[Any, jnp.ndarray]:
+    sq = sum(jnp.sum(jnp.square(x)) for x in jax.tree_util.tree_leaves(tree))
+    norm = jnp.sqrt(sq)
+    return jax.tree_util.tree_map(lambda x: x / (norm + 1e-12), tree), norm
+
+
+def top_eigenvalue(loss_fn: Callable[[Any], jnp.ndarray], params: Any,
+                   rng, max_iters: int = 20, tol: float = 1e-2) -> jnp.ndarray:
+    """Largest |eigenvalue| of the Hessian of ``loss_fn`` at ``params``."""
+    grad_fn = jax.grad(loss_fn)
+
+    def hvp(v):
+        return jax.jvp(grad_fn, (params,), (v,))[1]
+
+    leaves = jax.tree_util.tree_leaves(params)
+    keys = jax.random.split(rng, len(leaves))
+    v = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params),
+        [jax.random.normal(k, x.shape, jnp.float32) for k, x in zip(keys, leaves)])
+    v, _ = _normalize(v)
+
+    def body(carry, _):
+        v, prev = carry
+        hv = hvp(v)
+        v, norm = _normalize(hv)
+        return (v, norm), norm
+
+    (_, eig), _ = jax.lax.scan(body, (v, jnp.asarray(0.0)), None, length=max_iters)
+    return eig
