@@ -53,6 +53,12 @@ def initialize(args: Any = None,
 
     comm.init_distributed()
     ds_config = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
+    # MiCS (reference zero/mics.py): shard within groups of mics_shard_size,
+    # replicate across — expressed as data=mics_shard_size, repl=remainder
+    mics = ds_config.zero_config.mics_shard_size
+    if mics and mics > 0 and ds_config.mesh.data == -1:
+        ds_config.mesh.data = mics
+        ds_config.mesh.repl = -1
     if topology is None:
         topology = initialize_topology(ds_config.mesh)
 

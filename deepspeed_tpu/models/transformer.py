@@ -62,6 +62,8 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # ALST-style tiled logits+loss: sequence chunk size (0 = off)
+    loss_chunk: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -334,14 +336,50 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
     else:
         ids, labels, mask = batch, batch, None
     hidden, aux = transformer_forward(cfg, params, ids, mask)
-    logits = logits_fn(cfg, params, hidden[:, :-1])
+    hidden = hidden[:, :-1]
     targets = labels[:, 1:]
+    m = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+
+    if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk and \
+            hidden.shape[1] % cfg.loss_chunk == 0:
+        # ALST-style tiled logits+loss (reference TiledFusedLogitsLoss,
+        # runtime/sequence_parallel/ulysses_sp.py:960): never materialize the
+        # full [B, S, V] logits — scan over sequence chunks, remat inside
+        nll_sum, cnt = _tiled_nll(cfg, params, hidden, targets, m, cfg.loss_chunk)
+        return nll_sum / jnp.maximum(cnt, 1.0) + aux
+
+    logits = logits_fn(cfg, params, hidden)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        m = mask[:, 1:].astype(jnp.float32)
+    if m is not None:
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0) + aux
     return jnp.mean(nll) + aux
+
+
+def _tiled_nll(cfg: TransformerConfig, params, hidden, targets, mask, chunk: int):
+    B, S, H = hidden.shape
+    n = S // chunk
+    h_c = hidden.reshape(B, n, chunk, H).transpose(1, 0, 2, 3)
+    t_c = targets.reshape(B, n, chunk).transpose(1, 0, 2)
+    m_c = (mask.reshape(B, n, chunk).transpose(1, 0, 2)
+           if mask is not None else jnp.ones((n, B, chunk), jnp.float32))
+
+    @jax.checkpoint
+    def chunk_nll(h, t, m):
+        logits = logits_fn(cfg, params, h)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * m), jnp.sum(m)
+
+    def body(carry, xs):
+        s, c = carry
+        ds, dc = chunk_nll(*xs)
+        return (s + ds, c + dc), None
+
+    (nll_sum, cnt), _ = jax.lax.scan(
+        body, (jnp.asarray(0.0, jnp.float32), jnp.asarray(0.0, jnp.float32)),
+        (h_c, t_c, m_c))
+    return nll_sum, cnt
 
 
 # ---------------------------------------------------------------------------

@@ -33,18 +33,22 @@ from ..runtime.config import MeshConfig
 from ..utils.logging import logger
 
 PIPE_AXIS = "pipe"
+REPL_AXIS = "repl"  # MiCS replica groups: ZeRO shards within, replicates across
 DATA_AXIS = "data"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "sequence"
 MODEL_AXIS = "model"
 
-ALL_AXES = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
-#: axes over which ZeRO partitions dense (non-expert) state
+ALL_AXES = (PIPE_AXIS, REPL_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+#: axes over which ZeRO partitions dense (non-expert) state.  The MiCS
+#: "repl" axis is deliberately absent: state is sharded within a data group
+#: and replicated across repl groups (reference zero/mics.py:447) — gradient
+#: averaging across repl happens through the batch sharding alone.
 ZERO_AXES = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
 #: axes over which ZeRO partitions expert state
 EXPERT_ZERO_AXES = (DATA_AXIS,)
 #: the batch dimension of inputs is sharded over these
-BATCH_AXES = (DATA_AXIS, EXPERT_AXIS)
+BATCH_AXES = (REPL_AXIS, DATA_AXIS, EXPERT_AXIS)
 
 
 class MeshTopology:
@@ -58,6 +62,7 @@ class MeshTopology:
 
         sizes = {
             PIPE_AXIS: self.config.pipe,
+            REPL_AXIS: getattr(self.config, "repl", 1),
             DATA_AXIS: self.config.data,
             EXPERT_AXIS: self.config.expert,
             SEQ_AXIS: self.config.sequence,
@@ -97,9 +102,10 @@ class MeshTopology:
     @property
     def dp_world_size(self) -> int:
         """Data-parallel degree for batch-size math: everything that consumes
-        distinct micro-batches (data × expert axes; sequence ranks share a
-        batch, pipeline/model ranks share a batch)."""
-        return self.axis_sizes[DATA_AXIS] * self.axis_sizes[EXPERT_AXIS]
+        distinct micro-batches (repl × data × expert axes; sequence ranks
+        share a batch, pipeline/model ranks share a batch)."""
+        return (self.axis_sizes[REPL_AXIS] * self.axis_sizes[DATA_AXIS]
+                * self.axis_sizes[EXPERT_AXIS])
 
     @property
     def zero_world_size(self) -> int:

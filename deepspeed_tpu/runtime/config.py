@@ -114,13 +114,17 @@ class MeshConfig(ConfigModel):
     """TPU mesh axis sizes. -1 on ``data`` means 'all remaining devices'."""
 
     pipe: int = 1
+    # MiCS replica groups (zero_optimization.mics_shard_size sets data and
+    # lets repl absorb the rest): ZeRO shards within 'data', replicates
+    # across 'repl'
+    repl: int = 1
     data: int = -1
     expert: int = 1
     sequence: int = 1
     model: int = 1
     # How ICI/DCN axes are stacked for multi-slice: 'ici_major' keeps model/
     # sequence axes on the fastest links.
-    axis_order: str = "pipe,data,expert,sequence,model"
+    axis_order: str = "pipe,repl,data,expert,sequence,model"
 
 
 @dataclasses.dataclass
