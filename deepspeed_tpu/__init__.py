@@ -56,9 +56,17 @@ def initialize(args: Any = None,
     # MiCS (reference zero/mics.py): shard within groups of mics_shard_size,
     # replicate across — expressed as data=mics_shard_size, repl=remainder
     mics = ds_config.zero_config.mics_shard_size
-    if mics and mics > 0 and ds_config.mesh.data == -1:
-        ds_config.mesh.data = mics
-        ds_config.mesh.repl = -1
+    if mics and mics > 0:
+        if ds_config.mesh.data == -1:
+            ds_config.mesh.data = mics
+            ds_config.mesh.repl = -1
+        elif ds_config.mesh.data != mics:
+            from .utils.logging import logger as _logger
+
+            _logger.warning(
+                f"mics_shard_size={mics} ignored: mesh.data={ds_config.mesh.data} "
+                "is set explicitly — leave mesh.data unset (-1) to let MiCS "
+                "derive data=shard_size, repl=remainder")
     if topology is None:
         topology = initialize_topology(ds_config.mesh)
 

@@ -243,6 +243,14 @@ def _pick_attn(cfg: TransformerConfig) -> Callable:
         from ..sequence.ring_attention import ring_attention
 
         return ring_attention
+    if impl == "fpdt":
+        import math as _math
+
+        from ..sequence.fpdt import fpdt_attention
+
+        return lambda q, k, v, causal, mask=None: fpdt_attention(
+            q, k, v, causal=causal, mask=mask,
+            chunk_size=_math.gcd(q.shape[1], 1024))
     return xla_attention
 
 
@@ -340,6 +348,14 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
     targets = labels[:, 1:]
     m = mask[:, 1:].astype(jnp.float32) if mask is not None else None
 
+    if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk and \
+            hidden.shape[1] % cfg.loss_chunk != 0:
+        from ..utils.logging import warning_once
+
+        warning_once(
+            f"loss_chunk={cfg.loss_chunk} does not divide sequence "
+            f"{hidden.shape[1]} (seq_len-1); falling back to materializing "
+            f"full [B, S, V] logits — pick a loss_chunk dividing seq_len-1")
     if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk and \
             hidden.shape[1] % cfg.loss_chunk == 0:
         # ALST-style tiled logits+loss (reference TiledFusedLogitsLoss,

@@ -1,0 +1,65 @@
+"""Vocab-parallel cross-entropy.
+
+Reference: ``sequence/cross_entropy.py`` (``vocab_parallel_cross_entropy``) —
+when the LM head is tensor-parallel, each rank holds a vocab shard of the
+logits; the loss is computed without ever gathering the full-vocab logits:
+pmax for the softmax max, psum of local exp-sums, and a masked psum to fetch
+each target's logit from whichever rank owns it.
+
+TPU-native: the same three collectives over the "model" mesh axis inside a
+``shard_map``; everything else is jnp.  fp32 accumulation regardless of the
+logits dtype (the reference upcasts identically).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, MODEL_AXIS, get_topology
+
+
+def _vp_ce_body(logits_local: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-rank body: logits_local [..., V_local] is this rank's vocab shard."""
+    v_local = logits_local.shape[-1]
+    vocab_start = jax.lax.axis_index(MODEL_AXIS) * v_local
+    x = logits_local.astype(jnp.float32)
+
+    # the max shift cancels in the loss; stop_gradient both keeps that exact
+    # and sidesteps pmax's missing differentiation rule
+    local_max = jax.lax.stop_gradient(jnp.max(x, axis=-1))
+    gmax = jax.lax.pmax(local_max, MODEL_AXIS)
+    shifted = x - gmax[..., None]
+    sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), MODEL_AXIS)
+
+    in_range = (targets >= vocab_start) & (targets < vocab_start + v_local)
+    local_idx = jnp.where(in_range, targets - vocab_start, 0)
+    tl = jnp.take_along_axis(shifted, local_idx[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(in_range, tl, 0.0), MODEL_AXIS)
+
+    return jnp.log(sum_exp) - target_logit
+
+
+def vocab_parallel_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
+                                 batch_sharded: bool = None) -> jnp.ndarray:
+    """Per-token NLL of ``targets`` under vocab-sharded ``logits``.
+
+    logits: [..., V] with V sharded over the "model" axis; targets: [...]
+    int32, replicated over "model".  Returns [...] fp32 losses.
+    ``batch_sharded=None`` shards the leading dim over the data axes when it
+    divides evenly, else leaves it replicated.
+    """
+    topo = get_topology()
+    if topo.model_parallel_size <= 1:
+        x = logits.astype(jnp.float32)
+        return (jax.nn.logsumexp(x, axis=-1)
+                - jnp.take_along_axis(x, targets[..., None], axis=-1)[..., 0])
+    if batch_sharded is None:
+        batch_sharded = logits.shape[0] % topo.dp_world_size == 0
+    batch = BATCH_AXES if batch_sharded else None
+    in_specs = (P(batch, *([None] * (logits.ndim - 2)), MODEL_AXIS),
+                P(batch, *([None] * (targets.ndim - 1))))
+    fn = jax.shard_map(_vp_ce_body, mesh=topo.mesh, in_specs=in_specs,
+                       out_specs=in_specs[1], check_vma=False)
+    return fn(logits, targets)
