@@ -67,6 +67,13 @@ def initialize(args: Any = None,
                 f"mics_shard_size={mics} ignored: mesh.data={ds_config.mesh.data} "
                 "is set explicitly — leave mesh.data unset (-1) to let MiCS "
                 "derive data=shard_size, repl=remainder")
+    # a model prepared by tp_model_init carries its TP degree; honor it when
+    # the config leaves the model axis at the default
+    autotp = getattr(model, "_autotp_size", None)
+    if autotp and autotp > 1 and ds_config.mesh.model == 1:
+        ds_config.mesh.model = int(autotp)
+        if ds_config.mesh.data == 1:
+            ds_config.mesh.data = -1
     if topology is None:
         topology = initialize_topology(ds_config.mesh)
 
@@ -99,6 +106,16 @@ def init_inference(model: Any = None, config: Any = None, **kwargs):
         if hasattr(cfg, k):
             setattr(cfg, k, v)
     return InferenceEngine(model, cfg)
+
+
+def tp_model_init(model: Any, tp_size: int = 1, dtype: Any = None,
+                  config: Any = None, example_batch: Any = None):
+    """Shard a model with automatic tensor parallelism for training
+    (reference ``deepspeed.tp_model_init``, __init__.py:380)."""
+    from .runtime.tensor_parallel import tp_model_init as _tp_model_init
+
+    return _tp_model_init(model, tp_size=tp_size, dtype=dtype, config=config,
+                          example_batch=example_batch)
 
 
 def add_config_arguments(parser):

@@ -244,13 +244,16 @@ def _pick_attn(cfg: TransformerConfig) -> Callable:
 
         return ring_attention
     if impl == "fpdt":
-        import math as _math
-
         from ..sequence.fpdt import fpdt_attention
+
+        def _chunk(s: int, cap: int = 1024) -> int:
+            # largest divisor of s that is <= cap (gcd(s, cap) degenerates to
+            # 1 for s coprime with cap, e.g. odd sequence lengths)
+            return max(d for d in range(1, min(s, cap) + 1) if s % d == 0)
 
         return lambda q, k, v, causal, mask=None: fpdt_attention(
             q, k, v, causal=causal, mask=mask,
-            chunk_size=_math.gcd(q.shape[1], 1024))
+            chunk_size=_chunk(q.shape[1]))
     return xla_attention
 
 
@@ -348,21 +351,21 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
     targets = labels[:, 1:]
     m = mask[:, 1:].astype(jnp.float32) if mask is not None else None
 
-    if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk and \
-            hidden.shape[1] % cfg.loss_chunk != 0:
+    if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk:
+        if hidden.shape[1] % cfg.loss_chunk == 0:
+            # ALST-style tiled logits+loss (reference TiledFusedLogitsLoss,
+            # runtime/sequence_parallel/ulysses_sp.py:960): never materialize
+            # the full [B, S, V] logits — scan over sequence chunks, remat
+            # inside
+            nll_sum, cnt = _tiled_nll(cfg, params, hidden, targets, m,
+                                      cfg.loss_chunk)
+            return nll_sum / jnp.maximum(cnt, 1.0) + aux
         from ..utils.logging import warning_once
 
         warning_once(
             f"loss_chunk={cfg.loss_chunk} does not divide sequence "
             f"{hidden.shape[1]} (seq_len-1); falling back to materializing "
             f"full [B, S, V] logits — pick a loss_chunk dividing seq_len-1")
-    if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk and \
-            hidden.shape[1] % cfg.loss_chunk == 0:
-        # ALST-style tiled logits+loss (reference TiledFusedLogitsLoss,
-        # runtime/sequence_parallel/ulysses_sp.py:960): never materialize the
-        # full [B, S, V] logits — scan over sequence chunks, remat inside
-        nll_sum, cnt = _tiled_nll(cfg, params, hidden, targets, m, cfg.loss_chunk)
-        return nll_sum / jnp.maximum(cnt, 1.0) + aux
 
     logits = logits_fn(cfg, params, hidden)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

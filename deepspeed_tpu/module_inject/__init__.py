@@ -1,0 +1,21 @@
+"""Automatic tensor-parallelism (AutoTP) — module injection, TPU-style.
+
+The reference rewrites live torch modules: ``AutoTP``
+(module_inject/auto_tp.py:193) walks an ``nn.Module``, recognizes the
+architecture, and swaps ``Linear`` layers for sharded
+``LinearLayer``/``LinearAllreduce`` replacements
+(module_inject/layers.py, replace_module.py).
+
+On TPU there is nothing to rewrite: a "sharded Linear" is the same einsum
+with a ``PartitionSpec`` on its weight, and XLA inserts the collectives the
+reference's ``LinearAllreduce`` issues by hand.  AutoTP here therefore
+*infers partition rules* — (path-regex, PartitionSpec) pairs consumed by
+``ZeroShardingPlan`` — from a parameter pytree, using the same
+architecture-recognition heuristics as the reference's policy registry
+(module_inject/containers/*).
+"""
+
+from .auto_tp import AutoTP, infer_tp_rules, get_policy, POLICY_REGISTRY  # noqa: F401
+from .layers import (column_parallel, row_parallel,  # noqa: F401
+                     column_parallel_explicit, row_parallel_explicit)
+from .replace_module import replace_module, apply_injection_policy  # noqa: F401

@@ -1,0 +1,237 @@
+"""AutoTP: infer tensor-parallel partition rules from a parameter pytree.
+
+Reference parity: ``AutoTP`` (module_inject/auto_tp.py:193) with its
+policy registry (module_inject/containers/{llama,bert,gptneox,bloom,
+megatron,opt,...}.py) and the generic Linear classifier
+(``AutoTP.update_policy_list`` / ``tp_parser``).
+
+Conventions: weights are JAX-style ``[..., in, out]`` (HF-flax kernel
+layout), biases ``[..., out]``.  Column-parallel = shard the *output* dim
+(reference ``LinearLayer``); row-parallel = shard the *input* dim with a
+sum over the model axis after the matmul (reference ``LinearAllreduce``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MODEL_AXIS
+from ..utils.logging import logger
+
+PartitionRule = Tuple[str, P]
+
+# ---------------------------------------------------------------------------
+# generic Linear classifier — the analogue of the reference's tp_parser
+# "gem" lists (auto_tp.py: attention out / mlp down go to LinearAllreduce,
+# qkv / mlp up go to LinearLayer).
+# ---------------------------------------------------------------------------
+
+#: substrings marking a column-parallel (output-sharded) projection
+COLUMN_PATTERNS = (
+    "q_proj", "k_proj", "v_proj", "qkv_proj", "query_key_value", "c_attn",
+    "Wqkv", "wqkv", "query", "key", "value",
+    "gate_proj", "up_proj", "gate_up_proj", "c_fc", "fc1", "fc_in",
+    "dense_h_to_4h", "wi_0", "wi_1", "wi", "w1", "w3", "lin1",
+    "intermediate",
+)
+
+#: substrings marking a row-parallel (input-sharded, summed) projection
+ROW_PATTERNS = (
+    "o_proj", "out_proj", "c_proj", "fc_out", "down_proj", "fc2",
+    "dense_4h_to_h", "wo", "w2", "lin2", "attention.dense", "attn.dense",
+)
+
+#: embedding tables — kept replicated by AutoTP (the reference shards them
+#: only in the Megatron policy); the LM head is column-parallel.
+EMBED_PATTERNS = ("embed_tokens", "wte", "wpe", "word_embeddings",
+                  "position_embeddings", "token_type_embeddings", "shared",
+                  "tok_embeddings", "embeddings")
+HEAD_PATTERNS = ("lm_head", "embed_out", "score", "classifier", "cls")
+
+
+def _segments(path: str) -> List[str]:
+    return re.split(r"[./]", path)
+
+
+def _classify(path: str) -> Optional[str]:
+    """'column' | 'row' | 'head' | None (replicate) for one param path."""
+    segs = _segments(path)
+    joined = "/".join(segs)
+    # context-sensitive BERT-style names: attention/output/dense is row,
+    # intermediate/dense is column, (final) output/dense is row.
+    if segs[-1] in ("kernel", "weight", "bias", "w", "b"):
+        segs = segs[:-1]
+    name = segs[-1] if segs else ""
+    # embeddings stay replicated — check before the substring loops so e.g.
+    # "word_embeddings" is never caught by the short row pattern "wo"
+    if any(name == pat or pat in name for pat in EMBED_PATTERNS):
+        return None
+    if name == "dense":
+        if any(s == "intermediate" for s in segs):
+            return "column"
+        if any(s == "output" for s in segs):
+            return "row"
+    for pat in ROW_PATTERNS:
+        if "." in pat or "/" in pat:
+            if re.search(pat.replace(".", "[./]"), joined):
+                return "row"
+        elif name == pat or (len(pat) > 2 and pat in name):
+            # short names (wo, w2) must match the whole segment
+            return "row"
+    for pat in HEAD_PATTERNS:
+        if name == pat or any(s == pat for s in segs):
+            return "head"
+    for pat in COLUMN_PATTERNS:
+        if name == pat or (len(pat) > 2 and pat in name):
+            return "column"
+    return None
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _spec_for(kind: str, path: str, ndim: int, is_bias: bool,
+              mp_axis: str) -> Optional[P]:
+    """PartitionSpec for one leaf given its classification."""
+    if ndim == 0:
+        return None
+    if kind in ("column", "head"):
+        # column bias [out] and kernel [in, out] both shard the last dim
+        return P(*((None,) * (ndim - 1) + (mp_axis,)))
+    if kind == "row":
+        if is_bias or ndim == 1:
+            return None  # row-parallel bias is added after the sum: replicate
+        return P(*((None,) * (ndim - 2) + (mp_axis, None)))
+    return None
+
+
+def infer_tp_rules(params: Any, mp_axis: str = MODEL_AXIS) -> List[PartitionRule]:
+    """Walk a parameter pytree (or its eval_shape) and emit one exact-match
+    partition rule per TP-shardable leaf.  The generic path of the
+    reference's ``AutoTP.tp_parser`` (auto_tp.py:303)."""
+    rules: List[PartitionRule] = []
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in leaves:
+        pstr = _path_str(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        kind = _classify(pstr)
+        if kind is None:
+            continue
+        is_bias = bool(re.search(r"(^|[./])(bias|b_[a-z0-9]+|b)$", pstr))
+        spec = _spec_for(kind, pstr, len(shape), is_bias, mp_axis)
+        if spec is None:
+            continue
+        # skip specs that don't divide the dim evenly — checked later by the
+        # planner too, but emitting them would only produce warnings.
+        rules.append(("^" + re.escape(pstr) + "$", spec))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# per-architecture policies — the analogue of module_inject/containers/*.
+# Each maps compact path regexes (not exact paths) to specs for the HF-flax
+# per-layer parameter layout (kernel [in, out], bias [out]).
+# ---------------------------------------------------------------------------
+
+def _mk(col: List[str], row: List[str], mp_axis: str = MODEL_AXIS,
+        extra: Optional[List[PartitionRule]] = None) -> List[PartitionRule]:
+    rules: List[PartitionRule] = []
+    for pat in col:
+        rules.append((pat + r"/(kernel|weight)$", P(None, mp_axis)))
+        rules.append((pat + r"/bias$", P(mp_axis)))
+    for pat in row:
+        rules.append((pat + r"/(kernel|weight)$", P(mp_axis, None)))
+    return rules + list(extra or [])
+
+
+#: architecture name -> (signature substrings, rules)
+POLICY_REGISTRY: Dict[str, Tuple[Tuple[str, ...], List[PartitionRule]]] = {
+    "llama": (("q_proj", "gate_proj"),
+              _mk(["[qkv]_proj", "gate_proj", "up_proj"],
+                  ["o_proj", "down_proj"],
+                  extra=[(r"lm_head/(kernel|weight)$", P(None, MODEL_AXIS))])),
+    "mixtral": (("block_sparse_moe", "q_proj"),
+                _mk(["[qkv]_proj"], ["o_proj"],
+                    extra=[(r"experts.*w1/(kernel|weight)$", P(None, MODEL_AXIS)),
+                           (r"experts.*w3/(kernel|weight)$", P(None, MODEL_AXIS)),
+                           (r"experts.*w2/(kernel|weight)$", P(MODEL_AXIS, None)),
+                           (r"lm_head/(kernel|weight)$", P(None, MODEL_AXIS))])),
+    "gpt2": (("c_attn", "c_fc"),
+             _mk(["c_attn", "c_fc"], ["c_proj"],
+                 extra=[(r"lm_head/(kernel|weight)$", P(None, MODEL_AXIS))])),
+    "gptneox": (("query_key_value", "dense_h_to_4h"),
+                _mk(["query_key_value", "dense_h_to_4h"],
+                    ["attention/dense", "dense_4h_to_h"],
+                    extra=[(r"embed_out/(kernel|weight)$", P(None, MODEL_AXIS))])),
+    "bloom": (("query_key_value", "self_attention"),
+              _mk(["query_key_value", "dense_h_to_4h"],
+                  ["self_attention/dense", "dense_4h_to_h"])),
+    "falcon": (("query_key_value", "dense_h_to_4h"),
+               _mk(["query_key_value", "dense_h_to_4h"],
+                   ["self_attention/dense", "dense_4h_to_h"])),
+    "bert": (("attention", "intermediate"),
+             _mk(["self/query", "self/key", "self/value", "intermediate/dense"],
+                 ["attention/output/dense", r"\d+/output/dense"])),
+    "opt": (("k_proj", "fc1"),
+            _mk(["[qkv]_proj", "fc1"], ["out_proj", "fc2"],
+                extra=[(r"lm_head/(kernel|weight)$", P(None, MODEL_AXIS))])),
+    "t5": (("DenseReluDense", "SelfAttention"),
+           _mk(["SelfAttention/[qkv]", "EncDecAttention/[qkv]",
+                "DenseReluDense/wi(_[01])?"],
+               ["SelfAttention/o", "EncDecAttention/o", "DenseReluDense/wo"])),
+    "phi": (("Wqkv", "fc1"), _mk(["Wqkv", "fc1"], ["out_proj", "fc2"])),
+    "chatglm": (("query_key_value", "dense_h_to_4h"),
+                _mk(["query_key_value", "dense_h_to_4h"], ["dense_4h_to_h"])),
+}
+
+
+def get_policy(arch: str) -> List[PartitionRule]:
+    if arch not in POLICY_REGISTRY:
+        raise KeyError(f"no TP policy for architecture '{arch}'; "
+                       f"known: {sorted(POLICY_REGISTRY)}")
+    return list(POLICY_REGISTRY[arch][1])
+
+
+class AutoTP:
+    """Detect the architecture of a parameter pytree and produce TP rules.
+
+    ``AutoTP.parse(params)`` is the analogue of
+    ``AutoTP.tp_parser(model)`` + ``in_module_list`` policy lookup
+    (reference module_inject/auto_tp.py:193,265).
+    """
+
+    def __init__(self, mp_axis: str = MODEL_AXIS):
+        self.mp_axis = mp_axis
+
+    @staticmethod
+    def detect_arch(params: Any) -> Optional[str]:
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        joined = "\n".join(_path_str(p) for p, _ in leaves)
+        for arch, (signature, _rules) in POLICY_REGISTRY.items():
+            if all(s in joined for s in signature):
+                return arch
+        return None
+
+    def parse(self, params: Any) -> List[PartitionRule]:
+        arch = self.detect_arch(params)
+        if arch is not None and self.mp_axis == MODEL_AXIS:
+            logger.info(f"AutoTP: matched policy '{arch}'")
+            return get_policy(arch)
+        rules = infer_tp_rules(params, self.mp_axis)
+        logger.info(f"AutoTP: generic parser produced {len(rules)} rules")
+        return rules

@@ -1,0 +1,54 @@
+"""Injection entry points.
+
+Reference parity: ``replace_transformer_layer`` / ``replace_module``
+(module_inject/replace_module.py) and ``InferenceEngine._apply_injection_policy``
+(inference/engine.py:380).  On TPU "replacing a module" means attaching
+partition rules to the ModelSpec — the forward stays the same traced
+function; only shardings (and therefore generated collectives) change.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.module import ModelSpec, as_model_spec
+from ..utils.logging import logger
+from .auto_tp import AutoTP, PartitionRule
+
+
+def apply_injection_policy(model: Any,
+                           injection_policy: Optional[Sequence[PartitionRule]] = None,
+                           mp_axis: str = "model",
+                           example_batch: Any = None) -> ModelSpec:
+    """Attach TP partition rules to a model, inferring them if not given.
+
+    ``injection_policy`` plays the role of the reference's
+    ``{OrigLayer: (policy...)}`` dict; here it is a list of
+    (path-regex, PartitionSpec) pairs.  With no policy, AutoTP inference
+    runs on the parameter structure (reference falls back to AutoTP the
+    same way, inference/engine.py:380 vs auto_tp path).
+    """
+    spec = as_model_spec(model, example_batch=example_batch)
+    if injection_policy is not None:
+        rules = list(injection_policy)
+    else:
+        abstract = jax.eval_shape(spec.init_params, jax.random.PRNGKey(0))
+        rules = AutoTP(mp_axis).parse(abstract)
+    merged: List[Tuple[str, P]] = list(spec.partition_rules())
+    have = {pat for pat, _ in merged}
+    added = 0
+    for pat, rule_spec in rules:
+        if pat not in have:
+            merged.append((pat, rule_spec))
+            added += 1
+    logger.info(f"apply_injection_policy: {added} TP rules injected "
+                f"({len(merged)} total)")
+    spec._partition_rules = merged
+    return spec
+
+
+# torch-API-compatible alias (reference replace_module is the internal name)
+replace_module = apply_injection_policy

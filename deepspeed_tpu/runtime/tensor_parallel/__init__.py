@@ -1,0 +1,1 @@
+from .tp_manager import TpTrainingManager, tp_model_init  # noqa: F401
