@@ -71,9 +71,9 @@ def initialize(args: Any = None,
     # the config leaves the model axis at the default
     autotp = getattr(model, "_autotp_size", None)
     if autotp and autotp > 1 and ds_config.mesh.model == 1:
+        # mesh.data keeps its value: -1 (the default) absorbs the remaining
+        # devices; an explicit size stays the user's choice
         ds_config.mesh.model = int(autotp)
-        if ds_config.mesh.data == 1:
-            ds_config.mesh.data = -1
     if topology is None:
         topology = initialize_topology(ds_config.mesh)
 

@@ -1,0 +1,17 @@
+"""Inference v2: ragged / continuous batching engine.
+
+Reference: ``deepspeed/inference/v2`` — ``InferenceEngineV2``
+(engine_v2.py), blocked KV cache + scheduling state (``ragged/``), and the
+ragged kernel set (``kernels/ragged_ops``).
+
+TPU re-design: XLA needs static shapes, so "ragged" becomes *paged*: a
+fixed pool of KV pages + per-sequence page tables, one jitted decode
+program for all active sequences regardless of their lengths, and
+bucket-padded prefill programs.  The scheduler (admission, page
+allocation, eviction of finished sequences) runs on the host between
+device steps — same split as the reference's C++ atom-builder vs CUDA
+kernels.
+"""
+
+from .ragged import BlockAllocator, KVBlockConfig, PagedKVCache  # noqa: F401
+from .engine_v2 import InferenceEngineV2, RaggedInferenceConfig, RaggedRequest  # noqa: F401

@@ -1,0 +1,241 @@
+"""Continuous-batching inference engine.
+
+Reference parity: ``InferenceEngineV2`` (inference/v2/engine_v2.py) with
+its ragged batch scheduler (``DSStateManager``/``RaggedBatchWrapper``,
+inference/v2/ragged/): requests enter a queue, are admitted when KV pages
+and a decode slot are available, prefill and decode interleave, finished
+sequences release their pages immediately so new requests can start while
+others are mid-generation.
+
+The device work is two compiled programs (model_runner.py); everything
+here is host-side bookkeeping between steps.  Sampling (greedy /
+temperature) happens on host from the returned logits — batch sizes are
+small and this keeps the device programs sampling-free and cacheable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import TransformerConfig
+from ...runtime.config_utils import ConfigModel
+from ...runtime.precision import cast_tree
+from ...utils.logging import logger
+from .model_runner import paged_decode, paged_prefill
+from .ragged import BlockAllocator, KVBlockConfig, PagedKVCache, SequenceState
+
+
+@dataclasses.dataclass
+class RaggedInferenceConfig(ConfigModel):
+    dtype: str = "bf16"
+    page_size: int = 16
+    num_pages: int = 256
+    max_seqs: int = 8
+    max_pages_per_seq: int = 16
+    min_prefill_bucket: int = 16
+
+    @property
+    def jnp_dtype(self):
+        return {"fp32": jnp.float32, "bf16": jnp.bfloat16,
+                "fp16": jnp.float16}[self.dtype]
+
+    @property
+    def block(self) -> KVBlockConfig:
+        return KVBlockConfig(page_size=self.page_size, num_pages=self.num_pages,
+                             max_seqs=self.max_seqs,
+                             max_pages_per_seq=self.max_pages_per_seq)
+
+
+@dataclasses.dataclass
+class RaggedRequest:
+    prompt_ids: List[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0  # 0 => greedy
+    eos_id: Optional[int] = None
+    uid: Optional[int] = None
+
+
+class InferenceEngineV2:
+    """Paged continuous batching over a models/* transformer."""
+
+    def __init__(self, model: Any, config: Optional[RaggedInferenceConfig] = None,
+                 params: Any = None, seed: int = 0):
+        self.config = config or RaggedInferenceConfig()
+        if not hasattr(model, "config") or not isinstance(model.config, TransformerConfig):
+            raise TypeError("InferenceEngineV2 needs a models/* model carrying "
+                            "a TransformerConfig")
+        self.cfg: TransformerConfig = model.config
+        block = self.config.block
+        if params is None:
+            params = model.init_params(jax.random.PRNGKey(seed))
+        self.params = cast_tree(params, self.config.jnp_dtype)
+        pool = PagedKVCache.init(self.cfg.n_layers, self.cfg.kv_heads,
+                                 self.cfg.head_dim, block, self.config.jnp_dtype)
+        self._k_pool, self._v_pool = pool["k"], pool["v"]
+        self.block = block
+        self.allocator = BlockAllocator(block.num_pages)
+        self._uid = itertools.count()
+        self._rng = np.random.RandomState(seed)
+
+        self._queue: List[SequenceState] = []
+        self._slots: List[Optional[SequenceState]] = [None] * block.max_seqs
+        # host mirror of the device page tables, trash-filled
+        self._page_table = np.full((block.max_seqs, block.max_pages_per_seq),
+                                   block.trash_page, dtype=np.int32)
+
+        cfg = self.cfg
+        self._decode = jax.jit(
+            lambda *a: paged_decode(cfg, *a), donate_argnums=(1, 2))
+        self._prefill = jax.jit(
+            lambda *a: paged_prefill(cfg, *a), donate_argnums=(1, 2))
+
+    # -- request API ---------------------------------------------------------
+    def put(self, request: RaggedRequest) -> int:
+        """Queue a request; returns its uid."""
+        uid = request.uid if request.uid is not None else next(self._uid)
+        n = len(request.prompt_ids)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if n >= self.block.max_seq_len:
+            raise ValueError(f"prompt length {n} >= max_seq_len "
+                             f"{self.block.max_seq_len}")
+        self._queue.append(SequenceState(
+            uid=uid, tokens=list(request.prompt_ids), prompt_len=n,
+            max_new_tokens=request.max_new_tokens,
+            temperature=request.temperature, eos_id=request.eos_id))
+        return uid
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    # -- scheduling ----------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        # power-of-two growth from a page-size multiple keeps every bucket a
+        # multiple of page_size (prefill scatters whole pages)
+        b = max(self.config.min_prefill_bucket, self.block.page_size)
+        while b < n:
+            b *= 2
+        return min(b, self.block.max_seq_len)
+
+    def _admit(self) -> List[SequenceState]:
+        admitted = []
+        ps = self.block.page_size
+        for i, slot in enumerate(self._slots):
+            if not self._queue:
+                break
+            if slot is not None:
+                continue
+            need = -(-self._queue[0].prompt_len // ps)
+            if need > self.allocator.free_pages:
+                break  # head-of-line blocking, like the reference's FCFS
+            seq = self._queue.pop(0)
+            seq.slot, seq.pages = i, self.allocator.alloc(need)
+            self._page_table[i, :] = self.block.trash_page
+            self._page_table[i, :need] = seq.pages
+            admitted.append(seq)
+            self._slots[i] = seq
+        return admitted
+
+    def _sample(self, seq: SequenceState, logits: np.ndarray) -> int:
+        if seq.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / seq.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _retire(self, seq: SequenceState) -> None:
+        self.allocator.free(seq.pages)
+        self._page_table[seq.slot, :] = self.block.trash_page
+        self._slots[seq.slot] = None
+        seq.slot, seq.pages, seq.done = -1, [], True
+
+    def _maybe_finish(self, seq: SequenceState, token: int) -> None:
+        if (seq.generated >= seq.max_new_tokens
+                or (seq.eos_id is not None and token == seq.eos_id)
+                or seq.length >= self.block.max_seq_len):
+            self._retire(seq)
+
+    # -- the engine step -----------------------------------------------------
+    def step(self) -> Dict[int, Dict[str, Any]]:
+        """Admit + prefill new sequences, decode one token for running ones.
+
+        Returns {uid: {"tokens": [newly generated], "done": bool}}.
+        """
+        out: Dict[int, Dict[str, Any]] = {}
+        ps = self.block.page_size
+
+        for seq in self._admit():
+            n = seq.prompt_len
+            bucket = self._bucket(n)
+            ids = np.zeros((bucket,), np.int32)
+            ids[:n] = seq.tokens
+            rows = np.full((bucket // ps,), self.block.trash_page, np.int32)
+            rows[:len(seq.pages)] = seq.pages
+            logits, self._k_pool, self._v_pool = self._prefill(
+                self.params, self._k_pool, self._v_pool,
+                jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
+            tok = self._sample(seq, np.asarray(logits, np.float32))
+            seq.tokens.append(tok)
+            out[seq.uid] = {"tokens": [tok], "done": False}
+            self._maybe_finish(seq, tok)
+            if seq.done:
+                out[seq.uid]["done"] = True
+
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return out
+
+        # grow page tables where the pending token crosses a page boundary
+        for seq in active:
+            pos = seq.length - 1  # position the pending token will occupy
+            if pos // ps == len(seq.pages):
+                page = self.allocator.alloc(1)[0]
+                seq.pages.append(page)
+                self._page_table[seq.slot, len(seq.pages) - 1] = page
+
+        B = self.block.max_seqs
+        last = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        act = np.zeros((B,), bool)
+        for seq in active:
+            last[seq.slot] = seq.tokens[-1]
+            pos[seq.slot] = seq.length - 1
+            act[seq.slot] = True
+
+        logits, self._k_pool, self._v_pool = self._decode(
+            self.params, self._k_pool, self._v_pool,
+            jnp.asarray(last), jnp.asarray(pos),
+            jnp.asarray(self._page_table), jnp.asarray(act))
+        logits = np.asarray(logits, np.float32)
+
+        for seq in active:
+            tok = self._sample(seq, logits[seq.slot])
+            seq.tokens.append(tok)
+            rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
+            rec["tokens"].append(tok)
+            self._maybe_finish(seq, tok)
+            rec["done"] = seq.done
+        return out
+
+    def generate_all(self, requests: List[RaggedRequest],
+                     max_steps: int = 10_000) -> Dict[int, List[int]]:
+        """Convenience: run requests to completion, returning full
+        generations keyed by uid."""
+        uids = [self.put(r) for r in requests]
+        got: Dict[int, List[int]] = {u: [] for u in uids}
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            for uid, rec in self.step().items():
+                got[uid].extend(rec["tokens"])
+        else:
+            logger.warning("generate_all: max_steps reached with work pending")
+        return got

@@ -1,0 +1,154 @@
+"""Jitted programs over the paged KV cache.
+
+Reference parity: the ragged kernel set — blocked rotary + KV copy
+(inference/v2/kernels/ragged_ops/blocked_kv_rotary), ragged attention via
+blocked KV, logits gather (ragged_ops/logits_gather).  On TPU these are
+two XLA programs:
+
+* ``paged_prefill`` — one (bucket-padded) prompt: dense causal attention,
+  K/V scattered into the sequence's pages.
+* ``paged_decode`` — one token for *all* decode slots at once, regardless
+  of per-sequence lengths: gather pages by table, mask by length.  This is
+  the continuous-batching hot loop; lengths/page tables are data, not
+  shapes, so one compiled program serves every batch composition.
+
+Scatters are unconditional: inactive slots and pad chunks write to the
+trash page (ragged.py) instead of branching.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import (TransformerConfig, _norm, _repeat_kv,
+                                   _rope, logits_fn)
+
+
+def _qkv(cfg: TransformerConfig, layer, x, positions):
+    """Shared projection + rope for prefill/decode. x: [B, T, H]."""
+    B, T, _ = x.shape
+    NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    a = layer["attn"]
+    h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"),
+              cfg.norm, cfg.norm_eps)
+    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, T, NH, D)
+    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
+    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
+    if cfg.position == "rope":
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+    return q, k, v
+
+
+def _ffn(cfg: TransformerConfig, layer, x):
+    h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"),
+              cfg.norm, cfg.norm_eps)
+    m = layer["mlp"]
+    if cfg.moe_experts > 0:
+        from ...moe.sharded_moe import MoEConfig, moe_ffn
+
+        moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            aux_loss_coef=cfg.moe_aux_coef)
+        h, _ = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
+                       training=False)
+    elif cfg.activation == "swiglu":
+        h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
+    else:
+        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
+        if cfg.use_bias:
+            h = h + m["b_down"]
+    return x + h
+
+
+def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
+                  ids, page_rows, length) -> Tuple[jnp.ndarray, Any, Any]:
+    """Prefill one prompt.
+
+    ids: [S_pad] bucket-padded prompt; page_rows: [S_pad // page_size]
+    page index per chunk (trash for pad chunks); length: real prompt length.
+    Returns (last-token logits [V], k_pool, v_pool).
+    """
+    S = ids.shape[0]
+    ps = k_pool.shape[2]
+    x = params["embed"]["tok"][ids][None]  # [1, S, H]
+    if cfg.position == "learned":
+        x = x + params["embed"]["pos"][jnp.arange(S)][None]
+    positions = jnp.arange(S)[None]
+
+    def body(x, inputs):
+        layer, k_c, v_c = inputs  # k_c: [P+1, ps, KVH, D]
+        q, k, v = _qkv(cfg, layer, x, positions)
+        k_c = k_c.at[page_rows].set(k[0].reshape(S // ps, ps, *k.shape[2:])
+                                    .astype(k_c.dtype))
+        v_c = v_c.at[page_rows].set(v[0].reshape(S // ps, ps, *v.shape[2:])
+                                    .astype(v_c.dtype))
+        kk = _repeat_kv(k, cfg.n_heads // cfg.kv_heads)
+        vv = _repeat_kv(v, cfg.n_heads // cfg.kv_heads)
+        scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
+        scores = scores / math.sqrt(cfg.head_dim)
+        causal = jnp.arange(S)[None, None, :, None] >= jnp.arange(S)[None, None, None, :]
+        scores = jnp.where(causal, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, S, -1)
+        x = x + (attn @ layer["attn"]["wo"]
+                 + (layer["attn"]["bo"] if cfg.use_bias else 0))
+        return _ffn(cfg, layer, x), (k_c, v_c)
+
+    x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
+    hidden = _norm(x[:, length - 1], params["final_norm"]["scale"],
+                   params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    logits = logits_fn(cfg, params, hidden[:, None])[0, 0]
+    return logits, k_pool, v_pool
+
+
+def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
+                 last_tokens, positions, page_table, active
+                 ) -> Tuple[jnp.ndarray, Any, Any]:
+    """One token for every decode slot.
+
+    last_tokens: [B]; positions: [B] position of that token; page_table:
+    [B, MP] (trash-filled beyond each sequence's pages); active: [B] bool.
+    Returns (logits [B, V], k_pool, v_pool).
+    """
+    B = last_tokens.shape[0]
+    ps = k_pool.shape[2]
+    trash = k_pool.shape[1] - 1
+    x = params["embed"]["tok"][last_tokens][:, None]  # [B, 1, H]
+    if cfg.position == "learned":
+        x = x + params["embed"]["pos"][positions][:, None]
+
+    page_idx = jnp.where(active,
+                         page_table[jnp.arange(B), positions // ps], trash)
+    off = positions % ps
+    S = page_table.shape[1] * ps
+    slot_pos = jnp.arange(S)[None]  # [1, S]
+    vis = slot_pos <= positions[:, None]  # [B, S]
+
+    def body(x, inputs):
+        layer, k_c, v_c = inputs
+        q, k, v = _qkv(cfg, layer, x, positions[:, None])
+        k_c = k_c.at[page_idx, off].set(k[:, 0].astype(k_c.dtype))
+        v_c = v_c.at[page_idx, off].set(v[:, 0].astype(v_c.dtype))
+        kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
+        vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
+        kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
+        vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
+        scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
+        scores = scores / math.sqrt(cfg.head_dim)
+        scores = jnp.where(vis[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
+        x = x + (attn @ layer["attn"]["wo"]
+                 + (layer["attn"]["bo"] if cfg.use_bias else 0))
+        return _ffn(cfg, layer, x), (k_c, v_c)
+
+    x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
+    hidden = _norm(x, params["final_norm"]["scale"],
+                   params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    logits = logits_fn(cfg, params, hidden)[:, 0]
+    return logits, k_pool, v_pool

@@ -1,0 +1,98 @@
+"""Paged KV-cache state.
+
+Reference parity: the blocked KV cache of inference v2 —
+``BlockedAllocator`` / ``KVCacheManager`` (inference/v2/ragged/,
+ragged/csrc/fast_host_buffer.cpp and friends).  The reference manages
+blocks with a C++ host allocator feeding CUDA ragged kernels; here the
+allocator is host Python (it runs between jitted steps, off the hot
+device path) and the cache is a dense page pool the decode program
+indexes with page tables.
+
+Layout: ``k``/``v`` are ``[L, num_pages + 1, page_size, KVH, D]``.  The
+last page (index ``num_pages``) is the *trash page*: writes from inactive
+slots and pad positions are routed there, keeping every device-side
+scatter unconditional (no data-dependent control flow under jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class KVBlockConfig:
+    page_size: int = 16
+    num_pages: int = 256
+    max_seqs: int = 8  # concurrent decode slots
+    max_pages_per_seq: int = 16
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+    @property
+    def trash_page(self) -> int:
+        return self.num_pages
+
+
+class BlockAllocator:
+    """Free-list page allocator (reference inference/v2/ragged
+    BlockedAllocator): O(1) alloc/free, host-side."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"KV pool exhausted: need {n} pages, "
+                              f"{len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if not (0 <= p < self.num_pages):
+                raise ValueError(f"freeing invalid page {p}")
+        self._free.extend(pages)
+
+
+class PagedKVCache:
+    """Device arrays of the page pool."""
+
+    @staticmethod
+    def init(n_layers: int, kv_heads: int, head_dim: int,
+             block: KVBlockConfig, dtype=jnp.bfloat16) -> Dict[str, Any]:
+        shape = (n_layers, block.num_pages + 1, block.page_size, kv_heads, head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+@dataclasses.dataclass
+class SequenceState:
+    """Host-side descriptor of one in-flight sequence (reference
+    DSSequenceDescriptor, inference/v2/ragged/sequence_descriptor.py)."""
+
+    uid: int
+    tokens: List[int]  # prompt + generated so far
+    prompt_len: int
+    max_new_tokens: int
+    temperature: float
+    eos_id: int | None
+    slot: int = -1  # decode slot index, -1 = not scheduled
+    pages: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def length(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def generated(self) -> int:
+        return self.length - self.prompt_len

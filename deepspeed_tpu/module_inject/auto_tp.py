@@ -136,8 +136,8 @@ def infer_tp_rules(params: Any, mp_axis: str = MODEL_AXIS) -> List[PartitionRule
         spec = _spec_for(kind, pstr, len(shape), is_bias, mp_axis)
         if spec is None:
             continue
-        # skip specs that don't divide the dim evenly — checked later by the
-        # planner too, but emitting them would only produce warnings.
+        # non-divisible dims (e.g. a 2-class head with tp_size=4) fall back
+        # to replication inside ZeroShardingPlan._check_divisible
         rules.append(("^" + re.escape(pstr) + "$", spec))
     return rules
 
@@ -159,7 +159,13 @@ def _mk(col: List[str], row: List[str], mp_axis: str = MODEL_AXIS,
     return rules + list(extra or [])
 
 
-#: architecture name -> (signature substrings, rules)
+#: architecture name -> (signature substrings, rules).  Signatures are
+#: matched against the "/"-joined parameter paths; detection scores by
+#: total matched signature length so more-specific signatures win over
+#: subset signatures (e.g. bloom's "self_attention/query_key_value" over
+#: gptneox's "attention/query_key_value").  Structurally identical
+#: architectures (falcon≈bloom) alias to the first match — their rules
+#: coincide; ``get_policy`` still serves each by name.
 POLICY_REGISTRY: Dict[str, Tuple[Tuple[str, ...], List[PartitionRule]]] = {
     "llama": (("q_proj", "gate_proj"),
               _mk(["[qkv]_proj", "gate_proj", "up_proj"],
@@ -174,14 +180,14 @@ POLICY_REGISTRY: Dict[str, Tuple[Tuple[str, ...], List[PartitionRule]]] = {
     "gpt2": (("c_attn", "c_fc"),
              _mk(["c_attn", "c_fc"], ["c_proj"],
                  extra=[(r"lm_head/(kernel|weight)$", P(None, MODEL_AXIS))])),
-    "gptneox": (("query_key_value", "dense_h_to_4h"),
+    "gptneox": (("attention/query_key_value", "dense_h_to_4h"),
                 _mk(["query_key_value", "dense_h_to_4h"],
                     ["attention/dense", "dense_4h_to_h"],
                     extra=[(r"embed_out/(kernel|weight)$", P(None, MODEL_AXIS))])),
-    "bloom": (("query_key_value", "self_attention"),
+    "bloom": (("self_attention/query_key_value", "dense_h_to_4h"),
               _mk(["query_key_value", "dense_h_to_4h"],
                   ["self_attention/dense", "dense_4h_to_h"])),
-    "falcon": (("query_key_value", "dense_h_to_4h"),
+    "falcon": (("self_attention/query_key_value", "dense_h_to_4h"),
                _mk(["query_key_value", "dense_h_to_4h"],
                    ["self_attention/dense", "dense_4h_to_h"])),
     "bert": (("attention", "intermediate"),
@@ -195,7 +201,7 @@ POLICY_REGISTRY: Dict[str, Tuple[Tuple[str, ...], List[PartitionRule]]] = {
                 "DenseReluDense/wi(_[01])?"],
                ["SelfAttention/o", "EncDecAttention/o", "DenseReluDense/wo"])),
     "phi": (("Wqkv", "fc1"), _mk(["Wqkv", "fc1"], ["out_proj", "fc2"])),
-    "chatglm": (("query_key_value", "dense_h_to_4h"),
+    "chatglm": (("self_attention/query_key_value", "dense_4h_to_h"),
                 _mk(["query_key_value", "dense_h_to_4h"], ["dense_4h_to_h"])),
 }
 
@@ -222,10 +228,13 @@ class AutoTP:
     def detect_arch(params: Any) -> Optional[str]:
         leaves = jax.tree_util.tree_flatten_with_path(params)[0]
         joined = "\n".join(_path_str(p) for p, _ in leaves)
+        best, best_score = None, 0
         for arch, (signature, _rules) in POLICY_REGISTRY.items():
             if all(s in joined for s in signature):
-                return arch
-        return None
+                score = sum(len(s) for s in signature)
+                if score > best_score:
+                    best, best_score = arch, score
+        return best
 
     def parse(self, params: Any) -> List[PartitionRule]:
         arch = self.detect_arch(params)

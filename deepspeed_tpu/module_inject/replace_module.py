@@ -46,8 +46,10 @@ def apply_injection_policy(model: Any,
             added += 1
     logger.info(f"apply_injection_policy: {added} TP rules injected "
                 f"({len(merged)} total)")
-    spec._partition_rules = merged
-    return spec
+    # a new ModelSpec: never mutate the caller's model (it may be reused for
+    # a non-TP run)
+    return ModelSpec(spec.init_params, spec.loss_fn, merged, spec.apply_fn,
+                     spec.flops_per_sample)
 
 
 # torch-API-compatible alias (reference replace_module is the internal name)

@@ -89,6 +89,23 @@ class ZeroShardingPlan:
                 return P(*(tuple(spec) + (None,) * (ndim - len(spec))))
         return P(*((None,) * ndim))
 
+    def _check_divisible(self, spec: P, shape: Tuple[int, ...], path_str: str) -> P:
+        """Replicate (with a warning) instead of crashing at placement when a
+        rule shards a dim the mesh doesn't divide — e.g. an AutoTP-classified
+        classification head with num_labels < tp_size."""
+        sizes = self.topology.axis_sizes
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            axes = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+            need = int(np.prod([sizes[a] for a in axes]))
+            if dim >= len(shape) or shape[dim] % need != 0:
+                logger.warning(
+                    f"partition rule for {path_str}: dim {dim} of {shape} not "
+                    f"divisible by mesh axes {axes} (={need}); replicating")
+                return P(*((None,) * len(shape)))
+        return spec
+
     # -- zero extension ------------------------------------------------------
     def _extend_with_zero(self, spec: P, shape: Tuple[int, ...], path_str: str) -> P:
         """Insert the ZeRO axes on the largest dim they divide evenly."""
@@ -122,21 +139,21 @@ class ZeroShardingPlan:
     # -- public API ----------------------------------------------------------
     def param_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
         """Sharding of the live (compute) parameters."""
-        spec = self.base_spec(path_str, len(shape))
+        spec = self._check_divisible(self.base_spec(path_str, len(shape)), shape, path_str)
         if self.stage >= 3:
             spec = self._extend_with_zero(spec, shape, path_str)
         return spec
 
     def master_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
         """Sharding of fp32 master weights + optimizer moments."""
-        spec = self.base_spec(path_str, len(shape))
+        spec = self._check_divisible(self.base_spec(path_str, len(shape)), shape, path_str)
         if self.stage >= 1:
             spec = self._extend_with_zero(spec, shape, path_str)
         return spec
 
     def grad_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
         """Sharding of the gradient-accumulation buffer."""
-        spec = self.base_spec(path_str, len(shape))
+        spec = self._check_divisible(self.base_spec(path_str, len(shape)), shape, path_str)
         if self.stage >= 2:
             spec = self._extend_with_zero(spec, shape, path_str)
         return spec
