@@ -16,6 +16,7 @@ from typing import Any, Optional, Tuple
 __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.engine import DeepSpeedTPUEngine, TrainState  # noqa: F401
 from .runtime.module import ModelSpec  # noqa: F401

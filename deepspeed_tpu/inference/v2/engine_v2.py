@@ -79,6 +79,14 @@ class InferenceEngineV2:
                                  self.cfg.head_dim, block, self.config.jnp_dtype)
         self._k_pool, self._v_pool = pool["k"], pool["v"]
         self.block = block
+        if block.num_pages < block.max_pages_per_seq:
+            raise ValueError(
+                f"num_pages ({block.num_pages}) < max_pages_per_seq "
+                f"({block.max_pages_per_seq}): one sequence could never run to "
+                "completion even with the whole pool")
+        # A learned-position model cannot attend past its position table; cap
+        # the paged window to the model's trained context.
+        self.max_seq_len = min(block.max_seq_len, self.cfg.max_seq_len)
         self.allocator = BlockAllocator(block.num_pages)
         self._uid = itertools.count()
         self._rng = np.random.RandomState(seed)
@@ -102,9 +110,9 @@ class InferenceEngineV2:
         n = len(request.prompt_ids)
         if n == 0:
             raise ValueError("empty prompt")
-        if n >= self.block.max_seq_len:
+        if n >= self.max_seq_len:
             raise ValueError(f"prompt length {n} >= max_seq_len "
-                             f"{self.block.max_seq_len}")
+                             f"{self.max_seq_len}")
         self._queue.append(SequenceState(
             uid=uid, tokens=list(request.prompt_ids), prompt_len=n,
             max_new_tokens=request.max_new_tokens,
@@ -118,10 +126,22 @@ class InferenceEngineV2:
     def _bucket(self, n: int) -> int:
         # power-of-two growth from a page-size multiple keeps every bucket a
         # multiple of page_size (prefill scatters whole pages)
-        b = max(self.config.min_prefill_bucket, self.block.page_size)
+        ps = self.block.page_size
+        b = max(self.config.min_prefill_bucket, ps)
+        b = -(-b // ps) * ps  # round up: prefill scatters whole pages
         while b < n:
             b *= 2
         return min(b, self.block.max_seq_len)
+
+    def _preempt(self, seq: SequenceState) -> None:
+        """Evict a running sequence to the queue head; it will re-prefill its
+        whole prefix (recompute, the reference scheduler's KV-pressure relief)
+        when pages free up."""
+        self.allocator.free(seq.pages)
+        self._page_table[seq.slot, :] = self.block.trash_page
+        self._slots[seq.slot] = None
+        seq.slot, seq.pages = -1, []
+        self._queue.insert(0, seq)
 
     def _admit(self) -> List[SequenceState]:
         admitted = []
@@ -131,7 +151,7 @@ class InferenceEngineV2:
                 break
             if slot is not None:
                 continue
-            need = -(-self._queue[0].prompt_len // ps)
+            need = -(-self._queue[0].length // ps)
             if need > self.allocator.free_pages:
                 break  # head-of-line blocking, like the reference's FCFS
             seq = self._queue.pop(0)
@@ -160,7 +180,7 @@ class InferenceEngineV2:
     def _maybe_finish(self, seq: SequenceState, token: int) -> None:
         if (seq.generated >= seq.max_new_tokens
                 or (seq.eos_id is not None and token == seq.eos_id)
-                or seq.length >= self.block.max_seq_len):
+                or seq.length >= self.max_seq_len):
             self._retire(seq)
 
     # -- the engine step -----------------------------------------------------
@@ -173,7 +193,9 @@ class InferenceEngineV2:
         ps = self.block.page_size
 
         for seq in self._admit():
-            n = seq.prompt_len
+            # seq.length, not prompt_len: a preempted sequence re-prefills its
+            # whole prefix (prompt + tokens generated before eviction)
+            n = seq.length
             bucket = self._bucket(n)
             ids = np.zeros((bucket,), np.int32)
             ids[:n] = seq.tokens
@@ -193,13 +215,30 @@ class InferenceEngineV2:
         if not active:
             return out
 
-        # grow page tables where the pending token crosses a page boundary
-        for seq in active:
+        # grow page tables where the pending token crosses a page boundary;
+        # under pool pressure, preempt running sequences (youngest first) to
+        # recompute later — never crash mid-step (reference: the v2 scheduler
+        # holds requests back under KV pressure rather than failing)
+        for seq in list(active):
+            if seq.slot < 0:
+                continue  # already preempted this step
             pos = seq.length - 1  # position the pending token will occupy
             if pos // ps == len(seq.pages):
+                while self.allocator.free_pages < 1:
+                    victims = [s for s in self._slots
+                               if s is not None and s is not seq]
+                    victim = victims[-1] if victims else seq
+                    self._preempt(victim)
+                    if victim is seq:
+                        break
+                if seq.slot < 0:
+                    continue
                 page = self.allocator.alloc(1)[0]
                 seq.pages.append(page)
                 self._page_table[seq.slot, len(seq.pages) - 1] = page
+        active = [s for s in self._slots if s is not None]
+        if not active:
+            return out
 
         B = self.block.max_seqs
         last = np.zeros((B,), np.int32)

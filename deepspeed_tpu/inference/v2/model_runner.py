@@ -25,44 +25,17 @@ import jax
 import jax.numpy as jnp
 
 from ...models.transformer import (TransformerConfig, _norm, _repeat_kv,
-                                   _rope, logits_fn)
+                                   attn_qkv, logits_fn, mlp_block)
 
 
 def _qkv(cfg: TransformerConfig, layer, x, positions):
-    """Shared projection + rope for prefill/decode. x: [B, T, H]."""
-    B, T, _ = x.shape
-    NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-    a = layer["attn"]
-    h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"),
-              cfg.norm, cfg.norm_eps)
-    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, T, NH, D)
-    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
-    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
-    if cfg.position == "rope":
-        q = _rope(q, cfg.rope_theta, positions)
-        k = _rope(k, cfg.rope_theta, positions)
-    return q, k, v
+    """norm1 + projection + rope, shared with the training forward."""
+    return attn_qkv(cfg, layer, x, positions)
 
 
 def _ffn(cfg: TransformerConfig, layer, x):
-    h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"),
-              cfg.norm, cfg.norm_eps)
-    m = layer["mlp"]
-    if cfg.moe_experts > 0:
-        from ...moe.sharded_moe import MoEConfig, moe_ffn
-
-        moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
-                            capacity_factor=cfg.moe_capacity_factor,
-                            aux_loss_coef=cfg.moe_aux_coef)
-        h, _ = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
-                       training=False)
-    elif cfg.activation == "swiglu":
-        h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
-    else:
-        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
-        if cfg.use_bias:
-            h = h + m["b_down"]
-    return x + h
+    out, _aux = mlp_block(cfg, layer, x, training=False)
+    return out
 
 
 def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,

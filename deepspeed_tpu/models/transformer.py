@@ -257,28 +257,28 @@ def _pick_attn(cfg: TransformerConfig) -> Callable:
     return xla_attention
 
 
-def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
-    """One transformer block, [B, S, H] -> [B, S, H]."""
-    B, S, H = x.shape
+def attn_qkv(cfg: TransformerConfig, layer, x, positions):
+    """norm1 + QKV projection + rope — shared by the training forward and the
+    paged inference programs (inference/v2/model_runner.py).
+
+    x: [B, T, H] -> q [B, T, NH, D], k/v [B, T, KVH, D] (pre-GQA-repeat).
+    """
+    B, T, _ = x.shape
     NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     a = layer["attn"]
-
     h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
-    q = h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)
-    k = h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)
-    v = h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)
-    q = q.reshape(B, S, NH, D)
-    k = k.reshape(B, S, KVH, D)
-    v = v.reshape(B, S, KVH, D)
+    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, T, NH, D)
+    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
+    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
     if cfg.position == "rope":
         q = _rope(q, cfg.rope_theta, positions)
         k = _rope(k, cfg.rope_theta, positions)
-    k = _repeat_kv(k, NH // KVH)
-    v = _repeat_kv(v, NH // KVH)
-    attn = attn_fn(q, k, v, cfg.causal, mask)
-    attn = attn.reshape(B, S, NH * D)
-    x = x + (attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0))
+    return q, k, v
 
+
+def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
+    """norm2 + FFN (dense swiglu/gelu or MoE) with residual; returns
+    (x + ffn(norm(x)), aux_loss).  Shared by training and inference paths."""
     h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"), cfg.norm, cfg.norm_eps)
     m = layer["mlp"]
     aux = jnp.asarray(0.0, jnp.float32)
@@ -288,7 +288,8 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
         moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                             capacity_factor=cfg.moe_capacity_factor,
                             aux_loss_coef=cfg.moe_aux_coef)
-        h, aux = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation)
+        h, aux = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
+                         training=training)
     elif cfg.activation == "swiglu":
         h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
     else:
@@ -296,6 +297,21 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
         if cfg.use_bias:
             h = h + m["b_down"]
     return x + h, aux
+
+
+def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
+    """One transformer block, [B, S, H] -> [B, S, H]."""
+    B, S, H = x.shape
+    NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    a = layer["attn"]
+
+    q, k, v = attn_qkv(cfg, layer, x, positions)
+    k = _repeat_kv(k, NH // KVH)
+    v = _repeat_kv(v, NH // KVH)
+    attn = attn_fn(q, k, v, cfg.causal, mask)
+    attn = attn.reshape(B, S, NH * D)
+    x = x + (attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0))
+    return mlp_block(cfg, layer, x)
 
 
 def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None):

@@ -201,7 +201,11 @@ POLICY_REGISTRY: Dict[str, Tuple[Tuple[str, ...], List[PartitionRule]]] = {
                 "DenseReluDense/wi(_[01])?"],
                ["SelfAttention/o", "EncDecAttention/o", "DenseReluDense/wo"])),
     "phi": (("Wqkv", "fc1"), _mk(["Wqkv", "fc1"], ["out_proj", "fc2"])),
-    "chatglm": (("self_attention/query_key_value", "dense_4h_to_h"),
+    # "encoder/layers" disambiguates from bloom (whose blocks live under
+    # "h/<i>"), and makes the signature score strictly higher than bloom's so
+    # detect_arch prefers it on ChatGLM checkpoints.
+    "chatglm": (("encoder/layers", "self_attention/query_key_value",
+                 "dense_4h_to_h"),
                 _mk(["query_key_value", "dense_h_to_4h"], ["dense_4h_to_h"])),
 }
 

@@ -9,6 +9,7 @@ function; only shardings (and therefore generated collectives) change.
 
 from __future__ import annotations
 
+import copy
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,9 +48,11 @@ def apply_injection_policy(model: Any,
     logger.info(f"apply_injection_policy: {added} TP rules injected "
                 f"({len(merged)} total)")
     # a new ModelSpec: never mutate the caller's model (it may be reused for
-    # a non-TP run)
-    return ModelSpec(spec.init_params, spec.loss_fn, merged, spec.apply_fn,
-                     spec.flops_per_sample)
+    # a non-TP run).  Shallow-copy so extra attributes (e.g. the
+    # _autotp_size tag set by tp_model_init, or model.config) survive.
+    out = copy.copy(spec)
+    out._partition_rules = merged
+    return out
 
 
 # torch-API-compatible alias (reference replace_module is the internal name)

@@ -1,0 +1,58 @@
+"""Accelerator ABI tests (reference: tests/unit/accelerator/)."""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import (CPUAccelerator, TPUAccelerator, get_accelerator,
+                                       set_accelerator)
+
+
+def test_detection_cpu_sim():
+    set_accelerator(None)  # type: ignore[arg-type]
+    acc = get_accelerator()
+    # conftest pins JAX_PLATFORMS=cpu → CPU accelerator with 8 virtual devices
+    assert isinstance(acc, CPUAccelerator)
+    assert acc.device_count() == 8
+    assert acc.is_available()
+    assert acc.device_name() == "cpu"
+    assert acc.device_name(3) == "cpu:3"
+
+
+def test_stream_event_shims():
+    acc = get_accelerator()
+    with acc.stream(acc.Stream()):
+        pass
+    ev = acc.Event()
+    ev.record()
+    ev.synchronize()
+    acc.synchronize()
+
+
+def test_dtype_and_comm_surface():
+    acc = get_accelerator()
+    assert acc.is_bf16_supported()
+    assert jnp.bfloat16 in acc.supported_dtypes()
+    assert acc.communication_backend_name().startswith("xla")
+    assert acc.device_supports_graphs()
+
+
+def test_rng_and_memory():
+    acc = get_accelerator()
+    acc.manual_seed(1234)
+    assert acc.initial_seed() == 1234
+    key = acc.default_generator()
+    assert key.shape == (2,)
+    assert acc.memory_allocated() >= 0
+
+
+def test_op_builder_dispatch():
+    acc = get_accelerator()
+    b = acc.create_op_builder("CPUAdamBuilder")
+    assert b is not None
+
+
+def test_tpu_accelerator_props():
+    tpu = TPUAccelerator()
+    # no real TPU in CI: device list is empty but the ABI must not raise
+    assert tpu.communication_backend_name() == "xla:ici"
+    assert isinstance(tpu.device_kind(), str)
+    assert isinstance(tpu.is_fp8_supported(), bool)

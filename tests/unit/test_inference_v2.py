@@ -116,3 +116,43 @@ def test_rejects_oversized_prompt():
         max_pages_per_seq=2))
     with pytest.raises(ValueError):
         eng.put(RaggedRequest(prompt_ids=list(range(16)), max_new_tokens=1))
+
+
+def test_kv_pressure_preempts_instead_of_crashing():
+    """Decode-time page growth under a full pool must preempt + recompute,
+    never raise (reference: v2 scheduler holds requests under KV pressure)."""
+    model = llama_model("tiny", max_seq_len=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(2)
+    # pool of 8 pages, two prompts of 28 tokens -> 4 pages each: pool full at
+    # admission; the first boundary-crossing generated token forces preemption
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=8, max_seqs=2,
+        max_pages_per_seq=8), params=params)
+    prompts = [list(rng.randint(0, model.config.vocab_size, 28)) for _ in range(2)]
+    got = eng.generate_all([RaggedRequest(prompt_ids=p, max_new_tokens=10)
+                            for p in prompts])
+    for uid, p in enumerate(prompts):
+        assert len(got[uid]) == 10
+        # preempted sequences recompute their prefix; result must equal the
+        # uninterrupted dense generation
+        want = _dense_greedy(model, params, p, 10)
+        assert got[uid] == want
+
+
+def test_pool_smaller_than_one_seq_rejected():
+    model = llama_model("tiny", max_seq_len=256)
+    with pytest.raises(ValueError):
+        InferenceEngineV2(model, RaggedInferenceConfig(
+            page_size=8, num_pages=4, max_seqs=2, max_pages_per_seq=8))
+
+
+def test_learned_pos_window_capped_to_model_context():
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    model = gpt2_model("tiny", max_seq_len=32)
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=16, num_pages=32, max_seqs=2,
+        max_pages_per_seq=16))  # paged window 256 >> model context 32
+    assert eng.max_seq_len == 32
+    with pytest.raises(ValueError):
+        eng.put(RaggedRequest(prompt_ids=list(range(40))))
