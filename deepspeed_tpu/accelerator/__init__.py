@@ -222,16 +222,20 @@ class Accelerator:
         try:
             import jax.profiler as _p
 
-            self._ranges = getattr(self, "_ranges", [])
-            self._ranges.append(_p.TraceAnnotation(msg))
-            self._ranges[-1].__enter__()
+            ann = _p.TraceAnnotation(msg)
+            ann.__enter__()
         except Exception:
-            pass
+            return  # keep push/pop stack aligned: only entered ranges count
+        self._ranges = getattr(self, "_ranges", [])
+        self._ranges.append(ann)
 
     def range_pop(self) -> None:
         ranges = getattr(self, "_ranges", [])
         if ranges:
-            ranges.pop().__exit__(None, None, None)
+            try:
+                ranges.pop().__exit__(None, None, None)
+            except Exception:
+                pass
 
     def lazy_call(self, callback) -> None:
         callback()

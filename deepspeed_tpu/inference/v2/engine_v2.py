@@ -72,6 +72,11 @@ class InferenceEngineV2:
                             "a TransformerConfig")
         self.cfg: TransformerConfig = model.config
         block = self.config.block
+        if block.num_pages < block.max_pages_per_seq:
+            raise ValueError(
+                f"num_pages ({block.num_pages}) < max_pages_per_seq "
+                f"({block.max_pages_per_seq}): one sequence could never run to "
+                "completion even with the whole pool")
         if params is None:
             params = model.init_params(jax.random.PRNGKey(seed))
         self.params = cast_tree(params, self.config.jnp_dtype)
@@ -79,16 +84,12 @@ class InferenceEngineV2:
                                  self.cfg.head_dim, block, self.config.jnp_dtype)
         self._k_pool, self._v_pool = pool["k"], pool["v"]
         self.block = block
-        if block.num_pages < block.max_pages_per_seq:
-            raise ValueError(
-                f"num_pages ({block.num_pages}) < max_pages_per_seq "
-                f"({block.max_pages_per_seq}): one sequence could never run to "
-                "completion even with the whole pool")
         # A learned-position model cannot attend past its position table; cap
         # the paged window to the model's trained context.
         self.max_seq_len = min(block.max_seq_len, self.cfg.max_seq_len)
         self.allocator = BlockAllocator(block.num_pages)
         self._uid = itertools.count()
+        self._admit_counter = itertools.count()
         self._rng = np.random.RandomState(seed)
 
         self._queue: List[SequenceState] = []
@@ -156,6 +157,7 @@ class InferenceEngineV2:
                 break  # head-of-line blocking, like the reference's FCFS
             seq = self._queue.pop(0)
             seq.slot, seq.pages = i, self.allocator.alloc(need)
+            seq.admit_order = next(self._admit_counter)
             self._page_table[i, :] = self.block.trash_page
             self._page_table[i, :need] = seq.pages
             admitted.append(seq)
@@ -227,7 +229,10 @@ class InferenceEngineV2:
                 while self.allocator.free_pages < 1:
                     victims = [s for s in self._slots
                                if s is not None and s is not seq]
-                    victim = victims[-1] if victims else seq
+                    # evict the most recently admitted sequence: it has the
+                    # cheapest prefix to recompute
+                    victim = (max(victims, key=lambda s: s.admit_order)
+                              if victims else seq)
                     self._preempt(victim)
                     if victim is seq:
                         break

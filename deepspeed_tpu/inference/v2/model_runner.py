@@ -28,12 +28,8 @@ from ...models.transformer import (TransformerConfig, _norm, _repeat_kv,
                                    attn_qkv, logits_fn, mlp_block)
 
 
-def _qkv(cfg: TransformerConfig, layer, x, positions):
-    """norm1 + projection + rope, shared with the training forward."""
-    return attn_qkv(cfg, layer, x, positions)
-
-
 def _ffn(cfg: TransformerConfig, layer, x):
+    """mlp_block shared with the training forward; inference drops aux loss."""
     out, _aux = mlp_block(cfg, layer, x, training=False)
     return out
 
@@ -55,7 +51,7 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
 
     def body(x, inputs):
         layer, k_c, v_c = inputs  # k_c: [P+1, ps, KVH, D]
-        q, k, v = _qkv(cfg, layer, x, positions)
+        q, k, v = attn_qkv(cfg, layer, x, positions)
         k_c = k_c.at[page_rows].set(k[0].reshape(S // ps, ps, *k.shape[2:])
                                     .astype(k_c.dtype))
         v_c = v_c.at[page_rows].set(v[0].reshape(S // ps, ps, *v.shape[2:])
@@ -104,7 +100,7 @@ def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
 
     def body(x, inputs):
         layer, k_c, v_c = inputs
-        q, k, v = _qkv(cfg, layer, x, positions[:, None])
+        q, k, v = attn_qkv(cfg, layer, x, positions[:, None])
         k_c = k_c.at[page_idx, off].set(k[:, 0].astype(k_c.dtype))
         v_c = v_c.at[page_idx, off].set(v[:, 0].astype(v_c.dtype))
         kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]

@@ -88,6 +88,7 @@ class SequenceState:
     slot: int = -1  # decode slot index, -1 = not scheduled
     pages: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    admit_order: int = -1  # monotonic admission stamp (preemption policy)
 
     @property
     def length(self) -> int:

@@ -27,9 +27,16 @@ class DeepSpeedCPUAdam:
         self.weight_decay = weight_decay
         self.adamw_mode = adamw_mode
         self.bias_correction = bias_correction
-        self.step_count = 0
         self._m: Dict[int, np.ndarray] = {}
         self._v: Dict[int, np.ndarray] = {}
+        # per-key step counts: bias correction is per-parameter, and keeping
+        # them separate also makes concurrent per-leaf step() calls safe
+        # (SuperOffload's worker pool)
+        self._t: Dict[int, int] = {}
+
+    @property
+    def step_count(self) -> int:
+        return max(self._t.values(), default=0)
 
     def _state_for(self, key: int, n: int):
         if key not in self._m:
@@ -43,10 +50,10 @@ class DeepSpeedCPUAdam:
         assert params.dtype == np.float32 and params.flags["C_CONTIGUOUS"]
         grads = np.ascontiguousarray(grads, np.float32)
         m, v = self._state_for(key, params.size)
-        self.step_count += 1
+        self._t[key] = t = self._t.get(key, 0) + 1
         rc = self.lib.dstpu_adam_step(
             params.ctypes.data, grads.ctypes.data, m.ctypes.data, v.ctypes.data,
-            params.size, self.step_count, np.float32(lr or self.lr),
+            params.size, t, np.float32(lr or self.lr),
             np.float32(self.beta1), np.float32(self.beta2), np.float32(self.eps),
             np.float32(self.weight_decay), int(self.adamw_mode),
             int(self.bias_correction))
@@ -62,10 +69,10 @@ class DeepSpeedCPUAdam:
         g = np.ascontiguousarray(grads_bf16.view(np.uint16))
         m, v = self._state_for(key, params.size)
         out_bf16 = np.empty(params.size, np.uint16)
-        self.step_count += 1
+        self._t[key] = t = self._t.get(key, 0) + 1
         rc = self.lib.dstpu_adam_step_bf16g(
             params.ctypes.data, g.ctypes.data, m.ctypes.data, v.ctypes.data,
-            out_bf16.ctypes.data, params.size, self.step_count,
+            out_bf16.ctypes.data, params.size, t,
             np.float32(lr or self.lr), np.float32(self.beta1),
             np.float32(self.beta2), np.float32(self.eps),
             np.float32(self.weight_decay), int(self.adamw_mode),
@@ -75,11 +82,14 @@ class DeepSpeedCPUAdam:
         return out_bf16
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"step": self.step_count,
+        return {"t": dict(self._t),
                 "m": {k: v.copy() for k, v in self._m.items()},
                 "v": {k: v.copy() for k, v in self._v.items()}}
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
-        self.step_count = sd["step"]
+        if "t" in sd:
+            self._t = {k: int(v) for k, v in sd["t"].items()}
+        else:  # older checkpoints stored a single global count
+            self._t = {k: int(sd.get("step", 0)) for k in sd["m"]}
         self._m = {k: np.asarray(v) for k, v in sd["m"].items()}
         self._v = {k: np.asarray(v) for k, v in sd["v"].items()}

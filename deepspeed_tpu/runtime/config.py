@@ -53,10 +53,37 @@ class OffloadConfig(ConfigModel):
     buffer_size: int = 100_000_000
     ratio: float = 1.0
     max_in_cpu: int = 1_000_000_000
+    # SuperOffload (reference runtime/superoffload/): fan the host Adam out
+    # over a pool of CPU optimizer workers
+    super_offload: bool = False
+    cpu_worker_count: int = 4
 
     @property
     def enabled(self) -> bool:
         return self.device not in ("none", None)
+
+    def validate(self) -> None:
+        if self.super_offload and not self.enabled:
+            raise ValueError("super_offload requires offload_optimizer.device="
+                             "'cpu' (or 'nvme'); got device='none'")
+
+
+@dataclasses.dataclass
+class ZenFlowConfig(ConfigModel):
+    """zenflow block inside zero_optimization (reference
+    runtime/zenflow/zenflow_config.py:12)."""
+
+    enabled: bool = False
+    topk_ratio: float = 0.1  # fraction of columns on the immediate fast path
+    update_interval: int = 4  # deferred CPU pass cadence (boundaries)
+    full_warm_up_rounds: int = 0  # full synchronous updates first
+    overlap_step: bool = True  # run the deferred pass in a background thread
+
+    def validate(self) -> None:
+        if not (0.0 < self.topk_ratio <= 1.0):
+            raise ValueError(f"topk_ratio must be in (0, 1], got {self.topk_ratio}")
+        if self.update_interval < 1:
+            raise ValueError("update_interval must be >= 1")
 
 
 @dataclasses.dataclass
@@ -87,6 +114,8 @@ class ZeroConfig(ConfigModel):
     mics_hierarchical_params_gather: bool = False
     round_robin_gradients: bool = False
     ignore_unused_parameters: bool = True
+    # ZenFlow stall-free offload (reference runtime/zenflow/zenflow_config.py)
+    zenflow: ZenFlowConfig = dataclasses.field(default_factory=ZenFlowConfig)
 
     def validate(self) -> None:
         if self.stage not in (0, 1, 2, 3):

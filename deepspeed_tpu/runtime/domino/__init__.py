@@ -1,0 +1,1 @@
+from .transformer import DominoConfig, domino_transformer_forward  # noqa: F401

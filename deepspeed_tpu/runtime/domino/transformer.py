@@ -1,0 +1,168 @@
+"""Domino: tensor parallelism with communication hidden behind compute.
+
+Reference parity: ``runtime/domino/transformer.py`` (DominoTransformerLayer)
+and ``async_linear.py`` (DominoAsyncColumnParallelLinear) — the reference
+splits each microbatch into chunks and overlaps the row-parallel all-reduce
+of chunk *i* with the compute of chunk *i+1*, using async NCCL handles
+waited on just before the result is consumed.
+
+TPU-native translation: inside ``shard_map`` over the model axis, the same
+chunking is expressed purely as a dependency structure — each chunk's
+``psum`` depends only on that chunk's partial product, so XLA's
+latency-hiding scheduler turns the collectives into async
+all-reduce-start/done pairs that ride ICI underneath the next chunk's
+MXU work.  No handles, no waits: the overlap *is* the dataflow graph.
+
+The layer math matches models/transformer._block (same param tree, stacked
+``[L, ...]`` weights), so a Domino forward is numerically identical to the
+plain TP forward — only the schedule differs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...models.transformer import TransformerConfig, _norm, _repeat_kv, _rope
+from ...parallel.mesh import MODEL_AXIS
+
+# which last-dim / middle-dim the TP shard lives on, per stacked weight name
+_COLUMN_SHARDED = {"wq", "wk", "wv", "w_gate", "w_up", "bq", "bk", "bv", "b_up"}
+_ROW_SHARDED = {"wo", "w_down"}  # sharded on their input (dim 1 of [L, in, out])
+
+
+@dataclasses.dataclass
+class DominoConfig:
+    """Config for the Domino schedule (reference DominoTransformerLayer args)."""
+
+    n_chunks: int = 2  # microbatch split factor; 2 matches the reference
+    axis: str = MODEL_AXIS
+
+
+def _leaf_spec(path: str, ndim: int, axis: str) -> P:
+    name = path.split("/")[-1]
+    if name in _COLUMN_SHARDED:
+        return P(*((None,) * (ndim - 1)), axis)
+    if name in _ROW_SHARDED:
+        return P(None, axis, *((None,) * (ndim - 2)))
+    return P(*((None,) * ndim))
+
+
+def param_specs(params: Any, axis: str = MODEL_AXIS) -> Any:
+    """PartitionSpecs for a models/transformer param tree under Domino TP."""
+
+    def spec(path, leaf):
+        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        return _leaf_spec(p, leaf.ndim, axis)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def _attn_partial(cfg: TransformerConfig, lyr, xc, positions, tp: int):
+    """Attention on one chunk with column-sharded QKV; returns the
+    row-parallel partial product (pre-psum) of the output projection."""
+    B, S, _ = xc.shape
+    D = cfg.head_dim
+    nh_loc, kvh_loc = cfg.n_heads // tp, cfg.kv_heads // tp
+    a = lyr["attn"]
+    h = _norm(xc, lyr["norm1"]["scale"], lyr["norm1"].get("bias"),
+              cfg.norm, cfg.norm_eps)
+    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, S, nh_loc, D)
+    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, S, kvh_loc, D)
+    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, S, kvh_loc, D)
+    if cfg.position == "rope":
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
+    k = _repeat_kv(k, nh_loc // kvh_loc)
+    v = _repeat_kv(v, nh_loc // kvh_loc)
+    scores = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(D)
+    if cfg.causal:
+        causal = jnp.arange(S)[None, None, :, None] >= jnp.arange(S)[None, None, None, :]
+        scores = jnp.where(causal, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(xc.dtype)
+    attn = jnp.einsum("bnts,bsnd->btnd", probs, v).reshape(B, S, nh_loc * D)
+    return attn @ a["wo"]  # partial sum over the model axis
+
+
+def _mlp_partial(cfg: TransformerConfig, lyr, xc):
+    """FFN on one chunk with column-sharded up / row-sharded down projection;
+    returns the pre-psum partial."""
+    h = _norm(xc, lyr["norm2"]["scale"], lyr["norm2"].get("bias"),
+              cfg.norm, cfg.norm_eps)
+    m = lyr["mlp"]
+    if cfg.activation == "swiglu":
+        h = jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])
+    else:
+        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0))
+    return h @ m["w_down"]
+
+
+def _domino_block(cfg: TransformerConfig, lyr, x, positions, tp: int,
+                  axis: str, n_chunks: int):
+    """One transformer block, chunk-interleaved: issue each chunk's psum
+    right after its partial compute so XLA overlaps it with the next chunk."""
+    chunks = jnp.split(x, n_chunks, axis=0)
+    pos_chunks = jnp.split(positions, n_chunks, axis=0)
+
+    attn_out = []
+    for c, pc in zip(chunks, pos_chunks):
+        partial_out = _attn_partial(cfg, lyr, c, pc, tp)
+        # psum(chunk i) has no dependency on chunk i+1's matmuls → async
+        attn_out.append(jax.lax.psum(partial_out, axis))
+    bo = lyr["attn"].get("bo") if cfg.use_bias else None
+    chunks = [c + (o + bo if bo is not None else o)
+              for c, o in zip(chunks, attn_out)]
+
+    mlp_out = []
+    for c in chunks:
+        mlp_out.append(jax.lax.psum(_mlp_partial(cfg, lyr, c), axis))
+    bd = lyr["mlp"].get("b_down") if cfg.use_bias else None
+    chunks = [c + (o + bd if bd is not None else o)
+              for c, o in zip(chunks, mlp_out)]
+    return jnp.concatenate(chunks, axis=0)
+
+
+def domino_transformer_forward(cfg: TransformerConfig, params, input_ids,
+                               mesh: Mesh, axis: str = MODEL_AXIS,
+                               n_chunks: int = 2,
+                               domino_config: Optional[DominoConfig] = None):
+    """[B, S] tokens -> [B, S, H] hidden states, TP over ``axis`` with the
+    Domino overlap schedule.  Numerically equivalent to
+    models/transformer.transformer_forward (dense, non-MoE configs).
+    """
+    if domino_config is not None:
+        axis, n_chunks = domino_config.axis, domino_config.n_chunks
+    tp = mesh.shape[axis]
+    if cfg.n_heads % tp or cfg.kv_heads % tp:
+        raise ValueError(f"n_heads ({cfg.n_heads}) and kv_heads ({cfg.kv_heads}) "
+                         f"must divide the TP degree {tp}")
+    if cfg.moe_experts > 0:
+        raise ValueError("Domino covers dense blocks; route MoE through "
+                         "moe/sharded_moe expert parallelism instead")
+    B = input_ids.shape[0]
+    if B % n_chunks:
+        raise ValueError(f"batch {B} not divisible by n_chunks {n_chunks}")
+
+    specs = param_specs(params, axis)
+
+    def body(params, ids):
+        x = params["embed"]["tok"][ids]
+        Bc, S = ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S), (Bc, S))
+        if cfg.position == "learned":
+            x = x + params["embed"]["pos"][:S][None]
+        for i in range(cfg.n_layers):
+            lyr = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+            x = _domino_block(cfg, lyr, x, positions, tp, axis, n_chunks)
+        return _norm(x, params["final_norm"]["scale"],
+                     params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(specs, P(None, None)),
+                       out_specs=P(None, None, None), check_vma=False)
+    return fn(params, input_ids)

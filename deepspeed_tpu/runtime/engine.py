@@ -134,21 +134,48 @@ class DeepSpeedTPUEngine:
 
             self.flops_profiler = FlopsProfiler(self, config.flops_profiler)
 
-        # optimizer-state host offload (ZeRO-Offload / -Infinity)
+        # optimizer-state host offload (ZeRO-Offload / -Infinity / ZenFlow /
+        # SuperOffload — all share the host-master data path)
         self.offload_optimizer = None
         off_cfg = config.zero_config.offload_optimizer
-        if off_cfg.enabled:
+        zf_cfg = config.zero_config.zenflow
+        if off_cfg.enabled or zf_cfg.enabled:
             if self.fp16_enabled:
                 raise NotImplementedError("offload_optimizer with fp16 loss "
                                           "scaling is not supported; use bf16")
-            from .zero.offload import HostOffloadedOptimizer
+            opt_cfg = {"type": config.optimizer.type,
+                       "params": config.optimizer.params}
+            if zf_cfg.enabled:
+                from .zenflow import ZenFlowOptimizer
 
-            self.offload_optimizer = HostOffloadedOptimizer(
-                abstract_params=None,  # set in _init_state
-                optimizer_config={"type": config.optimizer.type,
-                                  "params": config.optimizer.params},
-                grad_clip=config.gradient_clipping,
-                nvme_path=(off_cfg.nvme_path if off_cfg.device == "nvme" else None))
+                if off_cfg.device == "nvme":
+                    raise NotImplementedError(
+                        "zenflow keeps optimizer state in host RAM; it does "
+                        "not spill to NVMe — drop offload_optimizer.device="
+                        "'nvme' or disable zenflow")
+                if off_cfg.super_offload:
+                    logger.warning("zenflow enabled: super_offload / "
+                                   "cpu_worker_count are ignored")
+                self.offload_optimizer = ZenFlowOptimizer(
+                    abstract_params=None,  # set in _init_state
+                    optimizer_config=opt_cfg, zenflow_config=zf_cfg,
+                    grad_clip=config.gradient_clipping)
+            elif off_cfg.super_offload:
+                from .superoffload import SuperOffloadOptimizer
+
+                self.offload_optimizer = SuperOffloadOptimizer(
+                    abstract_params=None, optimizer_config=opt_cfg,
+                    grad_clip=config.gradient_clipping,
+                    nvme_path=(off_cfg.nvme_path if off_cfg.device == "nvme" else None),
+                    cpu_worker_count=off_cfg.cpu_worker_count)
+            else:
+                from .zero.offload import HostOffloadedOptimizer
+
+                self.offload_optimizer = HostOffloadedOptimizer(
+                    abstract_params=None,  # set in _init_state
+                    optimizer_config=opt_cfg,
+                    grad_clip=config.gradient_clipping,
+                    nvme_path=(off_cfg.nvme_path if off_cfg.device == "nvme" else None))
 
         self.training_dataloader = None
         if training_data is not None:

@@ -1,0 +1,1 @@
+from .superoffload import SuperOffloadOptimizer  # noqa: F401

@@ -1,0 +1,1 @@
+from .zenflow import ZenFlowConfig, ZenFlowOptimizer  # noqa: F401

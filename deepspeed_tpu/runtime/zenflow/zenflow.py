@@ -1,0 +1,218 @@
+"""ZenFlow: stall-free optimizer offloading with importance-aware updates.
+
+Reference parity: ``runtime/zenflow/`` — ``ZenFlowZeroOptimizer``
+(zenflow_stage_1_and_2.py:47) and ``ZenFlowConfig`` (zenflow_config.py:12).
+The reference's mechanism: each step, the top-k "important" gradient
+columns are applied immediately on the accelerator; the remaining
+gradients are accumulated and applied on the CPU every
+``update_interval`` steps, asynchronously, so the device never stalls on
+the full CPU optimizer pass.
+
+TPU translation of the same split:
+
+* fast path  — selected columns of each 2-D parameter get a vectorized
+  numpy Adam update at every gradient boundary (small slices; host cost
+  is a fraction of a full pass).  1-D parameters (norms/biases) are tiny
+  and always take the fast path.
+* slow path  — unselected gradients accumulate in a host buffer; every
+  ``update_interval`` boundaries the residual is applied by a background
+  thread while the device runs the next micro-batches.
+* merge      — the slow pass works on snapshots and its results are
+  merged at the next boundary; columns the fast path touched in the
+  overlap window keep their fast-path values (important columns are
+  owned by the fast path, exactly the reference's split).
+
+Interface-compatible with zero/offload.HostOffloadedOptimizer so the
+engine can swap it in via config (zero_optimization.zenflow block).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import ZenFlowConfig  # noqa: F401  (re-exported)
+from ...utils.logging import log_dist
+
+
+def _adam_update(master, g, m, v, step, lr, b1, b2, eps, wd, adamw):
+    """Vectorized numpy Adam(W) on (views of) master/m/v, in place."""
+    m *= b1
+    m += (1 - b1) * g
+    v *= b2
+    v += (1 - b2) * g * g
+    mh = m / (1 - b1 ** step)
+    vh = v / (1 - b2 ** step)
+    if adamw and wd:
+        master *= (1 - lr * wd)
+    master -= lr * mh / (np.sqrt(vh) + eps)
+
+
+class ZenFlowOptimizer:
+    """Host optimizer with the ZenFlow fast/slow split."""
+
+    def __init__(self, abstract_params: Any, optimizer_config: Dict[str, Any],
+                 zenflow_config: Optional[ZenFlowConfig] = None,
+                 grad_clip: float = 0.0):
+        p = dict(optimizer_config.get("params") or {})
+        betas = p.get("betas", (0.9, 0.999))
+        self.lr = float(p.get("lr", 1e-3))
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(p.get("eps", 1e-8))
+        self.wd = float(p.get("weight_decay", 0.0))
+        self.adamw = bool(p.get("adam_w_mode", True)) or \
+            str(optimizer_config.get("type", "adamw")).lower().endswith("w")
+        self.zf = zenflow_config or ZenFlowConfig(enabled=True)
+        self.grad_clip = grad_clip
+
+        self.leaves, self.treedef = (jax.tree_util.tree_flatten(abstract_params)
+                                     if abstract_params is not None else ([], None))
+        self.master: List[np.ndarray] = []
+        self._m: List[np.ndarray] = []
+        self._v: List[np.ndarray] = []
+        self._accum: List[np.ndarray] = []
+        # columns written by the fast path since the running slow pass launched
+        self._fast_mask: List[Optional[np.ndarray]] = []
+        self.step_count = 0
+        self._slow_thread: Optional[threading.Thread] = None
+        self._slow_result: Optional[Tuple[List, List, List]] = None
+
+    # -- lifecycle (mirrors HostOffloadedOptimizer) -------------------------
+    def initialize_master(self, init_params: Any) -> None:
+        flat = jax.tree_util.tree_leaves(init_params)
+        self.master = [np.asarray(jax.device_get(x), np.float32).copy() for x in flat]
+        self._m = [np.zeros_like(x) for x in self.master]
+        self._v = [np.zeros_like(x) for x in self.master]
+        self._accum = [np.zeros_like(x) for x in self.master]
+        self._fast_mask = [None] * len(self.master)
+        log_dist(f"zenflow: {sum(x.size for x in self.master) / 1e6:.1f}M master "
+                 f"elements; topk_ratio={self.zf.topk_ratio} "
+                 f"interval={self.zf.update_interval}")
+
+    # -- slow path ----------------------------------------------------------
+    def _slow_pass(self, snap_master, snap_m, snap_v, snap_accum, step, lr):
+        denom = float(self.zf.update_interval)
+        for i in range(len(snap_master)):
+            g = snap_accum[i] / denom
+            nz = g != 0  # only elements with accumulated (slow-path) gradient
+            if not nz.any():
+                continue
+            x0, m0, v0 = snap_master[i].copy(), snap_m[i].copy(), snap_v[i].copy()
+            _adam_update(snap_master[i], g, snap_m[i], snap_v[i], step,
+                         lr, self.b1, self.b2, self.eps, self.wd, self.adamw)
+            snap_master[i][~nz] = x0[~nz]
+            snap_m[i][~nz] = m0[~nz]
+            snap_v[i][~nz] = v0[~nz]
+        self._slow_result = (snap_master, snap_m, snap_v)
+
+    def _join_slow(self) -> None:
+        if self._slow_thread is None:
+            return
+        self._slow_thread.join()
+        self._slow_thread = None
+        new_master, new_m, new_v = self._slow_result
+        self._slow_result = None
+        for i in range(len(self.master)):
+            mask = self._fast_mask[i]
+            if mask is not None and mask.any():
+                # important columns are owned by the fast path: keep the
+                # values it wrote during the overlap window
+                new_master[i][..., mask] = self.master[i][..., mask]
+                new_m[i][..., mask] = self._m[i][..., mask]
+                new_v[i][..., mask] = self._v[i][..., mask]
+            self.master[i] = new_master[i]
+            self._m[i] = new_m[i]
+            self._v[i] = new_v[i]
+            self._fast_mask[i] = None
+
+    def _launch_slow(self, lr: float) -> None:
+        snap = ([x.copy() for x in self.master], [x.copy() for x in self._m],
+                [x.copy() for x in self._v], [x.copy() for x in self._accum])
+        for a in self._accum:
+            a[...] = 0.0
+        for i, x in enumerate(self.master):
+            self._fast_mask[i] = (np.zeros(x.shape[-1], bool)
+                                  if x.ndim >= 2 else None)
+        if self.zf.overlap_step:
+            self._slow_thread = threading.Thread(
+                target=self._slow_pass, args=(*snap, self.step_count, lr),
+                daemon=True)
+            self._slow_thread.start()
+        else:
+            self._slow_pass(*snap, self.step_count, lr)
+            self._slow_thread = None
+            new_master, new_m, new_v = self._slow_result
+            self._slow_result = None
+            self.master, self._m, self._v = new_master, new_m, new_v
+            self._fast_mask = [None] * len(self.master)
+
+    # -- the boundary step --------------------------------------------------
+    def apply_step(self, grads_flat: List[np.ndarray], lr: float,
+                   denom: float) -> Tuple[List[np.ndarray], float]:
+        self._join_slow()
+        self.step_count += 1
+        step = self.step_count
+        self.lr = lr
+
+        gs = [np.asarray(g, np.float32).reshape(self.master[i].shape) / denom
+              for i, g in enumerate(grads_flat)]
+        norm = float(np.sqrt(sum(float(np.vdot(g, g)) for g in gs)))
+        if self.grad_clip > 0 and norm > self.grad_clip:
+            scale = self.grad_clip / (norm + 1e-6)
+            gs = [g * scale for g in gs]
+
+        warm = step <= self.zf.full_warm_up_rounds
+        for i, g in enumerate(gs):
+            x = self.master[i]
+            if warm or x.ndim < 2 or self.zf.topk_ratio >= 1.0:
+                _adam_update(x, g, self._m[i], self._v[i], step, lr,
+                             self.b1, self.b2, self.eps, self.wd, self.adamw)
+                continue
+            ncols = x.shape[-1]
+            k = max(1, int(round(self.zf.topk_ratio * ncols)))
+            col_imp = np.sum(g * g, axis=tuple(range(g.ndim - 1)))
+            sel = np.argpartition(col_imp, ncols - k)[ncols - k:]
+            # fast path: immediate update of the important columns.  Fancy
+            # indexing copies, so gather → update → scatter back.
+            xs, gsel = x[..., sel], g[..., sel]
+            ms, vs = self._m[i][..., sel], self._v[i][..., sel]
+            _adam_update(xs, gsel, ms, vs, step, lr, self.b1, self.b2,
+                         self.eps, self.wd, self.adamw)
+            x[..., sel] = xs
+            self._m[i][..., sel] = ms
+            self._v[i][..., sel] = vs
+            if self._fast_mask[i] is not None:
+                self._fast_mask[i][sel] = True
+            # slow path: everything else accumulates for the interval pass
+            self._accum[i] += g
+            self._accum[i][..., sel] = 0.0
+
+        if not warm and step % self.zf.update_interval == 0:
+            self._launch_slow(lr)
+        return self.master, norm
+
+    def master_as_tree(self, like: Any) -> Any:
+        self._join_slow()
+        flat = jax.tree_util.tree_leaves(like)
+        arrs = [m.reshape(x.shape) for m, x in zip(self.master, flat)]
+        return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(like), arrs)
+
+    def state_dict(self) -> Dict[str, Any]:
+        self._join_slow()
+        return {"step": self.step_count,
+                "master": [x.copy() for x in self.master],
+                "m": [x.copy() for x in self._m],
+                "v": [x.copy() for x in self._v],
+                "accum": [x.copy() for x in self._accum]}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self._join_slow()
+        self.step_count = int(sd["step"])
+        self.master = [np.asarray(x, np.float32) for x in sd["master"]]
+        self._m = [np.asarray(x, np.float32) for x in sd["m"]]
+        self._v = [np.asarray(x, np.float32) for x in sd["v"]]
+        self._accum = [np.asarray(x, np.float32) for x in sd["accum"]]
+        self._fast_mask = [None] * len(self.master)
