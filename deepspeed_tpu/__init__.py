@@ -78,7 +78,12 @@ def initialize(args: Any = None,
     if topology is None:
         topology = initialize_topology(ds_config.mesh)
 
-    engine = DeepSpeedTPUEngine(
+    engine_cls = DeepSpeedTPUEngine
+    if ds_config.hybrid_engine.enabled:
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+
+        engine_cls = DeepSpeedHybridEngine
+    engine = engine_cls(
         model=model,
         config=ds_config,
         topology=topology,

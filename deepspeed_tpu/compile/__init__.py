@@ -1,0 +1,1 @@
+from .backend import PASS_REGISTRY, compile_engine  # noqa: F401

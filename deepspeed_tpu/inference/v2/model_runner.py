@@ -64,9 +64,11 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
         scores = jnp.where(causal, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, S, -1)
-        x = x + (attn @ layer["attn"]["wo"]
-                 + (layer["attn"]["bo"] if cfg.use_bias else 0))
-        return _ffn(cfg, layer, x), (k_c, v_c)
+        attn_delta = (attn @ layer["attn"]["wo"]
+                      + (layer["attn"]["bo"] if cfg.use_bias else 0))
+        if cfg.parallel_block:
+            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c)
+        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c)
 
     x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
     hidden = _norm(x[:, length - 1], params["final_norm"]["scale"],
@@ -112,9 +114,11 @@ def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
         scores = jnp.where(vis[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
-        x = x + (attn @ layer["attn"]["wo"]
-                 + (layer["attn"]["bo"] if cfg.use_bias else 0))
-        return _ffn(cfg, layer, x), (k_c, v_c)
+        attn_delta = (attn @ layer["attn"]["wo"]
+                      + (layer["attn"]["bo"] if cfg.use_bias else 0))
+        if cfg.parallel_block:
+            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c)
+        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c)
 
     x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
     hidden = _norm(x, params["final_norm"]["scale"],

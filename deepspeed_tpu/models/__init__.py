@@ -1,4 +1,7 @@
 from .bert import bert_config, bert_model
+from .families import (falcon_config, falcon_model, mistral_config,
+                       mistral_model, opt_config, opt_model, phi_config,
+                       phi_model, qwen_config, qwen_model)
 from .gpt2 import gpt2_config, gpt2_model
 from .llama import llama_config, llama_model
 from .mixtral import mixtral_config, mixtral_model
@@ -6,4 +9,6 @@ from .transformer import TransformerConfig
 
 __all__ = ["bert_config", "bert_model", "gpt2_config", "gpt2_model",
            "llama_config", "llama_model", "mixtral_config", "mixtral_model",
-           "TransformerConfig"]
+           "mistral_config", "mistral_model", "qwen_config", "qwen_model",
+           "phi_config", "phi_model", "opt_config", "opt_model",
+           "falcon_config", "falcon_model", "TransformerConfig"]

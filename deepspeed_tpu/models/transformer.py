@@ -52,6 +52,9 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dropout: float = 0.0
     use_bias: bool = False  # gpt2/bert style proj biases
+    qkv_bias: bool = False  # bias on q/k/v only (qwen2 style)
+    rotary_pct: float = 1.0  # fraction of head_dim under rope (phi/neox)
+    parallel_block: bool = False  # x + attn(ln1 x) + mlp(ln2 x) (falcon/phi)
     dtype: Any = jnp.float32  # params storage dtype at init (engine recasts)
     remat: bool = False
     remat_policy: str = "nothing_saveable"
@@ -133,10 +136,11 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     else:
         layers["mlp"]["w_up"] = nrm(keys[8], L, H, F)
         layers["mlp"]["w_down"] = nrm(keys[9], L, F, H, s=proj_out_std)
-    if cfg.use_bias:
+    if cfg.use_bias or cfg.qkv_bias:
         layers["attn"]["bq"] = jnp.zeros((L, NH * D), dt)
         layers["attn"]["bk"] = jnp.zeros((L, KVH * D), dt)
         layers["attn"]["bv"] = jnp.zeros((L, KVH * D), dt)
+    if cfg.use_bias:
         layers["attn"]["bo"] = jnp.zeros((L, H), dt)
         layers["mlp"]["b_up"] = jnp.zeros((L, F), dt)
         layers["mlp"]["b_down"] = jnp.zeros((L, H), dt)
@@ -192,15 +196,19 @@ def _norm(x, scale, bias, kind: str, eps: float):
     return out.astype(x.dtype)
 
 
-def _rope(x, theta: float, positions):
-    """Rotary embedding on [..., S, NH, D]."""
-    d = x.shape[-1]
+def _rope(x, theta: float, positions, pct: float = 1.0):
+    """Rotary embedding on [..., S, NH, D]; ``pct`` < 1 rotates only the
+    leading fraction of the head dim (phi/gpt-neox partial rotary)."""
+    d_full = x.shape[-1]
+    d = d_full if pct >= 1.0 else (int(d_full * pct) // 2) * 2
+    x_rot, x_pass = x[..., :d], x[..., d:]
     freqs = jnp.exp(-jnp.arange(0, d, 2, dtype=jnp.float32) / d * math.log(theta))
-    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,S,1,D/2]
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,S,1,d/2]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    x1, x2 = jnp.split(x_rot.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    out = out.astype(x.dtype)
+    return out if d == d_full else jnp.concatenate([out, x_pass], axis=-1)
 
 
 def xla_attention(q, k, v, causal: bool, mask=None):
@@ -266,13 +274,14 @@ def attn_qkv(cfg: TransformerConfig, layer, x, positions):
     B, T, _ = x.shape
     NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     a = layer["attn"]
+    qb = cfg.use_bias or cfg.qkv_bias
     h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
-    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, T, NH, D)
-    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
-    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
+    q = (h @ a["wq"] + (a["bq"] if qb else 0)).reshape(B, T, NH, D)
+    k = (h @ a["wk"] + (a["bk"] if qb else 0)).reshape(B, T, KVH, D)
+    v = (h @ a["wv"] + (a["bv"] if qb else 0)).reshape(B, T, KVH, D)
     if cfg.position == "rope":
-        q = _rope(q, cfg.rope_theta, positions)
-        k = _rope(k, cfg.rope_theta, positions)
+        q = _rope(q, cfg.rope_theta, positions, cfg.rotary_pct)
+        k = _rope(k, cfg.rope_theta, positions, cfg.rotary_pct)
     return q, k, v
 
 
@@ -293,7 +302,8 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
     elif cfg.activation == "swiglu":
         h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
     else:
-        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
+        act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+        h = act(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
         if cfg.use_bias:
             h = h + m["b_down"]
     return x + h, aux
@@ -310,8 +320,12 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
     v = _repeat_kv(v, NH // KVH)
     attn = attn_fn(q, k, v, cfg.causal, mask)
     attn = attn.reshape(B, S, NH * D)
-    x = x + (attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0))
-    return mlp_block(cfg, layer, x)
+    attn_delta = attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0)
+    if cfg.parallel_block:
+        # falcon/phi: attention and MLP both read the block input
+        out, aux = mlp_block(cfg, layer, x)
+        return out + attn_delta, aux
+    return mlp_block(cfg, layer, x + attn_delta)
 
 
 def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None):
@@ -437,14 +451,8 @@ def _block_decode(cfg: TransformerConfig, x, layer, k_cache, v_cache, position):
     NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     a = layer["attn"]
 
-    h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
-    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, T, NH, D)
-    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
-    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, T, KVH, D)
     positions = position[:, None] + jnp.arange(T)[None, :]
-    if cfg.position == "rope":
-        q = _rope(q, cfg.rope_theta, positions)
-        k = _rope(k, cfg.rope_theta, positions)
+    q, k, v = attn_qkv(cfg, layer, x, positions)
 
     # write new k/v into the cache at [position, position+T)
     def upd(cache, new):
@@ -464,25 +472,12 @@ def _block_decode(cfg: TransformerConfig, x, layer, k_cache, v_cache, position):
     scores = jnp.where(slot <= limit, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, T, NH * D)
-    x = x + (attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0))
-
-    h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"), cfg.norm, cfg.norm_eps)
-    m = layer["mlp"]
-    if cfg.moe_experts > 0:
-        from ..moe.sharded_moe import MoEConfig, moe_ffn
-
-        moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
-                            capacity_factor=cfg.moe_capacity_factor,
-                            aux_loss_coef=cfg.moe_aux_coef)
-        h, _ = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
-                       training=False)
-    elif cfg.activation == "swiglu":
-        h = (jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])) @ m["w_down"]
-    else:
-        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0)) @ m["w_down"]
-        if cfg.use_bias:
-            h = h + m["b_down"]
-    return x + h, k_cache, v_cache
+    attn_delta = attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0)
+    if cfg.parallel_block:
+        out, _ = mlp_block(cfg, layer, x, training=False)
+        return out + attn_delta, k_cache, v_cache
+    out, _ = mlp_block(cfg, layer, x + attn_delta, training=False)
+    return out, k_cache, v_cache
 
 
 def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache,

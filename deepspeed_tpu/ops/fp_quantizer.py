@@ -1,0 +1,117 @@
+"""Floating-point quantization (FP8/FP6/FP12-style).
+
+Reference parity: ``csrc/fp_quantizer/`` (fp_quantize.cu + fp_quantize.py
+``FP_Quantize``) — groupwise scaled float quantization used for
+weight-only inference quantization and fp-quantized comm.
+
+TPU translation: fp8 uses the native ``float8_e4m3fn`` / ``float8_e5m2``
+dtypes (MXU-native on newer TPU generations); sub-byte widths (fp6/fp4)
+are emulated by mantissa rounding on top of the fp8 grid — the value set
+matches an e3m2/e2m1 format, stored in an fp8 carrier.  All paths use
+per-group absmax scaling like the reference (group_size elements share
+one fp32 scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+_FP8_DTYPE = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
+# emulated sub-byte formats: keep `mbits` mantissa bits of the fp8 value
+_EMULATED = {6: 2, 4: 1}  # q_bits -> mantissa bits kept (e3m2 / e2m1 style)
+
+
+@dataclasses.dataclass
+class FPQuantizerConfig:
+    group_size: int = 512
+    q_bits: int = 8
+    fmt: str = "e4m3"  # e4m3 | e5m2 (fp8 carrier format)
+
+
+class FP_Quantize:
+    """Groupwise FP quantizer (reference fp_quantizer/fp_quantize.py API)."""
+
+    def __init__(self, group_size: int = 512, q_bits: int = 8,
+                 fmt: str = "e4m3"):
+        if fmt not in _FP8_DTYPE:
+            raise ValueError(f"fmt must be e4m3|e5m2, got {fmt}")
+        if q_bits != 8 and q_bits not in _EMULATED:
+            raise ValueError(f"q_bits must be 8, 6 or 4, got {q_bits}")
+        self.config = FPQuantizerConfig(group_size, q_bits, fmt)
+
+    # -- core ---------------------------------------------------------------
+    def quantize(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """x (any shape) -> (codes fp8 [G, group], scales fp32 [G, 1]).
+
+        Values are scaled per group so the group absmax maps to the format's
+        max normal; sub-byte widths additionally round the mantissa.
+        """
+        cfg = self.config
+        flat = x.reshape(-1).astype(jnp.float32)
+        n = flat.size
+        pad = (-n) % cfg.group_size
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        g = flat.reshape(-1, cfg.group_size)
+        absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+        scale = jnp.maximum(absmax, 1e-30) / _FP8_MAX[cfg.fmt]
+        y = g / scale
+        if cfg.q_bits in _EMULATED:
+            y = _round_mantissa(y, _EMULATED[cfg.q_bits])
+        # mantissa round-up at absmax can exceed the format's finite range;
+        # e4m3fn has no inf, so an unclipped cast would produce NaN
+        y = jnp.clip(y, -_FP8_MAX[cfg.fmt], _FP8_MAX[cfg.fmt])
+        codes = y.astype(_FP8_DTYPE[cfg.fmt])
+        return codes, scale.astype(jnp.float32)
+
+    def dequantize(self, codes: jnp.ndarray, scales: jnp.ndarray,
+                   orig_shape, dtype=jnp.float32) -> jnp.ndarray:
+        n = 1
+        for d in orig_shape:
+            n *= int(d)
+        x = codes.astype(jnp.float32) * scales
+        return x.reshape(-1)[:n].reshape(orig_shape).astype(dtype)
+
+    # torch-API-compatible aliases (reference FP_Quantize.quantize returns
+    # a packed tensor; we return (codes, scales) — selective_dequantize and
+    # get_scales mirror the reference surface)
+    def get_scales(self, scales: jnp.ndarray) -> jnp.ndarray:
+        return scales
+
+    def selective_dequantize(self, codes, scales, indices, orig_shape,
+                             dtype=jnp.float32):
+        """Dequantize only the given group rows (reference
+        selective_dequantize for partial fetches)."""
+        sel = codes[indices].astype(jnp.float32) * scales[indices]
+        return sel.astype(dtype)
+
+
+def _round_mantissa(y: jnp.ndarray, mbits: int) -> jnp.ndarray:
+    """Round fp32 values to ``mbits`` mantissa bits (round-to-nearest-even)
+    — the value grid of an emulated narrow float format."""
+    bits = jax.lax.bitcast_convert_type(y.astype(jnp.float32), jnp.int32)
+    drop = 23 - mbits
+    round_bit = jnp.int32(1) << (drop - 1)
+    mask = ~((jnp.int32(1) << drop) - 1)
+    # round-half-to-even on the dropped bits
+    lsb = (bits >> drop) & 1
+    rounded = (bits + round_bit - 1 + lsb) & mask
+    return jax.lax.bitcast_convert_type(rounded, jnp.float32)
+
+
+def quantize_fp8(x: jnp.ndarray, group_size: int = 512,
+                 fmt: str = "e4m3") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Functional fp8 quant (module-level convenience)."""
+    return FP_Quantize(group_size, 8, fmt).quantize(x)
+
+
+def dequantize_fp8(codes: jnp.ndarray, scales: jnp.ndarray, orig_shape,
+                   dtype=jnp.float32, group_size: int = 512,
+                   fmt: str = "e4m3") -> jnp.ndarray:
+    return FP_Quantize(group_size, 8, fmt).dequantize(codes, scales,
+                                                      orig_shape, dtype)

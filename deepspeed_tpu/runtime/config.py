@@ -232,6 +232,20 @@ class CheckpointConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class HybridEngineConfig(ConfigModel):
+    """hybrid_engine block (reference runtime/hybrid_engine.py config):
+    RLHF-style flip-flopping between training and generation on one copy
+    of the weights."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@dataclasses.dataclass
 class GradientCompressionConfig(ConfigModel):
     """1-bit / compressed-communication style gradient compression."""
 
@@ -277,6 +291,7 @@ class DeepSpeedConfig:
     aio: AIOConfig
     checkpoint: CheckpointConfig
     compression: GradientCompressionConfig
+    hybrid_engine: HybridEngineConfig
     zero_allow_untested_optimizer: bool
     gradient_accumulation_dtype: str
 
@@ -323,6 +338,7 @@ class DeepSpeedConfig:
         self.aio = AIOConfig.from_dict(g("aio"))
         self.checkpoint = CheckpointConfig.from_dict(g("checkpoint"))
         self.compression = GradientCompressionConfig.from_dict(g("gradient_compression"))
+        self.hybrid_engine = HybridEngineConfig.from_dict(g("hybrid_engine"))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")

@@ -1,0 +1,132 @@
+"""Data routing: random-LTD and progressive layer drop (PLD).
+
+Reference parity:
+* random-LTD — ``runtime/data_pipeline/data_routing/`` + csrc/random_ltd:
+  each middle layer processes only a random subset of tokens; the kept
+  count follows a linear schedule from ``start_token_budget`` to the full
+  sequence, and dropped tokens bypass the layer (identity).  The reference
+  sorts/gathers with CUDA kernels; XLA's gather/scatter fuse fine on TPU
+  (SURVEY §2.4 random-LTD row).
+* PLD — ``runtime/progressive_layer_drop.py``: layer *i* of *L* is kept
+  with probability ``p_i(t) = (theta(t)) ** (i / L)``-style schedule,
+  theta decaying from 1 toward ``theta_min`` with factor ``gamma``; kept
+  layers rescale activations at eval.
+
+Both integrate with the scan-layers transformer through pure functions:
+``random_ltd_apply(block_fn, x, keep_idx)`` and
+``pld_apply(block_fn, x, keep, theta)`` — jit-safe (fixed shapes: the
+token budget is static per compilation; schedules step per boundary like
+the reference's schedulers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ------------------------------------------------------------- random-LTD
+@dataclasses.dataclass
+class RandomLTDConfig:
+    """random_ltd block of data_efficiency config (reference
+    data_pipeline/config.py random_ltd keys)."""
+
+    enabled: bool = False
+    total_layer_num: int = 12
+    random_ltd_layer_num: int = 8  # middle layers under LTD
+    start_token_budget: int = 128
+    schedule_steps: int = 1000  # linear ramp to the full sequence
+
+    def token_budget(self, step: int, seq_len: int) -> int:
+        """Kept-token count at ``step`` (reference BaseScheduler linear)."""
+        if not self.enabled or step >= self.schedule_steps:
+            return seq_len
+        frac = step / max(1, self.schedule_steps)
+        k = int(self.start_token_budget +
+                frac * (seq_len - self.start_token_budget))
+        return min(max(k, 1), seq_len)
+
+
+def random_ltd_indices(rng: jax.Array, seq_len: int, budget: int,
+                       batch: int) -> jnp.ndarray:
+    """Sample ``budget`` kept token positions per batch row, sorted
+    (reference token_sort kernel).  [B, budget] int32."""
+    def one(r):
+        return jnp.sort(jax.random.permutation(r, seq_len)[:budget])
+
+    return jax.vmap(one)(jax.random.split(rng, batch))
+
+
+def random_ltd_apply(block_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                     x: jnp.ndarray, keep_idx: jnp.ndarray) -> jnp.ndarray:
+    """Run ``block_fn`` on the kept tokens only; dropped tokens pass
+    through unchanged (reference gather→layer→scatter data path).
+
+    x: [B, S, H]; keep_idx: [B, K] sorted positions.
+    """
+    B = x.shape[0]
+    gathered = jnp.take_along_axis(x, keep_idx[..., None], axis=1)  # [B, K, H]
+    processed = block_fn(gathered)
+    return x.at[jnp.arange(B)[:, None], keep_idx].set(processed)
+
+
+# ------------------------------------------------------------------ PLD
+@dataclasses.dataclass
+class PLDConfig:
+    """progressive_layer_drop block (reference
+    runtime/progressive_layer_drop.py ProgressiveLayerDrop)."""
+
+    enabled: bool = False
+    theta: float = 0.5  # asymptotic keep probability
+    gamma: float = 0.001  # decay speed
+
+
+class ProgressiveLayerDrop:
+    """Keep-probability schedule (reference ProgressiveLayerDrop.update_state):
+    theta(t) = (1 - theta_bar) * exp(-gamma t) + theta_bar."""
+
+    def __init__(self, config: Optional[PLDConfig] = None,
+                 theta: float = 0.5, gamma: float = 0.001):
+        cfg = config or PLDConfig(enabled=True, theta=theta, gamma=gamma)
+        self.config = cfg
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        c = self.config
+        self.current_theta = float(
+            (1.0 - c.theta) * np.exp(-c.gamma * global_step) + c.theta)
+        return self.current_theta
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def layer_keep_prob(self, layer_idx: int, num_layers: int) -> float:
+        """Deeper layers drop more (reference: p_l = theta ** (l / L) shape
+        — keep probability decreases with depth)."""
+        depth_frac = (layer_idx + 1) / max(1, num_layers)
+        return float(self.current_theta ** depth_frac)
+
+
+def pld_apply(block_fn: Callable[[jnp.ndarray], jnp.ndarray],
+              x: jnp.ndarray, rng: jax.Array, keep_prob: float,
+              training: bool = True) -> jnp.ndarray:
+    """Stochastically skip a block (identity) with prob 1-keep_prob;
+    at eval, run it always (expectation-preserving residual scaling is the
+    block's residual-branch scale, matching stochastic depth)."""
+    if not training or keep_prob >= 1.0:
+        return block_fn(x)
+    keep = jax.random.bernoulli(rng, keep_prob)
+    # lax.cond executes one branch at runtime: skipped layers cost nothing;
+    # the kept branch rescales the block delta to preserve the expectation
+    return jax.lax.cond(
+        keep,
+        lambda v: v + (block_fn(v) - v) / keep_prob,
+        lambda v: v,
+        x)

@@ -70,14 +70,15 @@ def _attn_partial(cfg: TransformerConfig, lyr, xc, positions, tp: int):
     D = cfg.head_dim
     nh_loc, kvh_loc = cfg.n_heads // tp, cfg.kv_heads // tp
     a = lyr["attn"]
+    qb = cfg.use_bias or cfg.qkv_bias
     h = _norm(xc, lyr["norm1"]["scale"], lyr["norm1"].get("bias"),
               cfg.norm, cfg.norm_eps)
-    q = (h @ a["wq"] + (a["bq"] if cfg.use_bias else 0)).reshape(B, S, nh_loc, D)
-    k = (h @ a["wk"] + (a["bk"] if cfg.use_bias else 0)).reshape(B, S, kvh_loc, D)
-    v = (h @ a["wv"] + (a["bv"] if cfg.use_bias else 0)).reshape(B, S, kvh_loc, D)
+    q = (h @ a["wq"] + (a["bq"] if qb else 0)).reshape(B, S, nh_loc, D)
+    k = (h @ a["wk"] + (a["bk"] if qb else 0)).reshape(B, S, kvh_loc, D)
+    v = (h @ a["wv"] + (a["bv"] if qb else 0)).reshape(B, S, kvh_loc, D)
     if cfg.position == "rope":
-        q = _rope(q, cfg.rope_theta, positions)
-        k = _rope(k, cfg.rope_theta, positions)
+        q = _rope(q, cfg.rope_theta, positions, cfg.rotary_pct)
+        k = _rope(k, cfg.rope_theta, positions, cfg.rotary_pct)
     k = _repeat_kv(k, nh_loc // kvh_loc)
     v = _repeat_kv(v, nh_loc // kvh_loc)
     scores = jnp.einsum("btnd,bsnd->bnts", q, k).astype(jnp.float32)
@@ -99,7 +100,8 @@ def _mlp_partial(cfg: TransformerConfig, lyr, xc):
     if cfg.activation == "swiglu":
         h = jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])
     else:
-        h = jax.nn.gelu(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0))
+        act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+        h = act(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0))
     return h @ m["w_down"]
 
 
@@ -145,6 +147,9 @@ def domino_transformer_forward(cfg: TransformerConfig, params, input_ids,
     if cfg.moe_experts > 0:
         raise ValueError("Domino covers dense blocks; route MoE through "
                          "moe/sharded_moe expert parallelism instead")
+    if cfg.parallel_block:
+        raise ValueError("Domino implements the sequential block order; "
+                         "parallel_block models are unsupported")
     B = input_ids.shape[0]
     if B % n_chunks:
         raise ValueError(f"batch {B} not divisible by n_chunks {n_chunks}")

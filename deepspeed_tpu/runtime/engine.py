@@ -227,9 +227,13 @@ class DeepSpeedTPUEngine:
             with self.topology.mesh:
                 params = init_fn(init_rng)
 
-            opt_state = jax.jit(
-                self.optimizer.init,
-                out_shardings=None)(params)  # moments inherit param shardings via XLA
+                # moments shard like the master weights (ZeRO stage>=1
+                # partitions optimizer state); the plan's path-regex rules
+                # match the mu/nu subtrees because they mirror the param tree
+                abstract_opt = jax.eval_shape(self.optimizer.init, params)
+                opt_shardings = self.zero_plan.tree_shardings(abstract_opt, "master")
+                opt_state = jax.jit(
+                    self.optimizer.init, out_shardings=opt_shardings)(params)
         grad_acc = jax.jit(
             lambda p: jax.tree_util.tree_map(
                 lambda x: jnp.zeros(x.shape, self.grad_accum_dtype), p),
@@ -286,6 +290,16 @@ class DeepSpeedTPUEngine:
         denom = jnp.asarray(float(gas), jnp.float32)
         if self.fp16_enabled:
             denom = denom * state.loss_scale.cur_scale
+
+        if getattr(self, "_opt_dev_shardings", None) is not None:
+            # host-offloaded moments (compile offload_adam_states pass):
+            # stream them into device memory for the update; results return
+            # to host via out_shardings (TPU) or _repin_opt_state (host
+            # platforms).  "keep" entries (scalar leaves) never moved.
+            opt_state = jax.tree_util.tree_map(
+                lambda x, s: x if s == "keep" else jax.device_put(x, s),
+                state.opt_state, self._opt_dev_shardings)
+            state = dataclasses.replace(state, opt_state=opt_state)
 
         grads = jax.tree_util.tree_map(
             lambda g: (g.astype(jnp.float32) / denom), state.grad_acc)
@@ -348,18 +362,65 @@ class DeepSpeedTPUEngine:
         state, losses = jax.lax.scan(body, state, (batches, rngs))
         return state, jnp.mean(losses)
 
-    def _compile_steps(self) -> None:
+    def _compile_steps(self, opt_state_memory_kind: Optional[str] = None) -> None:
         donate = dict(donate_argnums=(0,))
         self._micro_step = jax.jit(self._micro_step_body, **donate)
+        self._eval_fn = None
         if self.offload_optimizer is not None:
             # the boundary update runs on host (C++ SIMD Adam); the device
             # program is micro-steps only
             self._train_batch = jax.jit(self._micro_scan_body, **donate)
             self._apply_step = None
-        else:
-            self._apply_step = jax.jit(self._apply_step_body, **donate)
-            self._train_batch = jax.jit(self._train_batch_body, **donate)
-        self._eval_fn = None
+            return
+        if opt_state_memory_kind is not None:
+            # compile/backend.py offload_adam_states moved the moments to
+            # host memory.  "keep" marks leaves that never left device
+            # memory (scalars — annotating their placement trips the SPMD
+            # partitioner).  The step fetches moments to device
+            # (_apply_step_body); results return to host either via
+            # out_shardings (TPU: XLA streams them back inside the program)
+            # or via the eager _repin_opt_state fallback (host platforms,
+            # where memory-kind out_shardings are not lowerable).
+            self._opt_dev_shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding.with_memory_kind("device")
+                if hasattr(x, "sharding") and getattr(x, "ndim", 0) >= 1
+                else "keep",
+                self.state.opt_state)
+            if jax.default_backend() == "tpu":
+                state_sh = jax.tree_util.tree_map(
+                    lambda x: x.sharding if hasattr(x, "sharding") else None,
+                    self.state)
+                self._opt_host_shardings = None
+                self._apply_step = jax.jit(self._apply_step_body,
+                                           out_shardings=state_sh, **donate)
+                self._train_batch = jax.jit(self._train_batch_body,
+                                            out_shardings=(state_sh, None),
+                                            **donate)
+                return
+            self._opt_host_shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding if hasattr(x, "sharding") else "keep",
+                self.state.opt_state)
+        self._apply_step = jax.jit(self._apply_step_body, **donate)
+        self._train_batch = jax.jit(self._train_batch_body, **donate)
+
+    def _repin_opt_state(self) -> None:
+        """After a boundary step, spill the optimizer moments back to host
+        memory (offload_adam_states keeps them HBM-resident only inside the
+        step program)."""
+        if getattr(self, "_opt_host_shardings", None) is None:
+            return
+        self.state = dataclasses.replace(
+            self.state,
+            opt_state=jax.tree_util.tree_map(
+                lambda x, s: x if s == "keep" else jax.device_put(x, s),
+                self.state.opt_state, self._opt_host_shardings))
+
+    def compile(self, backend: str = "xla", passes=None):
+        """Apply DeepCompile-style passes to the step programs (reference
+        ``engine.compile()``, engine.py:4243; see compile/backend.py)."""
+        from ..compile import compile_engine
+
+        return compile_engine(self, backend=backend, passes=passes)
 
     # ------------------------------------------------------- offloaded step
     def _apply_step_offload(self) -> None:
@@ -414,6 +475,7 @@ class DeepSpeedTPUEngine:
         self.tput_timer.start()
         with self.topology.mesh:
             self.state, loss = self._train_batch(self.state, batch, self._next_rng())
+        self._repin_opt_state()
         if self.offload_optimizer is not None:
             self._apply_step_offload()
         self.global_steps += 1
@@ -467,6 +529,7 @@ class DeepSpeedTPUEngine:
             else:
                 with self.topology.mesh:
                     self.state = self._apply_step(self.state)
+                self._repin_opt_state()
             self.global_steps += 1
             self.lr_scheduler.step()
             if self.config.wall_clock_breakdown:

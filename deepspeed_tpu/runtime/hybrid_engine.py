@@ -1,0 +1,101 @@
+"""Hybrid engine: RLHF train + generate on one copy of the weights.
+
+Reference parity: ``DeepSpeedHybridEngine`` (runtime/hybrid_engine.py:30) —
+during RLHF, the actor model alternates between ZeRO-3 training and
+generation; the reference shares the partitioned training parameters with
+its fused inference kernels so no second copy of the model exists, and
+flips between modes with ``eval()`` / ``train()``.
+
+TPU translation: the training engine's params are a sharded pytree already
+in compute dtype; ``generate()`` hands that *same* tree to a cached
+inference engine (inference/engine.py KV-cache decode programs).  The
+decode program takes params as an argument, so refreshed weights after
+each training step reuse the compiled program — the flip-flop costs one
+pointer swap, no re-injection and no gather (XLA reshards as needed
+between the training and inference shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..utils.logging import log_dist
+from .engine import DeepSpeedTPUEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedTPUEngine):
+    """Training engine that can also generate with its live weights."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_engine = None
+        self._in_eval = False
+        hcfg = self.config.hybrid_engine
+        log_dist(f"hybrid engine: max_out_tokens={hcfg.max_out_tokens} "
+                 f"inference_tp_size={hcfg.inference_tp_size}")
+
+    # -- mode flip (reference eval()/train() on the hybrid engine) ----------
+    def eval(self) -> None:
+        self._in_eval = True
+
+    def train(self, mode: bool = True) -> None:
+        self._in_eval = not mode
+
+    @property
+    def in_eval(self) -> bool:
+        return self._in_eval
+
+    # -- generation ---------------------------------------------------------
+    def _get_inference_engine(self):
+        if self._inference_engine is None:
+            from ..inference.engine import InferenceConfig, InferenceEngine
+            from ..models.transformer import TransformerConfig
+
+            if not hasattr(self.model, "config") or \
+                    not isinstance(self.model.config, TransformerConfig):
+                raise TypeError(
+                    "hybrid engine generation needs a models/* model carrying "
+                    "a TransformerConfig (models.llama_model / gpt2_model / ...)")
+            hcfg = self.config.hybrid_engine
+            icfg = InferenceConfig(
+                dtype={"bfloat16": "bf16", "float16": "fp16",
+                       "float32": "fp32"}.get(self.compute_dtype.__name__, "bf16"),
+                max_seq_len=self.model.config.max_seq_len,
+                max_out_tokens=hcfg.max_out_tokens,
+                # generation runs on the training mesh; the TP degree is the
+                # mesh's model axis (inference_tp_size is honored when it
+                # matches — a different degree would need a second mesh)
+                tensor_parallel={"tp_size": self.topology.model_parallel_size},
+            )
+            self._inference_engine = InferenceEngine(
+                self.model, icfg, params=self.state.params,
+                topology=self.topology)
+        return self._inference_engine
+
+    def refresh_inference_params(self) -> None:
+        """Point the generation path at the current training weights.
+
+        Cheap: the arrays are shared, not copied; the compiled decode
+        program takes params as a runtime argument."""
+        if self._inference_engine is not None:
+            self._inference_engine.params = self.state.params
+
+    def generate(self, input_ids, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, seed: int = 0) -> Any:
+        """Generate with the engine's live training weights
+        (reference hybrid_engine.generate)."""
+        was_eval = self._in_eval
+        self.eval()
+        try:
+            engine = self._get_inference_engine()
+            self.refresh_inference_params()
+            if max_new_tokens is None:
+                max_new_tokens = self.config.hybrid_engine.max_out_tokens
+            out = engine.generate(input_ids, max_new_tokens=max_new_tokens,
+                                  temperature=temperature, seed=seed)
+        finally:
+            self._in_eval = was_eval
+        if self.config.hybrid_engine.release_inference_cache:
+            # drop the cached engine (and its compiled programs + KV buffers)
+            self._inference_engine = None
+        return out

@@ -16,12 +16,13 @@ because clipping must see every gradient before any update starts.
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..zero.offload import HostOffloadedOptimizer
+from ..zero.offload import HostOffloadedOptimizer, scale_and_clip
 from ...utils.logging import log_dist
 
 
@@ -37,31 +38,30 @@ class SuperOffloadOptimizer(HostOffloadedOptimizer):
         self._pool = ThreadPoolExecutor(
             max_workers=self.cpu_worker_count,
             thread_name_prefix="superoffload-worker")
+        # the parent's AsyncIOHandle (NVMe spill path) is not thread-safe:
+        # drain() waits on and clears ALL in-flight ops, so concurrent
+        # fetch/spill from different workers would cross-cancel; serialize it
+        self._io_lock = threading.Lock()
         log_dist(f"superoffload: {self.cpu_worker_count} CPU optimizer workers")
 
     def apply_step(self, grads_flat: List[np.ndarray], lr: float,
                    denom: float) -> Tuple[List[np.ndarray], float]:
         # pass 1 (caller thread): scale + global norm — clipping needs the
         # full norm before any leaf updates
-        gs = []
-        sq = 0.0
-        for g in grads_flat:
-            g = np.asarray(g, np.float32).ravel() / denom
-            sq += float(np.dot(g, g))
-            gs.append(g)
-        norm = float(np.sqrt(sq))
-        if self.grad_clip > 0 and norm > self.grad_clip:
-            scale = self.grad_clip / (norm + 1e-6)
-            gs = [g * scale for g in gs]
+        gs, norm = scale_and_clip(grads_flat, denom, self.grad_clip)
 
         # pass 2: per-leaf Adam tasks on the worker pool (C++ kernel drops
         # the GIL, so leaves update on multiple cores concurrently)
         def task(i: int, g: np.ndarray) -> None:
             if self.master[i].size != g.size:
                 raise ValueError(f"grad/master size mismatch at leaf {i}")
-            self._fetch(i, g.size)
-            self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
-            self._spill(i)
+            if self._aio is not None:
+                with self._io_lock:
+                    self._fetch(i, g.size)
+                    self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
+                    self._spill(i)
+            else:
+                self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
 
         futures = [self._pool.submit(task, i, g) for i, g in enumerate(gs)]
         for f in futures:

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 
 from ..config import ZenFlowConfig  # noqa: F401  (re-exported)
+from ..zero.offload import scale_and_clip
 from ...utils.logging import log_dist
 
 
@@ -157,12 +158,8 @@ class ZenFlowOptimizer:
         step = self.step_count
         self.lr = lr
 
-        gs = [np.asarray(g, np.float32).reshape(self.master[i].shape) / denom
-              for i, g in enumerate(grads_flat)]
-        norm = float(np.sqrt(sum(float(np.vdot(g, g)) for g in gs)))
-        if self.grad_clip > 0 and norm > self.grad_clip:
-            scale = self.grad_clip / (norm + 1e-6)
-            gs = [g * scale for g in gs]
+        gs, norm = scale_and_clip(grads_flat, denom, self.grad_clip,
+                                  shapes=[x.shape for x in self.master])
 
         warm = step <= self.zf.full_warm_up_rounds
         for i, g in enumerate(gs):
@@ -186,9 +183,13 @@ class ZenFlowOptimizer:
             self._v[i][..., sel] = vs
             if self._fast_mask[i] is not None:
                 self._fast_mask[i][sel] = True
-            # slow path: everything else accumulates for the interval pass
-            self._accum[i] += g
-            self._accum[i][..., sel] = 0.0
+            # slow path: everything else accumulates for the interval pass.
+            # Zero only THIS step's contribution at the selected columns —
+            # residual from steps where they were unselected stays queued
+            # for the slow pass (zeroing the whole column would drop it).
+            g_slow = g.copy()
+            g_slow[..., sel] = 0.0
+            self._accum[i] += g_slow
 
         if not warm and step % self.zf.update_interval == 0:
             self._launch_slow(lr)

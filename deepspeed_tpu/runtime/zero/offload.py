@@ -21,6 +21,28 @@ from ...ops.cpu.adam import DeepSpeedCPUAdam
 from ...utils.logging import log_dist, logger
 
 
+def scale_and_clip(grads_flat: List[np.ndarray], denom: float,
+                   grad_clip: float,
+                   shapes: Optional[List[Tuple[int, ...]]] = None
+                   ) -> Tuple[List[np.ndarray], float]:
+    """Scale grads by 1/denom, compute the global norm, clip.  Shared by the
+    plain/SuperOffload/ZenFlow host optimizers so clipping semantics can't
+    drift between them.  ``shapes=None`` flattens each leaf (the C++ Adam
+    works on contiguous 1-D shards); otherwise leaves are reshaped."""
+    gs = []
+    sq = 0.0
+    for i, g in enumerate(grads_flat):
+        g = np.asarray(g, np.float32)
+        g = (g.ravel() if shapes is None else g.reshape(shapes[i])) / denom
+        sq += float(np.dot(g.ravel(), g.ravel()))
+        gs.append(g)
+    norm = float(np.sqrt(sq))
+    if grad_clip > 0 and norm > grad_clip:
+        scale = grad_clip / (norm + 1e-6)
+        gs = [g * scale for g in gs]
+    return gs, norm
+
+
 class HostOffloadedOptimizer:
     """Holds host master state and applies boundary steps."""
 
@@ -89,16 +111,7 @@ class HostOffloadedOptimizer:
                    denom: float) -> Tuple[List[np.ndarray], float]:
         """Run the C++ Adam on every leaf; returns (new master leaves,
         global grad norm)."""
-        sq = 0.0
-        gs = []
-        for g in grads_flat:
-            g = np.asarray(g, np.float32).ravel() / denom
-            sq += float(np.dot(g, g))
-            gs.append(g)
-        norm = float(np.sqrt(sq))
-        if self.grad_clip > 0 and norm > self.grad_clip:
-            scale = self.grad_clip / (norm + 1e-6)
-            gs = [g * scale for g in gs]
+        gs, norm = scale_and_clip(grads_flat, denom, self.grad_clip)
         for i, g in enumerate(gs):
             if self.master[i].size != g.size:
                 raise ValueError(f"grad/master size mismatch at leaf {i}")

@@ -1,0 +1,118 @@
+"""Block-sparse attention + fp quantizer tests (reference:
+tests/unit/ops/sparse_attention, tests/unit/ops/fp_quantizer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.fp_quantizer import (FP_Quantize, dequantize_fp8,
+                                            quantize_fp8)
+from deepspeed_tpu.ops.pallas.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, sparse_attention)
+
+B, S, H, D = 2, 512, 2, 64
+BLOCK = 128
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, S, H, D), jnp.float32) * 0.3
+    return mk(), mk(), mk()
+
+
+def _dense_masked(q, k, v, layout, causal):
+    """Numeric oracle: dense attention with the block mask expanded."""
+    mask = np.kron(np.asarray(layout), np.ones((BLOCK, BLOCK)))  # [H, S, S]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    s = jnp.where(jnp.asarray(mask[None]) > 0, s, -jnp.inf)
+    if causal:
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(cm[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+CONFIGS = [
+    DenseSparsityConfig(num_heads=H, block=BLOCK),
+    FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2,
+                        num_global_blocks=1),
+    BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                               num_sliding_window_blocks=3,
+                               global_block_indices=(0,)),
+    BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
+                          num_sliding_window_blocks=3, num_global_blocks=1),
+]
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: type(c).__name__)
+@pytest.mark.parametrize("causal", [True, False])
+def test_sparse_matches_dense_masked(cfg, causal):
+    q, k, v = _qkv()
+    layout = cfg.make_layout(S)
+    want = _dense_masked(q, k, v, layout, causal)
+    got = sparse_attention(q, k, v, cfg, causal=causal, impl="pallas")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_xla_impl_matches_pallas():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2)
+    q, k, v = _qkv(1)
+    a = sparse_attention(q, k, v, cfg, impl="pallas")
+    b = sparse_attention(q, k, v, cfg, impl="xla")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_layout_shapes_and_coverage():
+    cfg = BigBirdSparsityConfig(num_heads=4, block=BLOCK)
+    lay = cfg.make_layout(8 * BLOCK)
+    assert lay.shape == (4, 8, 8)
+    assert lay.any(axis=-1).all()  # every q block sees something
+    with pytest.raises(ValueError):
+        cfg.make_layout(BLOCK + 1)
+
+
+# ------------------------------------------------------------- fp quantizer
+def test_fp8_roundtrip_error():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1000), jnp.float32)
+    codes, scales = quantize_fp8(x, group_size=256)
+    y = dequantize_fp8(codes, scales, x.shape, group_size=256)
+    # e4m3 has ~2 decimal digits; relative error per element is bounded by
+    # 2^-3 after absmax scaling
+    err = np.abs(np.asarray(y) - np.asarray(x))
+    assert np.median(err / (np.abs(np.asarray(x)) + 1e-6)) < 0.07
+
+
+@pytest.mark.parametrize("q_bits,bound", [(8, 0.07), (6, 0.15), (4, 0.3)])
+def test_fp_bits_roundtrip(q_bits, bound):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(512) * 3.0, jnp.float32)
+    qz = FP_Quantize(group_size=128, q_bits=q_bits)
+    codes, scales = qz.quantize(x)
+    y = qz.dequantize(codes, scales, x.shape)
+    rel = np.abs(np.asarray(y) - np.asarray(x)) / (np.abs(np.asarray(x)) + 1e-6)
+    assert np.median(rel) < bound
+    # narrower formats must be (weakly) worse than wider ones
+    assert codes.dtype == jnp.float8_e4m3fn
+
+
+def test_fp_quantize_validation():
+    with pytest.raises(ValueError):
+        FP_Quantize(q_bits=5)
+    with pytest.raises(ValueError):
+        FP_Quantize(fmt="e2m5")
+
+
+def test_selective_dequantize():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 128), jnp.float32)
+    qz = FP_Quantize(group_size=128)
+    codes, scales = qz.quantize(x)
+    sel = qz.selective_dequantize(codes, scales, jnp.asarray([0, 2]), (2, 128))
+    full = qz.dequantize(codes, scales, (4, 128))
+    np.testing.assert_allclose(np.asarray(sel),
+                               np.asarray(full).reshape(4, 128)[[0, 2]])

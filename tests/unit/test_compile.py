@@ -1,0 +1,66 @@
+"""engine.compile() pass tests (reference: tests/unit/v1/compile, deepspeed/compile/)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+
+def _engine(**cfg_extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "gradient_clipping": 1.0,
+    }
+    cfg.update(cfg_extra)
+    engine, *_ = deepspeed_tpu.initialize(model=simple_mlp_spec(), config=cfg)
+    return engine
+
+
+def test_compile_default_passes():
+    engine = _engine()
+    out = engine.compile()
+    assert out is engine
+    assert engine.is_compiled
+    assert "zero3_compile" in engine.compile_passes_applied
+    losses = [float(engine.train_batch(random_batch(batch_size=16, seed=i % 4, gas=1)))
+              for i in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_compile_unknown_pass_raises():
+    engine = _engine()
+    with pytest.raises(KeyError):
+        engine.compile(passes=["not_a_pass"])
+    with pytest.raises(ValueError):
+        engine.compile(backend="tvm")
+
+
+def test_compile_offload_adam_states_still_trains():
+    engine = _engine()
+    l0 = float(engine.train_batch(random_batch(batch_size=16, seed=0, gas=1)))
+    engine.compile(passes=["offload_adam_states"])
+    losses = [float(engine.train_batch(random_batch(batch_size=16, seed=i % 4, gas=1)))
+              for i in range(10)]
+    assert losses[-1] < l0
+
+
+def test_compile_offload_activation_remat():
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=32)
+    assert not model.config.remat
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}}})
+    engine.compile(passes=["offload_activation"])
+    assert model.config.remat
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 256, (1, 2, 32)).astype(np.int32)
+    import jax.numpy as jnp
+
+    batch = {"input_ids": jnp.asarray(ids)}
+    losses = [float(engine.train_batch(batch)) for _ in range(8)]
+    assert losses[-1] < losses[0]

@@ -1,0 +1,137 @@
+"""Random-LTD, PLD, and data-analyzer tests (reference:
+tests/unit/runtime/data_efficiency)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer, load_difficulties, metric_seqlen, metric_total_vocab_freq)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    PLDConfig, ProgressiveLayerDrop, RandomLTDConfig, pld_apply,
+    random_ltd_apply, random_ltd_indices)
+
+
+# ------------------------------------------------------------- random-LTD
+def test_ltd_budget_schedule():
+    cfg = RandomLTDConfig(enabled=True, start_token_budget=16,
+                          schedule_steps=100)
+    assert cfg.token_budget(0, 64) == 16
+    assert cfg.token_budget(50, 64) == 40
+    assert cfg.token_budget(100, 64) == 64
+    assert cfg.token_budget(10_000, 64) == 64
+    assert RandomLTDConfig(enabled=False).token_budget(0, 64) == 64
+
+
+def test_ltd_apply_processes_only_kept():
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 4), jnp.float32)
+    keep = random_ltd_indices(jax.random.PRNGKey(0), 8, 3, 2)
+    assert keep.shape == (2, 3)
+    assert (np.diff(np.asarray(keep), axis=1) > 0).all()  # sorted, unique
+
+    out = random_ltd_apply(lambda h: h + 100.0, x, keep)
+    got = np.asarray(out)
+    ref = np.asarray(x)
+    for b in range(2):
+        kept = set(np.asarray(keep[b]).tolist())
+        for s in range(8):
+            if s in kept:
+                np.testing.assert_allclose(got[b, s], ref[b, s] + 100.0, rtol=1e-6)
+            else:
+                np.testing.assert_array_equal(got[b, s], ref[b, s])
+
+
+def test_ltd_jit_fixed_budget():
+    x = jnp.zeros((1, 16, 4))
+    f = jax.jit(lambda x, k: random_ltd_apply(lambda h: h + 1, x, k))
+    keep = random_ltd_indices(jax.random.PRNGKey(1), 16, 4, 1)
+    assert f(x, keep).shape == x.shape
+
+
+# ------------------------------------------------------------------- PLD
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(PLDConfig(enabled=True, theta=0.5, gamma=0.01))
+    assert pld.get_theta() == 1.0
+    t100 = pld.update_state(100)
+    t1000 = pld.update_state(1000)
+    assert 0.5 < t1000 < t100 < 1.0
+    assert abs(pld.update_state(10**6) - 0.5) < 1e-6
+    # deeper layers drop more
+    pld.update_state(1000)
+    assert pld.layer_keep_prob(0, 12) > pld.layer_keep_prob(11, 12)
+
+
+def test_pld_apply_eval_and_keep1():
+    x = jnp.ones((2, 4, 4))
+    blk = lambda v: v * 2  # noqa: E731
+    out = pld_apply(blk, x, jax.random.PRNGKey(0), keep_prob=0.5, training=False)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    out = pld_apply(blk, x, jax.random.PRNGKey(0), keep_prob=1.0)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_pld_apply_expectation():
+    x = jnp.ones((1, 2, 2))
+    blk = lambda v: v + 1.0  # noqa: E731
+    outs = [np.asarray(pld_apply(blk, x, jax.random.PRNGKey(i), keep_prob=0.5))
+            for i in range(400)]
+    mean = np.mean([o.mean() for o in outs])
+    # E[out] = x + keep_prob * (delta/keep_prob) = x + 1
+    assert abs(mean - 2.0) < 0.15
+
+
+# ----------------------------------------------------------- data analyzer
+def _dataset(n=20, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"input_ids": rng.randint(0, 50, size=rng.randint(4, 30))}
+            for _ in range(n)]
+
+
+def test_analyzer_map_reduce_single_worker(tmp_path):
+    ds = _dataset()
+    an = DataAnalyzer(ds, save_path=str(tmp_path))
+    an.run_map()
+    result = an.run_reduce()
+    vals = result["seqlen"]["index_to_metric"]
+    assert vals.shape == (20,)
+    np.testing.assert_allclose(vals, [len(s["input_ids"]) for s in ds])
+    order = result["seqlen"]["metric_to_sample"]
+    lens = np.asarray([len(ds[i]["input_ids"]) for i in order])
+    assert (np.diff(lens) >= 0).all()
+    assert load_difficulties(str(tmp_path), "seqlen").shape == (20,)
+
+
+def test_analyzer_multi_worker_matches_single(tmp_path):
+    ds = _dataset(31)
+    single = DataAnalyzer(ds, save_path=str(tmp_path / "s"))
+    single.run_map()
+    want = single.run_reduce()["seqlen"]["index_to_metric"]
+    for w in range(3):
+        DataAnalyzer(ds, save_path=str(tmp_path / "m"), num_workers=3,
+                     worker_id=w).run_map()
+    got = DataAnalyzer(ds, save_path=str(tmp_path / "m"),
+                       num_workers=3).run_reduce()["seqlen"]["index_to_metric"]
+    np.testing.assert_allclose(got, want)
+
+
+def test_vocab_rarity_metric(tmp_path):
+    freq = np.ones(50)
+    freq[0] = 1000  # token 0 very common
+    fn = metric_total_vocab_freq(freq)
+    common = fn({"input_ids": np.zeros(10, np.int64)})
+    rare = fn({"input_ids": np.full(10, 7, np.int64)})
+    assert rare > common  # rare tokens = harder
+
+    an = DataAnalyzer(_dataset(), metric_names=["rarity"],
+                      metric_functions=[fn], save_path=str(tmp_path))
+    an.run_map()
+    assert an.run_reduce()["rarity"]["index_to_metric"].shape == (20,)
+
+
+def test_analyzer_missing_shard_raises(tmp_path):
+    an = DataAnalyzer(_dataset(), save_path=str(tmp_path), num_workers=2,
+                      worker_id=0)
+    an.run_map()  # worker 1 never runs
+    with pytest.raises(FileNotFoundError):
+        an.run_reduce()

@@ -1,0 +1,79 @@
+"""Model family tests: mistral/qwen/phi/opt/falcon (reference:
+inference/v2/model_implementations/*, module_inject/containers/*)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import (falcon_model, mistral_model, opt_model,
+                                  phi_model, qwen_model)
+
+SEQ = 32
+FAMILIES = [mistral_model, qwen_model, phi_model, opt_model, falcon_model]
+
+
+def _batch(vocab, seed=0, bs=2):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": jnp.asarray(
+        rng.randint(0, vocab, (1, bs, SEQ)), jnp.int32)}
+
+
+@pytest.mark.parametrize("family", FAMILIES, ids=lambda f: f.__name__)
+def test_family_trains(family):
+    model = family("tiny", max_seq_len=SEQ)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                "zero_optimization": {"stage": 1}})
+    b = _batch(model.config.vocab_size)
+    losses = [float(engine.train_batch(b)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_family_structure_flags():
+    assert qwen_model("tiny").config.qkv_bias
+    assert not qwen_model("tiny").config.use_bias
+    assert phi_model("tiny").config.parallel_block
+    assert phi_model("tiny").config.rotary_pct == 0.4
+    assert opt_model("tiny").config.activation == "relu"
+    assert falcon_model("tiny").config.kv_heads == 1  # multi-query
+    assert mistral_model("tiny").config.kv_heads == 2  # GQA
+
+
+@pytest.mark.parametrize("family", [phi_model, falcon_model, qwen_model],
+                         ids=lambda f: f.__name__)
+def test_family_paged_inference_matches_dense(family):
+    """The paged (inference v2) path must agree with the dense cached
+    decode for the structural variants (parallel block, partial rotary,
+    qkv bias, multi-query)."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig,
+                                            RaggedRequest)
+    from tests.unit.test_inference_v2 import _dense_greedy
+
+    model = family("tiny", max_seq_len=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = list(np.random.RandomState(3).randint(0, model.config.vocab_size, 11))
+    want = _dense_greedy(model, params, prompt, 6)
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=32, max_seqs=2,
+        max_pages_per_seq=8), params=params)
+    got = eng.generate_all([RaggedRequest(prompt_ids=prompt, max_new_tokens=6)])
+    assert got[0] == want
+
+
+def test_partial_rotary_only_rotates_prefix():
+    from deepspeed_tpu.models.transformer import _rope
+
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 4, 2, 8), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(4), (1, 4))
+    full = _rope(x, 10000.0, pos, pct=1.0)
+    part = _rope(x, 10000.0, pos, pct=0.5)
+    # pass-through tail unchanged
+    np.testing.assert_array_equal(np.asarray(part[..., 4:]),
+                                  np.asarray(x[..., 4:]))
+    assert not np.allclose(np.asarray(part[..., :4]), np.asarray(x[..., :4]))
+    assert not np.allclose(np.asarray(full), np.asarray(part))

@@ -126,3 +126,26 @@ def test_cpu_adam_per_key_step_counts():
         adam.step(p1, g, key=1)
     np.testing.assert_array_equal(p0, p1)
     assert adam.step_count == 3
+
+
+def test_zenflow_selection_change_keeps_residual():
+    """A column newly entering the top-k must not lose its previously
+    accumulated slow-path gradient (only the current step's contribution
+    moves to the fast path)."""
+    opt = ZenFlowOptimizer(
+        None, {"type": "adamw", "params": {"lr": 1e-2}},
+        zenflow_config=ZenFlowConfig(enabled=True, topk_ratio=0.25,
+                                     update_interval=100))  # no slow launch
+    opt.initialize_master([np.zeros((4, 4), np.float32)])
+    g1 = np.zeros((4, 4), np.float32)
+    g1[:, 0] = 10.0  # col 0 selected
+    g1[:, 1] = 1.0   # col 1 accumulates
+    opt.apply_step([g1.copy()], lr=1e-2, denom=1.0)
+    np.testing.assert_allclose(opt._accum[0][:, 1], 1.0)
+    g2 = np.zeros((4, 4), np.float32)
+    g2[:, 1] = 10.0  # col 1 now selected
+    opt.apply_step([g2.copy()], lr=1e-2, denom=1.0)
+    # col 1's step-1 residual must survive the selection change
+    np.testing.assert_allclose(opt._accum[0][:, 1], 1.0)
+    # and col 1's step-2 gradient went to the fast path, not the buffer
+    assert (np.abs(opt.master[0][:, 1]) > 0).all()
