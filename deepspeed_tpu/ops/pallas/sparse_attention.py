@@ -138,7 +138,7 @@ def _sparse_attn_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, *,
     l = jnp.zeros((block, 1), jnp.float32)
     acc = jnp.zeros((block, D), jnp.float32)
 
-    def body(kj, carry):
+    def compute_block(kj, carry):
         m, l, acc = carry
         k_blk = k_ref[0, pl.ds(kj * block, block), :]
         v_blk = v_ref[0, pl.ds(kj * block, block), :]
@@ -147,9 +147,6 @@ def _sparse_attn_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, *,
             qpos = qi * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = kj * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
-        # block mask: layout==0 → the whole block contributes nothing
-        on = layout_ref[0, 0, kj] > 0
-        s = jnp.where(on, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> nan
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -159,7 +156,16 @@ def _sparse_attn_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, *,
         acc = acc * alpha + p @ v_blk.astype(jnp.float32)
         return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, nb, body, (m, l, acc))
+    def body(kj, carry):
+        # the sparsity payoff: off-layout blocks skip the matmuls entirely
+        # (lax.cond executes one branch at runtime)
+        on = layout_ref[0, 0, kj] > 0
+        return jax.lax.cond(on, lambda c: compute_block(kj, c),
+                            lambda c: c, carry)
+
+    # causal: k-blocks past the diagonal contribute nothing — don't visit
+    upper = jnp.minimum(nb, qi + 1) if causal else nb
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
 
 

@@ -120,8 +120,29 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         return True
 
 
+class NebulaCheckpointEngine(DecoupledCheckpointEngine):
+    """Nebula-style async tiered checkpointing (reference
+    runtime/checkpoint_engine/nebula_checkpoint_engine.py wraps the
+    torch_nebula service).  The service itself is Azure-only; the TPU build
+    keeps the same async commit contract over the decoupled engine."""
+
+
+class DataStatesCheckpointEngine(DecoupledCheckpointEngine):
+    """DataStates-LLM-style async checkpointing (reference
+    datastates/ + runtime/checkpoint_engine/datastates_checkpoint_engine.py):
+    host-buffered async flush, same engine contract."""
+
+
 def make_checkpoint_engine(config) -> CheckpointEngine:
     """From the ``checkpoint`` config block."""
+    kind = str(getattr(config.checkpoint, "writer", "") or "").lower()
+    if kind not in ("", "nebula", "datastates"):
+        raise ValueError(f"unknown checkpoint.writer '{kind}'; "
+                         "expected '', 'nebula' or 'datastates'")
+    if kind == "nebula":
+        return NebulaCheckpointEngine()
+    if kind == "datastates":
+        return DataStatesCheckpointEngine()
     if getattr(config.checkpoint, "async_save", False):
         return DecoupledCheckpointEngine()
     if getattr(config.checkpoint, "parallel_write_pipeline", False):

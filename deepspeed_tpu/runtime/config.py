@@ -229,6 +229,7 @@ class CheckpointConfig(ConfigModel):
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
     async_save: bool = False
+    writer: str = ""  # "" | nebula | datastates (async engine flavors)
 
 
 @dataclasses.dataclass

@@ -363,6 +363,12 @@ class DeepSpeedTPUEngine:
         return state, jnp.mean(losses)
 
     def _compile_steps(self, opt_state_memory_kind: Optional[str] = None) -> None:
+        # the offload mode is sticky: once offload_adam_states set it, later
+        # recompiles (e.g. a subsequent offload_activation pass) must keep
+        # the moments host-resident rather than silently reverting
+        if opt_state_memory_kind is not None:
+            self._opt_offload_kind = opt_state_memory_kind
+        opt_state_memory_kind = getattr(self, "_opt_offload_kind", None)
         donate = dict(donate_argnums=(0,))
         self._micro_step = jax.jit(self._micro_step_body, **donate)
         self._eval_fn = None

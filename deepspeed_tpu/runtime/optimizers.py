@@ -15,7 +15,6 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import optax
 
-from ..utils.logging import logger
 
 ADAM_OPTIMIZER = "adam"
 ADAMW_OPTIMIZER = "adamw"
@@ -52,13 +51,27 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any],
     base_lr = float(params.get("lr", 1e-3))
     wd = float(params.get("weight_decay", 0.0))
 
-    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM, ONEBIT_ADAM, ZERO_ONE_ADAM):
+    if name in (ONEBIT_ADAM, ZERO_ONE_ADAM, ONEBIT_LAMB):
+        from .fp16.onebit import one_bit_adam, one_bit_lamb, zero_one_adam
+
+        a = _adam_args(params)
+        common = dict(learning_rate=schedule, b1=a["b1"], b2=a["b2"],
+                      weight_decay=wd)
+        if name == ONEBIT_ADAM:
+            tx = one_bit_adam(**common, eps=a["eps"],
+                              freeze_step=int(params.get("freeze_step", 100)))
+        elif name == ZERO_ONE_ADAM:
+            tx = zero_one_adam(
+                **common, eps=a["eps"],
+                var_freeze_step=int(params.get("var_freeze_step", 100)),
+                var_update_interval=int(params.get("var_update_interval", 16)))
+        else:
+            tx = one_bit_lamb(**common, eps=float(params.get("eps", 1e-6)),
+                              freeze_step=int(params.get("freeze_step", 100)))
+        return tx, base_lr
+    if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         # reference FusedAdam defaults to adam_w_mode=True (ops/adam/fused_adam.py)
         adam_w_mode = bool(params.get("adam_w_mode", True))
-        if name in (ONEBIT_ADAM, ZERO_ONE_ADAM):
-            logger.warning(f"{name}: compressed-comm optimizer runs as exact Adam on TPU; "
-                           "gradient compression is configured separately "
-                           "(gradient_compression block)")
         if adam_w_mode:
             tx = optax.adamw(schedule, weight_decay=wd, **_adam_args(params))
         else:
@@ -66,7 +79,7 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any],
                              optax.adam(schedule, **_adam_args(params)))
     elif name == ADAMW_OPTIMIZER:
         tx = optax.adamw(schedule, weight_decay=wd, **_adam_args(params))
-    elif name in (LAMB_OPTIMIZER, ONEBIT_LAMB):
+    elif name == LAMB_OPTIMIZER:
         tx = optax.lamb(schedule, weight_decay=wd, **_adam_args(params))
     elif name in (LION_OPTIMIZER, "fusedlion", "deepspeedcpulion"):
         betas = params.get("betas", (0.9, 0.99))

@@ -56,12 +56,15 @@ class SuperOffloadOptimizer(HostOffloadedOptimizer):
             if self.master[i].size != g.size:
                 raise ValueError(f"grad/master size mismatch at leaf {i}")
             if self._aio is not None:
+                # only the AIO handle needs serializing (drain() waits on
+                # and clears ALL in-flight ops); the SIMD Adam step runs
+                # outside the lock so workers still update in parallel
                 with self._io_lock:
                     self._fetch(i, g.size)
-                    self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
+            self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
+            if self._aio is not None:
+                with self._io_lock:
                     self._spill(i)
-            else:
-                self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
 
         futures = [self._pool.submit(task, i, g) for i, g in enumerate(gs)]
         for f in futures:

@@ -49,13 +49,8 @@ def test_train_then_generate_then_train():
 
     out2 = np.asarray(engine.generate(prompt, max_new_tokens=4))
     assert out2.shape == (1, 12)
-    # weights changed -> generation must reflect them (same prompt, greedy);
-    # identical outputs would mean generate() sees stale params.  Compare the
-    # continuation region only (prompts are echoed).
-    # (with a tiny random model and 5 SGD-scale updates the argmax can
-    # coincide, so compare a longer continuation)
-    out1b = np.asarray(engine.generate(prompt, max_new_tokens=8))
-    assert out1b.shape == (1, 16)
+    # (that generation sees the LIVE weights is asserted structurally in
+    # test_generate_uses_updated_weights via leaf identity)
 
 
 def test_generate_uses_updated_weights():
