@@ -75,7 +75,12 @@ def main() -> None:
     for _ in range(steps):
         loss = engine.train_batch(batch())
     jax.block_until_ready(loss)
+    # force a host roundtrip of real data: on remote/tunneled devices a bare
+    # block_until_ready can return before execution finishes, which would
+    # report impossible (>1) MFU
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     tokens = steps * micro_bs * dp * seq
     tok_per_sec_chip = tokens / dt / n_chips

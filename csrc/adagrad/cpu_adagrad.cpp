@@ -1,0 +1,28 @@
+// SIMD CPU Adagrad for host-offloaded optimizer state.
+//
+// TPU-native counterpart of the reference's CPU Adagrad
+// (csrc/adagrad/cpu_adagrad.cpp): accumulate squared gradients, scale by
+// 1/sqrt(acc); OpenMP-threaded, auto-vectorized, plain C ABI for ctypes.
+
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// One Adagrad step over a contiguous fp32 shard.  Returns 0 on success.
+int dstpu_adagrad_step(float* params, const float* grads, float* exp_avg_sq,
+                       int64_t n, float lr, float eps, float weight_decay) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float p = params[i];
+    if (weight_decay != 0.0f) g += weight_decay * p;
+    float v = exp_avg_sq[i] + g * g;
+    exp_avg_sq[i] = v;
+    params[i] = p - lr * g / (std::sqrt(v) + eps);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,38 @@
+// SIMD CPU Lion for host-offloaded optimizer state.
+//
+// TPU-native counterpart of the reference's CPU Lion
+// (csrc/lion/cpu_lion_impl.cpp, fused_lion kernels): the Lion update
+// (sign of the interpolated momentum) for ZeRO-Offload, OpenMP-threaded
+// with compiler auto-vectorization (sign/copysign vectorize cleanly),
+// exposed as a plain C ABI for ctypes.
+
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// One fused Lion step over a contiguous fp32 shard.  Returns 0 on success.
+// update  c = b1*m + (1-b1)*g ;  p -= lr * (sign(c) + wd*p) ;
+// moment  m = b2*m + (1-b2)*g
+int dstpu_lion_step(float* params, const float* grads, float* exp_avg,
+                    int64_t n, float lr, float beta1, float beta2,
+                    float weight_decay) {
+  const float b1 = beta1, omb1 = 1.0f - beta1;
+  const float b2 = beta2, omb2 = 1.0f - beta2;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float m = exp_avg[i];
+    float c = b1 * m + omb1 * g;
+    float p = params[i];
+    // decoupled weight decay (Lion is always decoupled)
+    if (weight_decay != 0.0f) p -= lr * weight_decay * p;
+    params[i] = p - lr * ((c > 0.0f) - (c < 0.0f));
+    exp_avg[i] = b2 * m + omb2 * g;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -115,8 +115,39 @@ class AsyncIOBuilder(OpBuilder):
         return lib
 
 
+class CPULionBuilder(OpBuilder):
+    name = "cpu_lion"
+    source = "lion/cpu_lion.cpp"
+    simd_candidates = [["-march=native"], ["-mavx2", "-mfma"], []]
+
+    def load(self):
+        lib = super().load()
+        lib.dstpu_lion_step.restype = ctypes.c_int
+        lib.dstpu_lion_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float]
+        return lib
+
+
+class CPUAdagradBuilder(OpBuilder):
+    name = "cpu_adagrad"
+    source = "adagrad/cpu_adagrad.cpp"
+    simd_candidates = [["-march=native"], ["-mavx2", "-mfma"], []]
+
+    def load(self):
+        lib = super().load()
+        lib.dstpu_adagrad_step.restype = ctypes.c_int
+        lib.dstpu_adagrad_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        return lib
+
+
 BUILDERS = {
     "CPUAdamBuilder": CPUAdamBuilder,
+    "CPULionBuilder": CPULionBuilder,
+    "CPUAdagradBuilder": CPUAdagradBuilder,
     "AsyncIOBuilder": AsyncIOBuilder,
 }
 

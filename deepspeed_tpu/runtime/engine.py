@@ -428,6 +428,42 @@ class DeepSpeedTPUEngine:
 
         return compile_engine(self, backend=backend, passes=passes)
 
+    # --------------------------------------------------- state offload API
+    def offload_states(self, include=None, device: str = "cpu",
+                       pin_memory: bool = True,
+                       non_blocking: bool = False) -> None:
+        """Move the whole TrainState to host RAM and free the HBM copies
+        (reference ``engine.offload_states``, engine.py:4358 — used to park
+        a model, e.g. between RLHF phases).  ``reload_states`` restores it;
+        training calls in between raise."""
+        del include, device, pin_memory, non_blocking  # full-state, host-only
+        if getattr(self, "_host_state", None) is not None:
+            return
+        self._host_state_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding if hasattr(x, "sharding") else "keep",
+            self.state)
+        self._host_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x))
+            if hasattr(x, "sharding") else x, self.state)
+        for leaf in jax.tree_util.tree_leaves(self.state):
+            if hasattr(leaf, "delete"):
+                leaf.delete()
+        self.state = None
+        log_dist("offload_states: TrainState moved to host; HBM freed")
+
+    def reload_states(self, non_blocking: bool = False) -> None:
+        """Undo ``offload_states`` (reference ``engine.reload_states``)."""
+        del non_blocking
+        if getattr(self, "_host_state", None) is None:
+            return
+        with self.topology.mesh:
+            self.state = jax.tree_util.tree_map(
+                lambda h, s: h if s == "keep" else jax.device_put(h, s),
+                self._host_state, self._host_state_shardings)
+        self._host_state = None
+        self._host_state_shardings = None
+        log_dist("reload_states: TrainState restored to device")
+
     # ------------------------------------------------------- offloaded step
     def _apply_step_offload(self) -> None:
         """Boundary update on the host: pull reduced grads, run C++ Adam on

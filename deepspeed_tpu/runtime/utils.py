@@ -1,0 +1,93 @@
+"""Runtime utilities.
+
+Reference parity: ``deepspeed/runtime/utils.py`` — ``see_memory_usage``,
+``clip_grad_norm_``, flatten/unflatten helpers, partition helpers.  The
+tensor-surgery helpers shrink drastically on TPU (pytrees + jnp do the
+work); memory reporting reads the accelerator ABI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..accelerator import get_accelerator
+from ..utils.logging import logger
+from .precision import clip_by_global_norm, global_grad_norm
+
+__all__ = ["see_memory_usage", "clip_grad_norm_", "flatten_tree",
+           "unflatten_tree", "partition_uniform", "partition_balanced"]
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Log device + host memory (reference runtime/utils.py
+    see_memory_usage, which prints torch.cuda stats + psutil)."""
+    if not force:
+        return
+    acc = get_accelerator()
+    s = acc.memory_stats()
+    used = s.get("bytes_in_use", 0) / 2**30
+    peak = s.get("peak_bytes_in_use", 0) / 2**30
+    limit = s.get("bytes_limit", 0) / 2**30
+    logger.info(f"{message} | device MA {used:.2f} GB  Max_MA {peak:.2f} GB  "
+                f"limit {limit:.2f} GB")
+
+
+def clip_grad_norm_(grads: Any, max_norm: float) -> Tuple[Any, jnp.ndarray]:
+    """Global-norm clip over a gradient pytree; returns (clipped, norm)
+    (reference clip_grad_norm_ with the norm allreduce — on TPU the norm is
+    computed on global arrays, the collective is implicit)."""
+    norm = global_grad_norm(grads)
+    return clip_by_global_norm(grads, norm, max_norm), norm
+
+
+def flatten_tree(tree: Any) -> Tuple[jnp.ndarray, Any, List[Tuple[int, ...]]]:
+    """Flatten a pytree of arrays into one 1-D buffer (reference
+    flatten/_flatten_dense_tensors).  Returns (flat, treedef, shapes)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = [tuple(x.shape) for x in leaves]
+    flat = jnp.concatenate([jnp.ravel(x) for x in leaves]) if leaves else jnp.zeros((0,))
+    return flat, treedef, shapes
+
+
+def unflatten_tree(flat: jnp.ndarray, treedef: Any,
+                   shapes: Sequence[Tuple[int, ...]]) -> Any:
+    """Inverse of flatten_tree (reference unflatten/_unflatten_dense_tensors)."""
+    out, off = [], 0
+    for shp in shapes:
+        n = int(np.prod(shp)) if shp else 1
+        out.append(flat[off:off + n].reshape(shp))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries of a uniform split (reference partition_uniform):
+    returns num_parts+1 offsets."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Greedy prefix-sum balanced partition of weighted items (reference
+    partition_balanced, used by pipeline layer placement)."""
+    n = len(weights)
+    if num_parts >= n:
+        return list(range(n + 1)) + [n] * (num_parts - n)
+    prefix = np.concatenate([[0.0], np.cumsum(np.asarray(weights, np.float64))])
+    total = prefix[-1]
+    bounds = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(prefix, target))
+        idx = max(bounds[-1] + 1, min(idx, n - (num_parts - p)))
+        bounds.append(idx)
+    bounds.append(n)
+    return bounds

@@ -1,0 +1,64 @@
+"""ZeRO public surface.
+
+Reference parity: ``deepspeed.zero`` — ``Init`` (partition_parameters.py:878)
+and ``GatheredParameters`` (partition_parameters.py) plus the sharding plan
+that replaces the hook machinery on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .offload import HostOffloadedOptimizer  # noqa: F401
+from .strategy import ZeroShardingPlan  # noqa: F401
+
+
+class Init:
+    """API-parity context for constructing a model with partitioned params
+    (reference ``zero.Init``: patches tensor constructors so params are born
+    sharded).
+
+    On TPU no patching is needed: model definitions are pure init functions
+    (ModelSpec.init_params), and the engine jits them with sharded
+    ``out_shardings`` so full replicas never materialize
+    (engine._init_state).  The context is therefore a no-op that exists so
+    reference-style code — ``with zero.Init(): model = build()`` — runs
+    unchanged; it records the config it was given for inspection.
+    """
+
+    def __init__(self, module: Any = None, data_parallel_group: Any = None,
+                 mem_efficient_linear: bool = True, remote_device: str = None,
+                 pin_memory: bool = False, config_dict_or_path: Any = None,
+                 **kwargs):
+        self.config = dict(kwargs, remote_device=remote_device,
+                           pin_memory=pin_memory,
+                           config=config_dict_or_path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any, modifier_rank: Optional[int] = 0,
+                       fwd_module: Any = None, enabled: bool = True):
+    """Yield a fully-materialized host copy of (possibly sharded) params
+    (reference ``zero.GatheredParameters``: allgather partitioned params
+    for inspection/modification inside the context).
+
+    JAX arrays are immutable, so in-place modification inside the context
+    cannot write back; use the yielded numpy tree to build new params.
+    """
+    if not enabled or params is None:
+        yield params
+        return
+    gathered = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "sharding") else x,
+        params)
+    yield gathered

@@ -50,15 +50,31 @@ class HostOffloadedOptimizer:
                  grad_clip: float = 0.0, nvme_path: Optional[str] = None,
                  aio_threads: int = 4):
         params = dict(optimizer_config.get("params") or {})
-        betas = params.get("betas", (0.9, 0.999))
-        self.cpu_adam = DeepSpeedCPUAdam(
-            lr=float(params.get("lr", 1e-3)),
-            betas=(float(betas[0]), float(betas[1])),
-            eps=float(params.get("eps", 1e-8)),
-            weight_decay=float(params.get("weight_decay", 0.0)),
-            adamw_mode=bool(params.get("adam_w_mode", True)) or
-            optimizer_config.get("type", "adamw").lower().endswith("w"),
-        )
+        otype = str(optimizer_config.get("type", "adamw")).lower()
+        wd = float(params.get("weight_decay", 0.0))
+        if "lion" in otype:
+            from ...ops.cpu.lion import DeepSpeedCPULion
+
+            betas = params.get("betas", (0.9, 0.99))
+            self.cpu_adam = DeepSpeedCPULion(
+                lr=float(params.get("lr", 1e-4)),
+                betas=(float(betas[0]), float(betas[1])), weight_decay=wd)
+        elif "adagrad" in otype:
+            from ...ops.cpu.adagrad import DeepSpeedCPUAdagrad
+
+            self.cpu_adam = DeepSpeedCPUAdagrad(
+                lr=float(params.get("lr", 1e-2)),
+                eps=float(params.get("eps", 1e-10)), weight_decay=wd)
+        else:
+            betas = params.get("betas", (0.9, 0.999))
+            self.cpu_adam = DeepSpeedCPUAdam(
+                lr=float(params.get("lr", 1e-3)),
+                betas=(float(betas[0]), float(betas[1])),
+                eps=float(params.get("eps", 1e-8)),
+                weight_decay=wd,
+                adamw_mode=bool(params.get("adam_w_mode", True)) or
+                otype.endswith("w"),
+            )
         self.grad_clip = grad_clip
         self.leaves, self.treedef = jax.tree_util.tree_flatten(abstract_params)
         self.master: List[np.ndarray] = []
@@ -79,33 +95,46 @@ class HostOffloadedOptimizer:
         log_dist(f"host-offload: {sum(m.size for m in self.master) / 1e6:.1f}M "
                  f"fp32 master elements in host RAM")
 
+    def _moment_dicts(self):
+        """Per-kernel moment buffers: Adam has m+v, Lion m only, Adagrad v
+        only — spill/fetch whatever exists."""
+        out = []
+        for attr in ("_m", "_v"):
+            d = getattr(self.cpu_adam, attr, None)
+            if d is not None:
+                out.append((attr.strip("_"), d))
+        return out
+
     def _spill(self, key: int) -> None:
         if self._aio is None:
             return
-        m = self.cpu_adam._m.get(key)
-        v = self.cpu_adam._v.get(key)
-        if m is None:
+        dicts = self._moment_dicts()
+        if any(d.get(key) is None for _, d in dicts):
             return
-        self._aio.async_pwrite(m, f"{self.nvme_path}/m_{key}.bin")
-        self._aio.async_pwrite(v, f"{self.nvme_path}/v_{key}.bin")
+        if not any(key in d for _, d in dicts):
+            return
+        for name, d in dicts:
+            self._aio.async_pwrite(d[key], f"{self.nvme_path}/{name}_{key}.bin")
         self._aio.drain()
-        # release host copies (spilled)
-        self.cpu_adam._m[key] = None  # type: ignore[assignment]
-        self.cpu_adam._v[key] = None  # type: ignore[assignment]
+        for _, d in dicts:
+            d[key] = None  # type: ignore[assignment]  (spilled)
 
     def _fetch(self, key: int, n: int) -> None:
         if self._aio is None:
             return
         # key present but None => spilled to disk; absent => first step, the
-        # adam kernel will zero-init
-        if key in self.cpu_adam._m and self.cpu_adam._m[key] is None:
-            m = np.empty(n, np.float32)
-            v = np.empty(n, np.float32)
-            self._aio.async_pread(m, f"{self.nvme_path}/m_{key}.bin")
-            self._aio.async_pread(v, f"{self.nvme_path}/v_{key}.bin")
-            self._aio.drain()
-            self.cpu_adam._m[key] = m
-            self.cpu_adam._v[key] = v
+        # kernel will zero-init
+        dicts = self._moment_dicts()
+        if not dicts or key not in dicts[0][1] or dicts[0][1][key] is not None:
+            return
+        bufs = []
+        for name, d in dicts:
+            buf = np.empty(n, np.float32)
+            self._aio.async_pread(buf, f"{self.nvme_path}/{name}_{key}.bin")
+            bufs.append((d, buf))
+        self._aio.drain()
+        for d, buf in bufs:
+            d[key] = buf
 
     def apply_step(self, grads_flat: List[np.ndarray], lr: float,
                    denom: float) -> Tuple[List[np.ndarray], float]:

@@ -114,3 +114,80 @@ def test_aio_read_missing_file_raises(tmp_path):
     h.async_pread(out, str(tmp_path / "nope.bin"))
     with pytest.raises(IOError):
         h.drain()
+
+
+def test_cpu_lion_matches_reference():
+    """C++ Lion vs a numpy reference implementation."""
+    from deepspeed_tpu.ops.cpu.lion import DeepSpeedCPULion
+
+    rng = np.random.RandomState(5)
+    p = rng.randn(1000).astype(np.float32)
+    ref_p, ref_m = p.copy(), np.zeros_like(p)
+    lion = DeepSpeedCPULion(lr=1e-3, betas=(0.9, 0.99), weight_decay=0.01)
+    for _ in range(5):
+        g = rng.randn(1000).astype(np.float32)
+        lion.step(p, g, key=0)
+        c = 0.9 * ref_m + 0.1 * g
+        ref_p *= (1 - 1e-3 * 0.01)
+        ref_p -= 1e-3 * np.sign(c)
+        ref_m = 0.99 * ref_m + 0.01 * g
+    np.testing.assert_allclose(p, ref_p, rtol=1e-5, atol=1e-6)
+
+
+def test_cpu_adagrad_matches_reference():
+    from deepspeed_tpu.ops.cpu.adagrad import DeepSpeedCPUAdagrad
+
+    rng = np.random.RandomState(6)
+    p = rng.randn(777).astype(np.float32)
+    ref_p, ref_v = p.copy(), np.zeros_like(p)
+    ada = DeepSpeedCPUAdagrad(lr=1e-2, eps=1e-10)
+    for _ in range(4):
+        g = rng.randn(777).astype(np.float32)
+        ada.step(p, g, key=0)
+        ref_v += g * g
+        ref_p -= 1e-2 * g / (np.sqrt(ref_v) + 1e-10)
+    np.testing.assert_allclose(p, ref_p, rtol=1e-5, atol=1e-6)
+
+
+def test_offload_with_lion_and_adagrad():
+    """Host-offload path selects the matching CPU kernel by optimizer type."""
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.cpu.adagrad import DeepSpeedCPUAdagrad
+    from deepspeed_tpu.ops.cpu.lion import DeepSpeedCPULion
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    for opt, cls, lr in [("Lion", DeepSpeedCPULion, 1e-3),
+                         ("Adagrad", DeepSpeedCPUAdagrad, 5e-2)]:
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_mlp_spec(),
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": opt, "params": {"lr": lr}},
+                    "bf16": {"enabled": True},
+                    "zero_optimization": {"stage": 2,
+                                          "offload_optimizer": {"device": "cpu"}}})
+        assert isinstance(engine.offload_optimizer.cpu_adam, cls)
+        losses = [float(engine.train_batch(random_batch(batch_size=16, seed=0, gas=1)))
+                  for _ in range(10)]
+        assert losses[-1] < losses[0], (opt, losses)
+
+
+def test_offload_nvme_lion_spills(tmp_path):
+    import os
+
+    import deepspeed_tpu
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Lion", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2,
+                                      "offload_optimizer": {
+                                          "device": "nvme",
+                                          "nvme_path": str(tmp_path / "nv")}}})
+    for i in range(3):
+        engine.train_batch(random_batch(batch_size=8, seed=i, gas=1))
+    names = os.listdir(tmp_path / "nv")
+    assert any(n.startswith("m_") for n in names)  # lion spills m only
+    assert not any(n.startswith("v_") for n in names)
