@@ -11,9 +11,62 @@ reference's utilization on our hardware.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# How long to give the configured (possibly tunneled-TPU) backend to come up
+# before falling back to CPU.  Backend init through the axon relay can be
+# slow; a hung tunnel must not zero out the benchmark (round-1 BENCH rc=1).
+try:
+    _PROBE_TIMEOUT_S = int(os.environ.get("DSTPU_BENCH_PROBE_TIMEOUT", "240"))
+except ValueError:
+    _PROBE_TIMEOUT_S = 240
+
+
+def _pin_cpu() -> None:
+    """Force the CPU platform, overriding any site-plugin pin."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def _backend_usable() -> bool:
+    """Probe the configured backend in a subprocess with a hard timeout.
+
+    jax backend init happens inside a C call that cannot be interrupted
+    in-process, so a hung TPU plugin would hang the benchmark itself; the
+    subprocess is the only safe way to find out.
+    """
+    # Probe unless explicitly pinned to cpu: a site PJRT plugin can select a
+    # TPU backend via jax.config even when JAX_PLATFORMS is unset, and the
+    # subprocess (same sitecustomize) reproduces whatever main() would see.
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return True
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((128, 128), jnp.bfloat16); "
+            "(x @ x).block_until_ready(); "
+            "print(jax.default_backend())")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=_PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        print("bench: backend probe timed out; falling back to cpu",
+              file=sys.stderr)
+        return False
+    if proc.returncode != 0:
+        print(f"bench: backend probe failed; falling back to cpu\n"
+              f"{proc.stderr[-2000:]}", file=sys.stderr)
+        return False
+    return True
 
 PEAK_BF16_FLOPS = {
     # per-chip peak bf16 FLOP/s
@@ -96,4 +149,22 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--cpu" in sys.argv:
+        _pin_cpu()
+        main()
+    else:
+        if not _backend_usable():
+            _pin_cpu()
+            main()
+        else:
+            try:
+                main()
+            except Exception:  # mid-run TPU failure: rerun on cpu
+                import traceback
+                traceback.print_exc()
+                print("bench: run failed on configured backend; retrying on "
+                      "cpu", file=sys.stderr)
+                env = dict(os.environ, JAX_PLATFORMS="cpu")
+                ret = subprocess.run([sys.executable, __file__, "--cpu"],
+                                     env=env)
+                sys.exit(ret.returncode)
