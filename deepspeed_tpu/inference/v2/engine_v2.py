@@ -132,7 +132,11 @@ class InferenceEngineV2:
         b = -(-b // ps) * ps  # round up: prefill scatters whole pages
         while b < n:
             b *= 2
-        return min(b, self.block.max_seq_len)
+        # cap at the page-rounded model window (self.max_seq_len, not
+        # block.max_seq_len): a learned-position model must not be prefetched
+        # past its position table; paged_prefill clamps the residual < ps
+        cap = -(-self.max_seq_len // ps) * ps
+        return min(b, cap)
 
     def _preempt(self, seq: SequenceState) -> None:
         """Evict a running sequence to the queue head; it will re-prefill its

@@ -46,7 +46,11 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
     ps = k_pool.shape[2]
     x = params["embed"]["tok"][ids][None]  # [1, S, H]
     if cfg.position == "learned":
-        x = x + params["embed"]["pos"][jnp.arange(S)][None]
+        # the bucket may pad up to page_size-1 slots past the position
+        # table; clamp explicitly (pad positions >= length never influence
+        # real-token outputs under the causal mask)
+        pos_idx = jnp.minimum(jnp.arange(S), params["embed"]["pos"].shape[0] - 1)
+        x = x + params["embed"]["pos"][pos_idx][None]
     positions = jnp.arange(S)[None]
 
     def body(x, inputs):

@@ -54,7 +54,7 @@ class TransformerConfig:
     use_bias: bool = False  # gpt2/bert style proj biases
     qkv_bias: bool = False  # bias on q/k/v only (qwen2 style)
     rotary_pct: float = 1.0  # fraction of head_dim under rope (phi/neox)
-    parallel_block: bool = False  # x + attn(ln1 x) + mlp(ln2 x) (falcon/phi)
+    parallel_block: bool = False  # x + attn(ln x) + mlp(ln x), shared ln (falcon/phi)
     dtype: Any = jnp.float32  # params storage dtype at init (engine recasts)
     remat: bool = False
     remat_policy: str = "nothing_saveable"
@@ -121,8 +121,9 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         },
         "mlp": {},
         "norm1": {"scale": jnp.ones((L, H), dt)},
-        "norm2": {"scale": jnp.ones((L, H), dt)},
     }
+    if not cfg.parallel_block:  # falcon/phi share norm1 across both branches
+        layers["norm2"] = {"scale": jnp.ones((L, H), dt)}
     if cfg.moe_experts > 0:
         E = cfg.moe_experts
         layers["mlp"]["router"] = nrm(keys[7], L, H, E)
@@ -146,7 +147,8 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         layers["mlp"]["b_down"] = jnp.zeros((L, H), dt)
     if cfg.norm == "layernorm":
         layers["norm1"]["bias"] = jnp.zeros((L, H), dt)
-        layers["norm2"]["bias"] = jnp.zeros((L, H), dt)
+        if not cfg.parallel_block:
+            layers["norm2"]["bias"] = jnp.zeros((L, H), dt)
     p["layers"] = layers
     return p
 
@@ -287,8 +289,13 @@ def attn_qkv(cfg: TransformerConfig, layer, x, positions):
 
 def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
     """norm2 + FFN (dense swiglu/gelu or MoE) with residual; returns
-    (x + ffn(norm(x)), aux_loss).  Shared by training and inference paths."""
-    h = _norm(x, layer["norm2"]["scale"], layer["norm2"].get("bias"), cfg.norm, cfg.norm_eps)
+    (x + ffn(norm(x)), aux_loss).  Shared by training and inference paths.
+
+    parallel_block (falcon/phi) shares ONE input layernorm between the
+    attention and MLP branches — there is no norm2 in those checkpoints;
+    XLA CSEs the duplicate _norm with the one inside attn_qkv."""
+    ln = layer["norm1"] if cfg.parallel_block else layer["norm2"]
+    h = _norm(x, ln["scale"], ln.get("bias"), cfg.norm, cfg.norm_eps)
     m = layer["mlp"]
     aux = jnp.asarray(0.0, jnp.float32)
     if cfg.moe_experts > 0:

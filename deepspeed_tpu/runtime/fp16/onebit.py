@@ -96,6 +96,12 @@ def _one_bit_family(learning_rate, b1, b2, eps, weight_decay, freeze_step,
                            error=z())
 
     def update(grads, state, params=None):
+        if params is None and (weight_decay or lamb):
+            # decoupled weight decay / LAMB trust ratio read the parameter
+            # values; silently substituting grads would corrupt the update
+            raise ValueError(
+                "one-bit optimizer with weight_decay or LAMB needs params: "
+                "call update(grads, state, params)")
         count = state.count + 1
         warm = count <= freeze_step
 

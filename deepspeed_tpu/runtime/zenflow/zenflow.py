@@ -16,11 +16,13 @@ TPU translation of the same split:
   and always take the fast path.
 * slow path  — unselected gradients accumulate in a host buffer; every
   ``update_interval`` boundaries the residual is applied by a background
-  thread while the device runs the next micro-batches.
-* merge      — the slow pass works on snapshots and its results are
-  merged at the next boundary; columns the fast path touched in the
-  overlap window keep their fast-path values (important columns are
-  owned by the fast path, exactly the reference's split).
+  thread that runs across the whole next interval (device micro-batches
+  AND the intervening fast-path boundaries proceed meanwhile).
+* merge      — the slow pass works on snapshots and its results merge at
+  the next interval boundary, column-wise: only columns the slow pass
+  touched are taken, and columns the fast path wrote during the overlap
+  window keep their fast-path values (important columns are owned by the
+  fast path, exactly the reference's split).
 
 Interface-compatible with zero/offload.HostOffloadedOptimizer so the
 engine can swap it in via config (zero_optimization.zenflow block).
@@ -77,9 +79,14 @@ class ZenFlowOptimizer:
         self._accum: List[np.ndarray] = []
         # columns written by the fast path since the running slow pass launched
         self._fast_mask: List[Optional[np.ndarray]] = []
+        # columns that received slow-path residual this interval (drives the
+        # slow pass instead of a g!=0 proxy, so zero-grad elements inside a
+        # touched column still get Adam's moment decay)
+        self._slow_touched: List[Optional[np.ndarray]] = []
         self.step_count = 0
         self._slow_thread: Optional[threading.Thread] = None
-        self._slow_result: Optional[Tuple[List, List, List]] = None
+        # (master, m, v, touched, accum) snapshots produced by _slow_pass
+        self._slow_result: Optional[Tuple[List, List, List, List, List]] = None
 
     # -- lifecycle (mirrors HostOffloadedOptimizer) -------------------------
     def initialize_master(self, init_params: Any) -> None:
@@ -89,51 +96,81 @@ class ZenFlowOptimizer:
         self._v = [np.zeros_like(x) for x in self.master]
         self._accum = [np.zeros_like(x) for x in self.master]
         self._fast_mask = [None] * len(self.master)
+        self._slow_touched = [np.zeros(x.shape[-1], bool) if x.ndim >= 2 else None
+                              for x in self.master]
         log_dist(f"zenflow: {sum(x.size for x in self.master) / 1e6:.1f}M master "
                  f"elements; topk_ratio={self.zf.topk_ratio} "
                  f"interval={self.zf.update_interval}")
 
     # -- slow path ----------------------------------------------------------
-    def _slow_pass(self, snap_master, snap_m, snap_v, snap_accum, step, lr):
+    def _slow_pass(self, snap_master, snap_m, snap_v, snap_accum, snap_touched,
+                   step, lr):
         denom = float(self.zf.update_interval)
         for i in range(len(snap_master)):
-            g = snap_accum[i] / denom
-            nz = g != 0  # only elements with accumulated (slow-path) gradient
-            if not nz.any():
+            tm = snap_touched[i]
+            if tm is None or not tm.any():
                 continue
-            x0, m0, v0 = snap_master[i].copy(), snap_m[i].copy(), snap_v[i].copy()
-            _adam_update(snap_master[i], g, snap_m[i], snap_v[i], step,
-                         lr, self.b1, self.b2, self.eps, self.wd, self.adamw)
-            snap_master[i][~nz] = x0[~nz]
-            snap_m[i][~nz] = m0[~nz]
-            snap_v[i][~nz] = v0[~nz]
-        self._slow_result = (snap_master, snap_m, snap_v)
+            # whole touched columns update — including elements whose
+            # accumulated grad is exactly zero (moments decay, weight decay
+            # applies: exact Adam semantics for the slow partition)
+            if tm.all():  # common case (selection churns): update in place
+                _adam_update(snap_master[i], snap_accum[i] / denom, snap_m[i],
+                             snap_v[i], step, lr, self.b1, self.b2, self.eps,
+                             self.wd, self.adamw)
+                continue
+            sel = np.nonzero(tm)[0]
+            g = snap_accum[i][..., sel] / denom
+            xs = snap_master[i][..., sel]
+            ms = snap_m[i][..., sel]
+            vs = snap_v[i][..., sel]
+            _adam_update(xs, g, ms, vs, step, lr, self.b1, self.b2, self.eps,
+                         self.wd, self.adamw)
+            snap_master[i][..., sel] = xs
+            snap_m[i][..., sel] = ms
+            snap_v[i][..., sel] = vs
+        self._slow_result = (snap_master, snap_m, snap_v, snap_touched,
+                             snap_accum)
 
     def _join_slow(self) -> None:
         if self._slow_thread is None:
             return
         self._slow_thread.join()
         self._slow_thread = None
-        new_master, new_m, new_v = self._slow_result
+        new_master, new_m, new_v, snap_touched, snap_accum = self._slow_result
         self._slow_result = None
         for i in range(len(self.master)):
-            mask = self._fast_mask[i]
-            if mask is not None and mask.any():
+            tm = snap_touched[i]
+            if tm is None or not tm.any():
+                continue  # slow pass never touched this param: keep live values
+            take = tm.copy()
+            fm = self._fast_mask[i]
+            if fm is not None:
                 # important columns are owned by the fast path: keep the
-                # values it wrote during the overlap window
-                new_master[i][..., mask] = self.master[i][..., mask]
-                new_m[i][..., mask] = self._m[i][..., mask]
-                new_v[i][..., mask] = self._v[i][..., mask]
-            self.master[i] = new_master[i]
-            self._m[i] = new_m[i]
-            self._v[i] = new_v[i]
-            self._fast_mask[i] = None
+                # values it wrote during the overlap window ...
+                take &= ~fm
+                # ... but their pre-window residual must not vanish with the
+                # discarded slow result: re-queue it for the next slow pass
+                dropped = tm & fm
+                if dropped.any():
+                    cols = np.nonzero(dropped)[0]
+                    self._accum[i][..., cols] += snap_accum[i][..., cols]
+                    self._slow_touched[i][cols] = True
+            if take.any():
+                cols = np.nonzero(take)[0]
+                self.master[i][..., cols] = new_master[i][..., cols]
+                self._m[i][..., cols] = new_m[i][..., cols]
+                self._v[i][..., cols] = new_v[i][..., cols]
+        self._fast_mask = [None] * len(self.master)
 
     def _launch_slow(self, lr: float) -> None:
         snap = ([x.copy() for x in self.master], [x.copy() for x in self._m],
-                [x.copy() for x in self._v], [x.copy() for x in self._accum])
+                [x.copy() for x in self._v], [x.copy() for x in self._accum],
+                [t.copy() if t is not None else None for t in self._slow_touched])
         for a in self._accum:
             a[...] = 0.0
+        for t in self._slow_touched:
+            if t is not None:
+                t[:] = False
         for i, x in enumerate(self.master):
             self._fast_mask[i] = (np.zeros(x.shape[-1], bool)
                                   if x.ndim >= 2 else None)
@@ -145,7 +182,7 @@ class ZenFlowOptimizer:
         else:
             self._slow_pass(*snap, self.step_count, lr)
             self._slow_thread = None
-            new_master, new_m, new_v = self._slow_result
+            new_master, new_m, new_v, _, _ = self._slow_result
             self._slow_result = None
             self.master, self._m, self._v = new_master, new_m, new_v
             self._fast_mask = [None] * len(self.master)
@@ -153,15 +190,23 @@ class ZenFlowOptimizer:
     # -- the boundary step --------------------------------------------------
     def apply_step(self, grads_flat: List[np.ndarray], lr: float,
                    denom: float) -> Tuple[List[np.ndarray], float]:
-        self._join_slow()
         self.step_count += 1
         step = self.step_count
         self.lr = lr
+        warm_now = step <= self.zf.full_warm_up_rounds
+        will_launch = (not warm_now) and step % self.zf.update_interval == 0
+        if will_launch:
+            # the slow pass launched at the previous interval boundary ran
+            # while the intervening fast-only boundaries proceeded (the
+            # stall-free overlap); merge it before snapshotting the next one.
+            # Columns the fast path wrote in that window keep their fast
+            # values (_join_slow's fast-mask merge).
+            self._join_slow()
 
         gs, norm = scale_and_clip(grads_flat, denom, self.grad_clip,
                                   shapes=[x.shape for x in self.master])
 
-        warm = step <= self.zf.full_warm_up_rounds
+        warm = warm_now
         for i, g in enumerate(gs):
             x = self.master[i]
             if warm or x.ndim < 2 or self.zf.topk_ratio >= 1.0:
@@ -190,8 +235,12 @@ class ZenFlowOptimizer:
             g_slow = g.copy()
             g_slow[..., sel] = 0.0
             self._accum[i] += g_slow
+            if self._slow_touched[i] is not None:
+                unsel = np.ones(ncols, bool)
+                unsel[sel] = False
+                self._slow_touched[i] |= unsel
 
-        if not warm and step % self.zf.update_interval == 0:
+        if will_launch:
             self._launch_slow(lr)
         return self.master, norm
 
@@ -207,7 +256,9 @@ class ZenFlowOptimizer:
                 "master": [x.copy() for x in self.master],
                 "m": [x.copy() for x in self._m],
                 "v": [x.copy() for x in self._v],
-                "accum": [x.copy() for x in self._accum]}
+                "accum": [x.copy() for x in self._accum],
+                "touched": [t.copy() if t is not None else None
+                            for t in self._slow_touched]}
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self._join_slow()
@@ -217,3 +268,10 @@ class ZenFlowOptimizer:
         self._v = [np.asarray(x, np.float32) for x in sd["v"]]
         self._accum = [np.asarray(x, np.float32) for x in sd["accum"]]
         self._fast_mask = [None] * len(self.master)
+        if "touched" in sd:
+            self._slow_touched = [np.asarray(t, bool) if t is not None else None
+                                  for t in sd["touched"]]
+        else:  # older checkpoints: conservatively mark every column touched
+            # (one extra moment-decay pass, vs re-freezing zero-grad columns)
+            self._slow_touched = [np.ones(x.shape[-1], bool) if x.ndim >= 2
+                                  else None for x in self.master]

@@ -156,3 +156,20 @@ def test_learned_pos_window_capped_to_model_context():
     assert eng.max_seq_len == 32
     with pytest.raises(ValueError):
         eng.put(RaggedRequest(prompt_ids=list(range(40))))
+
+
+def test_prefill_bucket_capped_to_model_context():
+    """The prefill bucket caps at the page-rounded MODEL window, not the
+    (possibly much larger) paged window (ADVICE r1 engine_v2.py:135): a
+    learned-position model must not prefill past its position table."""
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    model = gpt2_model("tiny", max_seq_len=40)  # not a page multiple
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=16, num_pages=32, max_seqs=2,
+        max_pages_per_seq=16))  # paged window 256 >> model context 40
+    assert eng._bucket(33) == 48  # page-rounded model window, not 64/256
+    # end-to-end: a prompt near the context edge still prefills + decodes
+    out = eng.generate_all(
+        [RaggedRequest(prompt_ids=list(range(1, 34)), max_new_tokens=4)])
+    (toks,) = out.values()
+    assert len(toks) >= 1

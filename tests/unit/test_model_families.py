@@ -77,3 +77,19 @@ def test_partial_rotary_only_rotates_prefix():
                                   np.asarray(x[..., 4:]))
     assert not np.allclose(np.asarray(part[..., :4]), np.asarray(x[..., :4]))
     assert not np.allclose(np.asarray(full), np.asarray(part))
+
+
+def test_parallel_block_shares_single_norm():
+    """falcon/phi parallel blocks carry ONE shared input layernorm (no
+    norm2), matching the real architectures (ADVICE r1 families.py)."""
+    import jax
+
+    from deepspeed_tpu.models.families import falcon_model, phi_model
+
+    for fam in (falcon_model, phi_model):
+        model = fam("tiny", max_seq_len=64)
+        params = model.init_params(jax.random.PRNGKey(0))
+        assert "norm2" not in params["layers"], fam.__name__
+        loss = model.loss_fn(
+            params, {"input_ids": jnp.zeros((2, 16), jnp.int32)}, None)
+        assert jnp.isfinite(loss)

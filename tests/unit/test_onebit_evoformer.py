@@ -139,3 +139,20 @@ def test_nebula_datastates_engines(tmp_path):
         assert eng.commit("tag")
         got = eng.load(path)
         np.testing.assert_array_equal(got["a"], arrays["a"])
+
+
+def test_onebit_weight_decay_requires_params():
+    """params=None with weight_decay/LAMB must raise, not silently use grads
+    as params (ADVICE r1 onebit.py:141)."""
+    from deepspeed_tpu.runtime.fp16.onebit import one_bit_adam, one_bit_lamb
+
+    g = {"w": jnp.ones((4,))}
+    for opt in (one_bit_adam(1e-3, weight_decay=0.1), one_bit_lamb(1e-3)):
+        state = opt.init(g)
+        with pytest.raises(ValueError, match="needs params"):
+            opt.update(g, state, None)
+    # without decay/lamb, params=None stays fine
+    opt = one_bit_adam(1e-3)
+    state = opt.init(g)
+    upd, _ = opt.update(g, state, None)
+    assert jnp.all(jnp.isfinite(upd["w"]))

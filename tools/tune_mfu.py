@@ -1,0 +1,108 @@
+"""MFU tuning harness: A/B-times train_batch variants on the real chip.
+
+Usage: python tools/tune_mfu.py [variant ...]   (no args = all)
+Prints one line per variant: name, step_ms, tok/s/chip, mfu.
+
+Findings are recorded in docs/PERF_NOTES.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.models.transformer import flops_per_token
+
+    model = llama_model(size, max_seq_len=seq, **model_overrides)
+    config = {
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+
+    def batch():
+        ids = rng.randint(0, vocab, (1, micro_bs, seq)).astype(np.int32)
+        return {"input_ids": jnp.asarray(ids)}
+
+    loss = engine.train_batch(batch())
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch())
+    final = float(loss)  # host roundtrip: real completion
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final), name
+
+    tokens = steps * micro_bs * seq
+    tok_s = tokens / dt
+    flops = flops_per_token(model.config, seq) * tokens
+    peak = 197e12 if jax.default_backend() == "tpu" else 1e12
+    mfu = flops / dt / peak
+    print(f"{name:36s} step={dt/steps*1e3:8.1f}ms  tok/s={tok_s:9.0f}  "
+          f"mfu={mfu:.3f}", flush=True)
+    del engine
+    return mfu
+
+
+VARIANTS = {
+    # name: (size, seq, bs, overrides)
+    "base-160m-flash512": ("160m", 1024, 8, {}),
+    "160m-xla-attn": ("160m", 1024, 8, {"attn_impl": "xla"}),
+    "160m-flash-jaxstock": ("160m", 1024, 8, {"attn_impl": "flash_jax"}),
+    "160m-flash-bq256": ("160m", 1024, 8, {"attn_impl": "flash_bq256"}),
+    "160m-losschunk341": ("160m", 1024, 8, {"loss_chunk": 341}),
+    "160m-bs32": ("160m", 1024, 32, {}),
+    "160m-bs16": ("160m", 1024, 16, {}),
+    "1b-bs8-remat": ("1b", 1024, 8, {"remat": True}),
+    "1b-bs4": ("1b", 1024, 4, {}),
+}
+
+
+def main():
+    names = sys.argv[1:] or list(VARIANTS)
+    # patch the special attn impl variants in via TransformerConfig.attn_impl
+    import deepspeed_tpu.models.transformer as T
+
+    orig_pick = T._pick_attn
+
+    def pick(cfg):
+        if cfg.attn_impl == "flash_jax":
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            return lambda q, k, v, causal, mask=None: flash_attention(
+                q, k, v, causal=causal, segment_mask=mask, impl="jax")
+        if cfg.attn_impl == "flash_bq256":
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            return lambda q, k, v, causal, mask=None: flash_attention(
+                q, k, v, causal=causal, segment_mask=mask,
+                block_q=256, block_k=256)
+        return orig_pick(cfg)
+
+    T._pick_attn = pick
+    for n in names:
+        size, seq, bs, ov = VARIANTS[n]
+        try:
+            timed_variant(n, size, seq, bs, **ov)
+        except Exception as e:  # OOM etc: report and continue
+            print(f"{n:36s} FAILED: {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
