@@ -1,0 +1,9 @@
+"""Pipeline parallelism (reference deepspeed/runtime/pipe/ + deepspeed/pipe/).
+
+``PipelineModule``/``LayerSpec``/``TiedLayerSpec`` — pipeline any user
+model; ``pipelined_causal_lm`` — the transformer fast path.
+"""
+
+from .engine import pipelined_causal_lm, pipeline_partition_rules  # noqa: F401
+from .module import (LayerSpec, PipelineModule, TiedLayerSpec,  # noqa: F401
+                     partition_balanced)

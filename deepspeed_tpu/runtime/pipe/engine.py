@@ -108,16 +108,28 @@ def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
 
     def step(carry, t):
         buf, loss_acc, aux_acc = carry
-        # stage 0 injects micro-batch t (clamped; masked once t >= M)
-        inject = embed(mb_ids[jnp.minimum(t, M - 1)])
-        x = jnp.where(stage == 0, inject, buf)
+        # stage 0 injects micro-batch t (clamped once t >= M); lax.cond keeps
+        # the embedding gather off every other stage (only the taken branch
+        # executes — the reference's LoadMicroBatch runs on stage 0 alone)
+        x = jax.lax.cond(
+            stage == 0,
+            lambda: embed(mb_ids[jnp.minimum(t, M - 1)]).astype(buf.dtype),
+            lambda: buf)
         x, aux = _stage_apply(cfg, params["layers"], x, positions, attn_fn)
-        # last stage consumes output of micro-batch t - (pp - 1)
+        # last stage consumes output of micro-batch t - (pp - 1); the head
+        # matmul + softmax run only there and only in the valid window
         mb_out = t - (pp - 1)
         valid = jnp.logical_and(stage == pp - 1, mb_out >= 0)
-        loss_t = head_loss(x, mb_labels[jnp.maximum(mb_out, 0)])
-        loss_acc = loss_acc + jnp.where(valid, loss_t, 0.0)
-        aux_acc = aux_acc + jnp.where(stage == pp - 1, aux, 0.0)
+        loss_t = jax.lax.cond(
+            valid,
+            lambda: head_loss(x, mb_labels[jnp.maximum(mb_out, 0)]),
+            lambda: jnp.asarray(0.0, jnp.float32))
+        loss_acc = loss_acc + loss_t
+        # every stage contributes ITS layers' aux (MoE router balance), but
+        # only for ticks where it holds a real micro-batch (stage s at tick t
+        # processes micro t - s); warm-up/drain garbage is excluded
+        aux_valid = jnp.logical_and(t >= stage, t - stage < M)
+        aux_acc = aux_acc + jnp.where(aux_valid, aux, 0.0)
         buf = jax.lax.ppermute(x, PIPE_AXIS, perm)
         return (buf, loss_acc, aux_acc), None
 

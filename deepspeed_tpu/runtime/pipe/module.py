@@ -1,0 +1,377 @@
+"""Generic pipeline module: pipeline *your* model.
+
+Reference parity: ``PipelineModule`` (reference runtime/pipe/module.py:86) —
+the user expresses a model as a ``LayerSpec`` list; the module partitions
+layers across stages (``_partition_layers``, module.py:393, methods
+uniform / parameters / type:regex) and handles tied layers
+(``TiedLayerSpec``, ``allreduce_tied_weight_gradients``, module.py:454).
+
+TPU-native design (one SPMD program, not per-stage processes):
+
+* The stage schedule is a ``lax.scan`` over T = M + P - 1 ticks inside a
+  ``shard_map`` over the 'pipe' mesh axis; activations move between stages
+  with ``ppermute`` (ring).  Autodiff of the scanned schedule IS the
+  backward pipeline wave — no hand-written 1F1B instruction map needed.
+* Each device executes ONLY its stage's layer group, via ``lax.switch`` on
+  the stage index: the first stage's input mapping (e.g. embedding) and the
+  last stage's head+loss run on exactly one stage each (the reference's
+  LoadMicroBatch / loss-on-last-stage placement; fixes the all-stages
+  masked-compute waste of the transformer-specific path).
+* Per-stage parameter placement: when the per-stage groups are structurally
+  identical (the common repeated-block case), layer params are stacked on a
+  leading [num_stages, ...] dim sharded over 'pipe' — each stage holds only
+  its own weights.  Heterogeneous groups fall back to replicated params
+  (compute is still pipelined; documented trade-off of the SPMD design).
+* Tied layers (``TiedLayerSpec``): one shared param subtree, replicated
+  over 'pipe'; ``shard_map``'s transpose psums the per-stage cotangents —
+  the tied-weight gradient allreduce of the reference, for free.
+
+Constraints of the SPMD formulation (differences from the reference):
+  - stage-boundary activations must share one shape/dtype (the ring
+    buffer); the LAST group is exempt (its output feeds the loss only).
+  - dropout/rng inside pipelined layers is not threaded (pass deterministic
+    apply fns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.mesh import BATCH_AXES, PIPE_AXIS, get_topology
+from ...utils.logging import logger
+from ..module import ModelSpec
+
+
+@dataclasses.dataclass
+class LayerSpec:
+    """One pipeline layer: ``init_fn(rng) -> params``,
+    ``apply_fn(params, x) -> y`` (reference LayerSpec, pipe/module.py:43).
+    A param-less layer (activation, reshape) may use ``init_fn=None``."""
+
+    init_fn: Optional[Callable[[Any], Any]]
+    apply_fn: Callable[[Any, Any], Any]
+    name: str = ""
+
+    def init(self, rng):
+        return self.init_fn(rng) if self.init_fn is not None else ()
+
+
+@dataclasses.dataclass
+class TiedLayerSpec(LayerSpec):
+    """A layer whose params are shared with every other TiedLayerSpec of the
+    same ``key`` (reference TiedLayerSpec, pipe/module.py:62 — e.g. embedding
+    reused as the LM head).  ``init_fn`` is taken from the first spec with
+    the key; tied gradients sum across stages automatically."""
+
+    key: str = ""
+
+
+def partition_balanced(weights: Sequence[float], parts: int) -> List[int]:
+    """Contiguous partition of ``weights`` into ``parts`` minimizing the max
+    part weight (reference ds_utils.partition_balanced used by
+    _partition_layers).  Returns part boundaries, len = parts + 1."""
+    n = len(weights)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def parts_needed(cap: float) -> Optional[List[int]]:
+        bounds, start = [0], 0
+        for k in range(parts):
+            # furthest end with sum(start..end) <= cap, leaving at least one
+            # item for each later part (no empty stages)
+            end = int(np.searchsorted(prefix, prefix[start] + cap, side="right")) - 1
+            end = min(end, n - (parts - k - 1))
+            if end <= start:  # a single item exceeds cap
+                return None
+            bounds.append(end)
+            start = end
+        if bounds[-1] != n:
+            return None
+        return bounds
+
+    lo = max(float(max(weights)) if len(weights) else 0.0, 1e-9)
+    hi = max(float(prefix[-1]), lo)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        if parts_needed(mid) is None:
+            lo = mid
+        else:
+            hi = mid
+    bounds = parts_needed(hi)
+    assert bounds is not None
+    return bounds
+
+
+class PipelineModule:
+    """Partition a LayerSpec list over the 'pipe' mesh axis and expose the
+    engine's ModelSpec contract (init_params / loss_fn / partition_rules).
+
+    loss_fn: ``(last_stage_output, labels) -> scalar`` (mean over the
+    micro-batch), the reference's ``loss_fn`` argument (pipe/module.py:86).
+    Batches are ``(inputs, labels)`` tuples (or dicts with 'inputs'/
+    'labels'); leaves carry the full (micro * b) batch dim like the dense
+    engine path.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], loss_fn: Callable,
+                 num_stages: Optional[int] = None,
+                 num_microbatches: int = 4,
+                 partition_method: str = "parameters",
+                 seed_layers: bool = False):
+        self.layers = list(layers)
+        self.user_loss_fn = loss_fn
+        self.num_microbatches = num_microbatches
+        self.partition_method = partition_method
+        topo = get_topology()
+        self.num_stages = num_stages or topo.pipe_parallel_size
+        if topo.pipe_parallel_size not in (1, self.num_stages):
+            raise ValueError(
+                f"num_stages {self.num_stages} != mesh pipe axis "
+                f"{topo.pipe_parallel_size}")
+        if len(self.layers) < self.num_stages:
+            raise ValueError(f"{len(self.layers)} layers < {self.num_stages} stages")
+        del seed_layers  # reference arg, rng handling is explicit here
+        self._partition()
+
+    # -- partitioning (reference _partition_layers, pipe/module.py:393) ------
+    def _layer_weight(self, spec: LayerSpec) -> float:
+        if spec.init_fn is None:
+            return 0.0
+        shapes = jax.eval_shape(spec.init, jax.random.PRNGKey(0))
+        return float(sum(int(np.prod(l.shape)) for l in
+                         jax.tree_util.tree_leaves(shapes)))
+
+    def _partition(self) -> None:
+        method = self.partition_method.lower()
+        n, parts = len(self.layers), self.num_stages
+        if method == "uniform":
+            bounds = [round(i * n / parts) for i in range(parts + 1)]
+        elif method == "parameters":
+            bounds = partition_balanced(
+                [self._layer_weight(s) + 1.0 for s in self.layers], parts)
+        elif method.startswith("type:"):
+            regex = method.split(":", 1)[1]
+            marks = [1.0 if re.search(regex, s.name or type(s).__name__,
+                                      re.IGNORECASE) else 0.0
+                     for s in self.layers]
+            bounds = partition_balanced([m + 1e-6 for m in marks], parts)
+        else:
+            raise ValueError(f"unknown partition_method {self.partition_method}")
+        self.bounds = bounds
+        self.groups: List[List[LayerSpec]] = [
+            self.layers[bounds[i]:bounds[i + 1]] for i in range(parts)]
+        logger.info(f"PipelineModule: {n} layers over {parts} stages, "
+                    f"bounds={bounds} ({self.partition_method})")
+
+    # -- init ----------------------------------------------------------------
+    def _split_tied(self):
+        tied_inits = {}
+        for spec in self.layers:
+            if isinstance(spec, TiedLayerSpec) and spec.key not in tied_inits:
+                tied_inits[spec.key] = spec.init_fn
+        return tied_inits
+
+    def _group_tree_struct(self, group, rng):
+        return jax.eval_shape(
+            lambda r: tuple(s.init(k) for s, k in
+                            zip(group, jax.random.split(r, max(len(group), 1)))
+                            if not isinstance(s, TiedLayerSpec)), rng)
+
+    @property
+    def stackable(self) -> bool:
+        """Per-stage groups structurally identical -> stack over 'pipe'."""
+        if getattr(self, "_stackable", None) is None:
+            rng = jax.random.PRNGKey(0)
+            structs = [self._group_tree_struct(g, rng) for g in self.groups]
+            first = jax.tree_util.tree_structure(structs[0])
+            leaves0 = jax.tree_util.tree_leaves(structs[0])
+            ok = all(
+                jax.tree_util.tree_structure(s) == first and
+                all(a.shape == b.shape and a.dtype == b.dtype
+                    for a, b in zip(jax.tree_util.tree_leaves(s), leaves0))
+                for s in structs[1:])
+            self._stackable = ok
+            if not ok:
+                logger.warning(
+                    "PipelineModule: per-stage layer groups are not "
+                    "structurally identical; parameters will be REPLICATED "
+                    "across pipeline stages (compute still pipelined)")
+        return self._stackable
+
+    def init_params(self, rng) -> Any:
+        tied_inits = self._split_tied()
+        keys = jax.random.split(rng, len(self.layers) + len(tied_inits))
+        group_trees = []
+        ki = 0
+        for group in self.groups:
+            layers_p = []
+            for spec in group:
+                if isinstance(spec, TiedLayerSpec):
+                    ki += 1
+                    continue  # tied params live in the shared subtree
+                layers_p.append(spec.init(keys[ki]))
+                ki += 1
+            group_trees.append(tuple(layers_p))
+        tied = {k: fn(keys[len(self.layers) + i]) if fn is not None else ()
+                for i, (k, fn) in enumerate(tied_inits.items())}
+        if self.stackable:
+            stages = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *group_trees)
+        else:
+            stages = tuple(group_trees)
+        return {"stages": stages, "tied": tied}
+
+    def partition_rules(self) -> List[Tuple[str, P]]:
+        if self.stackable:
+            return [(r"^stages/", P(PIPE_AXIS))]
+        return []
+
+    # -- forward -------------------------------------------------------------
+    def _apply_group(self, g: int, group_params, tied, x):
+        """Run stage g's layers sequentially.  group_params: tuple of
+        non-tied layer params in group order."""
+        it = iter(group_params)
+        for spec in self.groups[g]:
+            p = tied[spec.key] if isinstance(spec, TiedLayerSpec) else next(it)
+            x = spec.apply_fn(p, x)
+        return x
+
+    def _dense_loss(self, params, xs, ys):
+        x = xs
+        for g in range(self.num_stages):
+            gp = (jax.tree_util.tree_map(lambda a: a[g], params["stages"])
+                  if self.stackable else params["stages"][g])
+            x = self._apply_group(g, gp, params["tied"], x)
+        return self.user_loss_fn(x, ys)
+
+    def _ring_struct(self, params, xs_micro, local: bool = False):
+        """Shape/dtype of the stage-boundary activation (output of group 0 on
+        one micro-batch); validates groups 0..P-2 agree.  ``local``: params
+        are a shard_map view (stacked leading dim is 1, not num_stages)."""
+        def run_to(g_end, x):
+            for g in range(g_end + 1):
+                gp = (jax.tree_util.tree_map(
+                    lambda a: a[0 if local else g], params["stages"])
+                      if self.stackable else params["stages"][g])
+                x = self._apply_group(g, gp, params["tied"], x)
+            return x
+
+        shapes = [jax.eval_shape(functools.partial(run_to, g), xs_micro)
+                  for g in range(self.num_stages - 1)]
+        for g, s in enumerate(shapes[1:], 1):
+            if s.shape != shapes[0].shape or s.dtype != shapes[0].dtype:
+                raise ValueError(
+                    f"pipeline stage boundaries must share one activation "
+                    f"shape: stage 0 -> {shapes[0].shape}, stage {g} -> "
+                    f"{s.shape}.  Regroup layers (partition_method) or pad.")
+        return shapes[0]
+
+    def _pipe_body(self, params, xs, ys, *, pp: int):
+        stage = jax.lax.axis_index(PIPE_AXIS)
+        M = self.num_microbatches
+        b = xs.shape[0] // M  # xs here is the LOCAL batch shard
+        xs_mb = xs.reshape(M, b, *xs.shape[1:])
+        ys_mb = ys.reshape(M, b, *ys.shape[1:])
+        tied = params["tied"]
+        ring = self._ring_struct(
+            params, jax.ShapeDtypeStruct((b, *xs.shape[1:]), xs.dtype),
+            local=True)
+        ring_shape, ring_dtype = ring.shape, ring.dtype
+
+        def local_group_params(g: int):
+            if self.stackable:
+                # the local pipe shard [1, ...] IS this stage's group
+                return jax.tree_util.tree_map(lambda a: a[0], params["stages"])
+            return params["stages"][g]
+
+        # every switch branch returns one pytree: (ring buffer, last-stage
+        # output).  Only the executed branch pays its group's compute: embed
+        # runs on stage 0 only, head+loss on the last stage only.
+        last_struct = jax.eval_shape(
+            lambda x: self._apply_group(pp - 1, local_group_params(pp - 1),
+                                        tied, x),
+            jax.ShapeDtypeStruct(ring_shape, ring_dtype))
+
+        def branch(g: int, x_in, buf):
+            out = self._apply_group(g, local_group_params(g),
+                                    tied, x_in if g == 0 else buf)
+            if g == pp - 1:
+                # the last group's output feeds only the loss; its ring slot
+                # is dead (stage 0 injects over it after the permute)
+                return jnp.zeros(ring_shape, ring_dtype), out
+            return (out.astype(ring_dtype),
+                    jnp.zeros(last_struct.shape, last_struct.dtype))
+
+        branches = [functools.partial(branch, g) for g in range(pp)]
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        T = M + pp - 1
+
+        def tick(carry, t):
+            buf, loss_acc = carry
+            x_in = xs_mb[jnp.minimum(t, M - 1)]
+            ring, out = jax.lax.switch(stage, branches, x_in, buf)
+            mb_out = t - (pp - 1)
+            valid = jnp.logical_and(stage == pp - 1,
+                                    jnp.logical_and(mb_out >= 0, mb_out < M))
+            y = ys_mb[jnp.clip(mb_out, 0, M - 1)]
+            loss_t = jax.lax.cond(
+                valid, lambda: self.user_loss_fn(out, y).astype(jnp.float32),
+                lambda: jnp.asarray(0.0, jnp.float32))
+            buf = jax.lax.ppermute(ring, PIPE_AXIS, perm)
+            return (buf, loss_acc + loss_t), None
+
+        buf0 = jnp.zeros(ring_shape, ring_dtype)
+        (_, loss), _ = jax.lax.scan(
+            tick, (buf0, jnp.asarray(0.0, jnp.float32)), jnp.arange(T))
+        loss = jax.lax.psum(loss, PIPE_AXIS) / M
+        for ax in BATCH_AXES:
+            loss = jax.lax.pmean(loss, ax)
+        return loss
+
+    def loss_fn(self, params, batch, rng=None):
+        del rng
+        if isinstance(batch, dict):
+            xs, ys = batch["inputs"], batch["labels"]
+        else:
+            xs, ys = batch
+        topo = get_topology()
+        pp = topo.pipe_parallel_size
+        if pp == 1:
+            return self._dense_loss(params, xs, ys)
+        if pp != self.num_stages:
+            raise ValueError(f"mesh pipe={pp} != num_stages={self.num_stages}")
+        M = self.num_microbatches
+        shards = 1
+        for ax in BATCH_AXES:
+            shards *= topo.axis_size(ax)
+        if xs.shape[0] % shards != 0 or (xs.shape[0] // shards) % M != 0:
+            raise ValueError(
+                f"batch dim {xs.shape[0]} must divide into {shards} "
+                f"data shards x num_microbatches {M} (local micro-batch "
+                f"size must be a positive integer)")
+
+        from ..zero.strategy import ZeroShardingPlan
+
+        plan = ZeroShardingPlan(topo, None, self.partition_rules())
+        param_specs = plan.tree_specs(params, "param")
+        body = functools.partial(self._pipe_body, pp=pp)
+        data_spec = P(BATCH_AXES, *([None] * (xs.ndim - 1)))
+        label_spec = P(BATCH_AXES, *([None] * (ys.ndim - 1)))
+        fn = jax.shard_map(body, mesh=topo.mesh,
+                           in_specs=(param_specs, data_spec, label_spec),
+                           out_specs=P(), check_vma=False)
+        return fn(params, xs, ys)
+
+    def to_model_spec(self) -> ModelSpec:
+        spec = ModelSpec(init_params=self.init_params, loss_fn=self.loss_fn,
+                         partition_rules=self.partition_rules())
+        spec.num_microbatches = self.num_microbatches
+        spec.pipeline_module = self
+        return spec

@@ -54,7 +54,8 @@ def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
     tokens = steps * micro_bs * seq
     tok_s = tokens / dt
     flops = flops_per_token(model.config, seq) * tokens
-    peak = 197e12 if jax.default_backend() == "tpu" else 1e12
+    import bench
+    peak = bench._peak_for(jax.devices()[0])  # per-chip bf16 peak by device kind
     mfu = flops / dt / peak
     print(f"{name:36s} step={dt/steps*1e3:8.1f}ms  tok/s={tok_s:9.0f}  "
           f"mfu={mfu:.3f}", flush=True)
