@@ -82,6 +82,7 @@ class DeepSpeedTPUEngine:
 
         self.zero_plan = ZeroShardingPlan(self.topology, config.zero_config,
                                           self.model.partition_rules())
+        self._configure_zeropp(config)
         self.compute_dtype = config.compute_dtype
         self.grad_accum_dtype = {
             "fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16,
@@ -193,6 +194,48 @@ class DeepSpeedTPUEngine:
                  f"micro_bs={config.train_micro_batch_size_per_gpu} "
                  f"gas={config.gradient_accumulation_steps}")
 
+    def _configure_zeropp(self, config: DeepSpeedConfig) -> None:
+        """ZeRO++ wiring (reference engine.py:1101-1113 config keys).
+
+        qwZ: per-layer weight gathers move int8 (model-cooperative — the
+        transformer core's ``_qwz`` gather points); qgZ: gradient reduction
+        over the data axis rides an int8 all-to-all (zero/zeropp.py); hpZ is
+        pure sharding, handled in ZeroShardingPlan."""
+        zc = config.zero_config
+        self._qgz = False
+        self._qwz = False
+        if zc.zero_quantized_weights:
+            model_cfg = getattr(self.model, "config", None)
+            if zc.stage == 3 and model_cfg is not None \
+                    and hasattr(model_cfg, "qwz") \
+                    and self.topology.pipe_parallel_size == 1:
+                # per-engine flag, applied around tracing (_model_loss): a
+                # shared model object must not become sticky-quantized for
+                # other engines, and the pipe shard_map body cannot host the
+                # forced-gather sharding constraints
+                self._qwz = True
+                log_dist("ZeRO++ qwZ: int8 quantized weight gathers enabled")
+            else:
+                logger.warning(
+                    "zero_quantized_weights needs stage 3, a models/* "
+                    "transformer (qwZ gather points), and no pipeline "
+                    "parallelism; ignoring")
+        if zc.zero_quantized_gradients:
+            from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, REPL_AXIS,
+                                         SEQ_AXIS)
+
+            others = [self.topology.axis_size(a)
+                      for a in (REPL_AXIS, EXPERT_AXIS, SEQ_AXIS)]
+            if zc.stage in (1, 2) and self.topology.axis_size(DATA_AXIS) > 1 \
+                    and all(s == 1 for s in others):
+                self._qgz = True
+                log_dist("ZeRO++ qgZ: int8 all-to-all gradient reduce enabled")
+            else:
+                logger.warning(
+                    "zero_quantized_gradients needs stage 1/2 with data-axis-"
+                    "only batch parallelism (repl/expert/sequence == 1); "
+                    "falling back to the XLA fp reduce")
+
     # ------------------------------------------------------------------ init
     def _init_state(self) -> TrainState:
         """Initialize params already sharded: the analogue of ``zero.Init``
@@ -259,6 +302,20 @@ class DeepSpeedTPUEngine:
         )
 
     # ------------------------------------------------------------- programs
+    def _model_loss(self, p, batch, rng):
+        """model.loss_fn with the engine's qwZ flag applied for the duration
+        of the trace (not a permanent config mutation — engines may share a
+        model object)."""
+        mc = getattr(self.model, "config", None)
+        if mc is None or not hasattr(mc, "qwz"):
+            return self.model.loss_fn(p, batch, rng)
+        old = mc.qwz
+        mc.qwz = self._qwz
+        try:
+            return self.model.loss_fn(p, batch, rng)
+        finally:
+            mc.qwz = old
+
     def _compute_params(self, master_params):
         """fp32 master -> compute-dtype copy, constrained to the live-param
         sharding (stage 3: still sharded; XLA all-gathers per-layer at use,
@@ -270,20 +327,61 @@ class DeepSpeedTPUEngine:
     def _micro_step_body(self, state: TrainState, batch, rng) -> Tuple[TrainState, jnp.ndarray]:
         compute_params = self._compute_params(state.params)
 
-        def scaled_loss_fn(p):
-            loss = self.model.loss_fn(p, batch, rng)
+        def scaled_loss_fn(p, b=None):
+            loss = self._model_loss(p, b if b is not None else batch, rng)
             if self.fp16_enabled:
                 # scale in fp32: the default scale (2^16) overflows float16
                 return loss.astype(jnp.float32) * state.loss_scale.cur_scale, loss
             return loss, loss
 
-        grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
+        if self._qgz:
+            grads, loss = self._qgz_grads(scaled_loss_fn, compute_params, batch)
+        else:
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
         grads = cast_tree(grads, self.grad_accum_dtype)
         grads = self.zero_plan.constrain(grads, "grad")
         new_acc = jax.tree_util.tree_map(jnp.add, state.grad_acc, grads)
         state = dataclasses.replace(state, grad_acc=new_acc,
                                     micro_step=state.micro_step + 1)
         return state, loss.astype(jnp.float32)
+
+    def _qgz_grads(self, scaled_loss_fn, compute_params, batch):
+        """qgZ (ZeRO++ quantized gradient reduce): compute PER-DATA-SHARD
+        partial gradients (vmap over batch chunks — embarrassingly parallel,
+        XLA inserts no gradient collective) and reduce them with an explicit
+        int8 all-to-all (reference all_to_all_quant_reduce,
+        runtime/comm/coalesced_collectives.py:31)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+        from .zero.zeropp import quantized_grad_reduce
+
+        W = self.topology.axis_size(DATA_AXIS)
+
+        def chunk(x):
+            if x.shape[0] % W != 0:
+                raise ValueError(f"qgZ: batch dim {x.shape[0]} not divisible "
+                                 f"by data axis {W}")
+            return x.reshape(W, x.shape[0] // W, *x.shape[1:])
+
+        batch_c = jax.tree_util.tree_map(chunk, batch)
+        grads_c, losses = jax.vmap(
+            lambda b: jax.grad(scaled_loss_fn, has_aux=True)(compute_params, b)
+        )(batch_c)
+        # chunk specs: leading data axis + the param's TP spec (stage<=2:
+        # live params carry no zero axes)
+        from .zero.strategy import _path_str
+
+        chunk_specs = jax.tree_util.tree_map_with_path(
+            lambda path, g: P(DATA_AXIS, *tuple(self.zero_plan.param_spec(
+                _path_str(path), g.shape[1:]))), grads_c)
+        grads_c = jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, jax.sharding.NamedSharding(self.topology.mesh, s)),
+            grads_c, chunk_specs)
+        grads = quantized_grad_reduce(grads_c, chunk_specs,
+                                      self.topology.mesh)
+        return grads, jnp.mean(losses)
 
     def _apply_step_body(self, state: TrainState) -> TrainState:
         gas = self.config.gradient_accumulation_steps or 1

@@ -31,7 +31,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.mesh import EXPERT_AXIS, MeshTopology, ZERO_AXES
+from ...parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MeshTopology, REPL_AXIS,
+                              ZERO_AXES)
 from ...utils.logging import logger
 from ..config import ZeroConfig
 
@@ -77,6 +78,26 @@ class ZeroShardingPlan:
         self.partition_rules = list(partition_rules or [])
         # effective shard group size (MiCS): -1 => whole zero axis group
         self._zero_axes = [a for a in ZERO_AXES if topology.axis_size(a) > 1]
+        # hpZ (ZeRO++ hierarchical partition, reference engine.py:1101-1113):
+        # master/grads shard over the FULL dp (repl x data) while stage-3
+        # live-param gathers ride only the small 'data' axis ("intra-node"
+        # secondary partition).  Mesh contract: data == hpz, repl == dp/hpz.
+        self._state_zero_axes = self._zero_axes
+        hpz = int(getattr(self.config, "zero_hpz_partition_size", 1) or 1)
+        if hpz > 1:
+            if getattr(self.config, "mics_shard_size", -1) and \
+                    self.config.mics_shard_size > 1:
+                raise ValueError("zero_hpz_partition_size and mics_shard_size "
+                                 "are mutually exclusive uses of the repl axis")
+            if topology.axis_size(DATA_AXIS) != hpz:
+                raise ValueError(
+                    f"zero_hpz_partition_size={hpz} needs mesh data axis == "
+                    f"{hpz} and repl == dp/{hpz} (got data="
+                    f"{topology.axis_size(DATA_AXIS)}, repl="
+                    f"{topology.axis_size(REPL_AXIS)}); set mesh "
+                    f"{{'repl': dp//{hpz}, 'data': {hpz}}}")
+            if topology.axis_size(REPL_AXIS) > 1:
+                self._state_zero_axes = [REPL_AXIS] + self._zero_axes
 
     # -- model-parallel (TP/EP) base spec -----------------------------------
     def base_spec(self, path_str: str, ndim: int) -> P:
@@ -107,9 +128,11 @@ class ZeroShardingPlan:
         return spec
 
     # -- zero extension ------------------------------------------------------
-    def _extend_with_zero(self, spec: P, shape: Tuple[int, ...], path_str: str) -> P:
+    def _extend_with_zero(self, spec: P, shape: Tuple[int, ...], path_str: str,
+                          axes: Optional[Sequence[str]] = None) -> P:
         """Insert the ZeRO axes on the largest dim they divide evenly."""
-        zero_axes = [a for a in self._zero_axes if a not in _spec_axes(spec)]
+        zero_axes = [a for a in (axes if axes is not None else self._zero_axes)
+                     if a not in _spec_axes(spec)]
         # expert params: their replicas only exist within an expert group, so
         # the expert axis is already consumed by the rule; nothing special.
         if not zero_axes:
@@ -145,17 +168,20 @@ class ZeroShardingPlan:
         return spec
 
     def master_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
-        """Sharding of fp32 master weights + optimizer moments."""
+        """Sharding of fp32 master weights + optimizer moments (hpZ: over the
+        full repl x data group)."""
         spec = self._check_divisible(self.base_spec(path_str, len(shape)), shape, path_str)
         if self.stage >= 1:
-            spec = self._extend_with_zero(spec, shape, path_str)
+            spec = self._extend_with_zero(spec, shape, path_str,
+                                          self._state_zero_axes)
         return spec
 
     def grad_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
         """Sharding of the gradient-accumulation buffer."""
         spec = self._check_divisible(self.base_spec(path_str, len(shape)), shape, path_str)
         if self.stage >= 2:
-            spec = self._extend_with_zero(spec, shape, path_str)
+            spec = self._extend_with_zero(spec, shape, path_str,
+                                          self._state_zero_axes)
         return spec
 
     # -- tree-level helpers --------------------------------------------------
