@@ -357,6 +357,16 @@ class DeepSpeedTPUEngine:
         from .zero.zeropp import quantized_grad_reduce
 
         W = self.topology.axis_size(DATA_AXIS)
+        if isinstance(batch, dict) and batch.get("attention_mask") is not None:
+            # mean-of-chunk-masked-means != global masked mean when valid
+            # token counts differ across chunks; don't silently change the
+            # objective — use the exact fp reduce for masked batches
+            from ..utils.logging import warning_once
+
+            warning_once("qgZ: batch carries attention_mask — per-chunk "
+                         "masked means would reweight the loss; falling back "
+                         "to the fp gradient reduce for this step")
+            return jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
 
         def chunk(x):
             if x.shape[0] % W != 0:
@@ -574,8 +584,14 @@ class DeepSpeedTPUEngine:
         state = self.state
         gas = float(self.config.gradient_accumulation_steps or 1)
         lr = float(self.lr_schedule(int(state.step)))
-        grads_flat = [np.asarray(jax.device_get(g)) for g in
-                      jax.tree_util.tree_leaves(state.grad_acc)]
+        grad_leaves = jax.tree_util.tree_leaves(state.grad_acc)
+        # kick off every leaf's D2H copy before touching any of them: the
+        # transfers run in parallel instead of leaf-serial device_get
+        # (reference: swap/offload grad copies overlapped with backward)
+        for g in grad_leaves:
+            if hasattr(g, "copy_to_host_async"):
+                g.copy_to_host_async()
+        grads_flat = [np.asarray(jax.device_get(g)) for g in grad_leaves]
         master, norm = self.offload_optimizer.apply_step(grads_flat, lr, gas)
 
         leaves, treedef = jax.tree_util.tree_flatten(state.params)
@@ -685,7 +701,7 @@ class DeepSpeedTPUEngine:
                 p = self._compute_params(params)
                 if self.model.apply_fn is not None:
                     return self.model.apply_fn(p, batch)
-                return self.model.loss_fn(p, batch, None)
+                return self._model_loss(p, batch, None)
 
             self._eval_fn = jax.jit(_eval)
         with self.topology.mesh:

@@ -1,13 +1,18 @@
 """Optimizer-state host offload (ZeRO-Offload) and NVMe spill (ZeRO-Infinity).
 
 Reference: ``zero/offload_config.py`` + CPU-Adam (csrc/adam) + swap_tensor
-(``runtime/swap_tensor/partitioned_param_swapper.py``).  TPU design: fp32
-master weights + Adam moments live in host RAM as numpy arrays; each
-gradient-accumulation boundary pulls the (already reduced) grads from HBM,
-runs the SIMD C++ Adam (ops/cpu/adam.py), and pushes compute-dtype params
-back — HBM then only holds compute params + grads.  With device="nvme",
-moment arrays are spilled to disk through the AIO engine between steps
-(prefetched back right before the update, reads overlapped per-leaf).
+(``runtime/swap_tensor/partitioned_param_swapper.py``,
+``pipelined_optimizer_swapper.py:52``).  TPU design: fp32 master weights +
+Adam moments live in host RAM as numpy arrays; each gradient-accumulation
+boundary pulls the (already reduced) grads from HBM, runs the SIMD C++ Adam
+(ops/cpu/adam.py), and pushes compute-dtype params back — HBM then only
+holds compute params + grads.
+
+With device="nvme" the boundary step is PIPELINED like the reference's
+PipelinedOptimizerSwapper: leaf i+1's moment reads are in flight while
+leaf i runs its Adam step (ping-pong read handles, so waiting on leaf i
+never waits on i+1's prefetch), and spills drain in windows behind the
+compute instead of per leaf.
 """
 
 from __future__ import annotations
@@ -80,6 +85,9 @@ class HostOffloadedOptimizer:
         self.master: List[np.ndarray] = []
         self.nvme_path = nvme_path
         self._aio = None
+        #: spill-drain cadence: bounds host RAM to ~window live moment sets
+        #: while keeping writes off the critical path
+        self.spill_window = 4
         if nvme_path:
             import os
 
@@ -87,6 +95,12 @@ class HostOffloadedOptimizer:
 
             os.makedirs(nvme_path, exist_ok=True)
             self._aio = AsyncIOHandle(thread_count=aio_threads)
+            # ping-pong read handles: drain(one) waits only that handle's
+            # in-flight prefetch, so fetch(i+1) rides through step(i)
+            self._fetch_aio = [AsyncIOHandle(thread_count=max(1, aio_threads // 2)),
+                               AsyncIOHandle(thread_count=max(1, aio_threads // 2))]
+            self._inflight_fetch = [[], []]  # per slot: (key, [(dict, buf)])
+            self._spill_pending: List[int] = []
 
     def initialize_master(self, init_params: Any) -> None:
         flat = jax.tree_util.tree_leaves(init_params)
@@ -106,6 +120,48 @@ class HostOffloadedOptimizer:
         return out
 
     def _spill(self, key: int) -> None:
+        """Synchronous spill (SuperOffload's locked worker path); the
+        pipelined apply_step uses _issue_spill/_flush_spills directly."""
+        self._issue_spill(key)
+        self._flush_spills()
+
+    def _fetch(self, key: int, n: int) -> None:
+        """Synchronous fetch (SuperOffload's locked worker path)."""
+        self._issue_fetch(key, n, 0)
+        self._commit_fetch(0)
+
+    # -- pipelined NVMe swap (reference PipelinedOptimizerSwapper,
+    # runtime/swap_tensor/pipelined_optimizer_swapper.py:52) ----------------
+    def _needs_fetch(self, key: int) -> bool:
+        dicts = self._moment_dicts()
+        # key present but None => spilled to disk; absent => first step, the
+        # kernel will zero-init
+        return bool(dicts) and key in dicts[0][1] and dicts[0][1][key] is None
+
+    def _issue_fetch(self, key: int, n: int, slot: int) -> None:
+        """Submit leaf ``key``'s moment preads on ping-pong handle ``slot``
+        without waiting (the prefetch of the pipelined swapper)."""
+        if self._aio is None or not self._needs_fetch(key):
+            return
+        entries = []
+        for name, d in self._moment_dicts():
+            buf = np.empty(n, np.float32)
+            self._fetch_aio[slot].async_pread(
+                buf, f"{self.nvme_path}/{name}_{key}.bin")
+            entries.append((d, buf))
+        self._inflight_fetch[slot].append((key, entries))
+
+    def _commit_fetch(self, slot: int) -> None:
+        """Wait for handle ``slot``'s in-flight reads and install them."""
+        if self._aio is None or not self._inflight_fetch[slot]:
+            return
+        self._fetch_aio[slot].drain()
+        for key, entries in self._inflight_fetch[slot]:
+            for d, buf in entries:
+                d[key] = buf
+        self._inflight_fetch[slot] = []
+
+    def _issue_spill(self, key: int) -> None:
         if self._aio is None:
             return
         dicts = self._moment_dicts()
@@ -115,38 +171,49 @@ class HostOffloadedOptimizer:
             return
         for name, d in dicts:
             self._aio.async_pwrite(d[key], f"{self.nvme_path}/{name}_{key}.bin")
-        self._aio.drain()
-        for _, d in dicts:
-            d[key] = None  # type: ignore[assignment]  (spilled)
+        self._spill_pending.append(key)
 
-    def _fetch(self, key: int, n: int) -> None:
-        if self._aio is None:
+    def _flush_spills(self) -> None:
+        """Wait for in-flight writes, then free the spilled moments."""
+        if self._aio is None or not self._spill_pending:
             return
-        # key present but None => spilled to disk; absent => first step, the
-        # kernel will zero-init
-        dicts = self._moment_dicts()
-        if not dicts or key not in dicts[0][1] or dicts[0][1][key] is not None:
-            return
-        bufs = []
-        for name, d in dicts:
-            buf = np.empty(n, np.float32)
-            self._aio.async_pread(buf, f"{self.nvme_path}/{name}_{key}.bin")
-            bufs.append((d, buf))
         self._aio.drain()
-        for d, buf in bufs:
-            d[key] = buf
+        dicts = self._moment_dicts()
+        for key in self._spill_pending:
+            for _, d in dicts:
+                d[key] = None  # type: ignore[assignment]  (spilled)
+        self._spill_pending = []
 
     def apply_step(self, grads_flat: List[np.ndarray], lr: float,
                    denom: float) -> Tuple[List[np.ndarray], float]:
         """Run the C++ Adam on every leaf; returns (new master leaves,
-        global grad norm)."""
+        global grad norm).  NVMe moments ride the pipelined swap: fetch of
+        leaf i+1 overlaps the Adam step of leaf i, spills drain every
+        ``spill_window`` leaves behind the compute."""
         gs, norm = scale_and_clip(grads_flat, denom, self.grad_clip)
+        n = len(gs)
         for i, g in enumerate(gs):
             if self.master[i].size != g.size:
                 raise ValueError(f"grad/master size mismatch at leaf {i}")
-            self._fetch(i, g.size)
+        if self._aio is None:
+            for i, g in enumerate(gs):
+                self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
+            return self.master, norm
+
+        if n > 0:
+            self._issue_fetch(0, gs[0].size, 0)
+        if n > 1:
+            self._issue_fetch(1, gs[1].size, 1)
+        for i, g in enumerate(gs):
+            slot = i % 2
+            self._commit_fetch(slot)
             self.cpu_adam.step(self.master[i], g, key=i, lr=lr)
-            self._spill(i)
+            self._issue_spill(i)
+            if i + 2 < n:
+                self._issue_fetch(i + 2, gs[i + 2].size, slot)
+            if len(self._spill_pending) >= self.spill_window:
+                self._flush_spills()
+        self._flush_spills()
         return self.master, norm
 
     def master_as_tree(self, like: Any) -> Any:

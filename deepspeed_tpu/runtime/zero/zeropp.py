@@ -137,6 +137,11 @@ def _a2a_quant_reduce_flat(g: jnp.ndarray, axis: str, world: int) -> jnp.ndarray
     partials = dequantize_lastdim(q_r, s_r, slot, jnp.float32)  # [W, slot]
     reduced = jnp.mean(partials, axis=0)  # this rank's slot, reduced
 
+    # hop 2 gathers the reduced slots back to a full gradient (int8 wire).
+    # For stage 2 the accumulation buffer is data-sharded, so XLA re-slices
+    # the replicated result locally; returning the raw reduce-scattered slot
+    # instead would save this hop but requires mapping the flat slot layout
+    # onto each leaf's sharded dim — a follow-up optimization.
     q2, s2, _ = quantize_lastdim(reduced[None])  # [1, slot]
     q2 = jax.lax.all_gather(q2, axis, axis=0, tiled=True)  # [W, slot]
     s2 = jax.lax.all_gather(s2, axis, axis=0, tiled=True)

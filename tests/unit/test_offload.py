@@ -1,6 +1,7 @@
 """ZeRO-Offload path tests (reference tests/unit/runtime/zero offload tests)."""
 
 import jax
+import os
 import numpy as np
 import pytest
 
@@ -69,3 +70,79 @@ def test_offload_fp16_rejected():
                     "fp16": {"enabled": True},
                     "zero_optimization": {"stage": 2,
                                           "offload_optimizer": {"device": "cpu"}}})
+
+
+def test_nvme_swap_is_pipelined(tmp_path, monkeypatch):
+    """The boundary step overlaps NVMe reads with compute (reference
+    PipelinedOptimizerSwapper, swap_tensor/pipelined_optimizer_swapper.py:52):
+    leaf i+1's moment fetch must be ISSUED before leaf i's Adam step runs,
+    and spill drains happen in windows, not per leaf."""
+    from deepspeed_tpu.runtime.zero.offload import HostOffloadedOptimizer
+    import deepspeed_tpu.ops.cpu.aio as aio_mod
+
+    events = []
+
+    class FakeAIO:
+        def __init__(self, thread_count=1, **kw):
+            self._pending = []
+
+        def async_pread(self, array, path, offset=0):
+            events.append(("pread", os.path.basename(path)))
+            array[...] = np.fromfile(path, np.float32)
+
+        def async_pwrite(self, array, path, offset=0):
+            events.append(("pwrite", os.path.basename(path)))
+            np.asarray(array, np.float32).tofile(path)
+
+        def drain(self):
+            events.append(("drain", ""))
+
+    monkeypatch.setattr(aio_mod, "AsyncIOHandle", FakeAIO)
+    import jax.numpy as jnp_
+
+    leaves = {f"p{i}": jnp_.zeros((64,)) for i in range(6)}
+    opt = HostOffloadedOptimizer(
+        leaves, {"type": "adamw", "params": {"lr": 1e-3}},
+        nvme_path=str(tmp_path / "nv"))
+    opt.spill_window = 2
+    opt.initialize_master(leaves)
+
+    orig_step = opt.cpu_adam.step
+
+    def rec_step(master, g, key, lr):
+        events.append(("step", str(key)))
+        return orig_step(master, g, key=key, lr=lr)
+
+    opt.cpu_adam = type("W", (), {"step": staticmethod(rec_step),
+                                  "_m": opt.cpu_adam._m,
+                                  "_v": opt.cpu_adam._v,
+                                  "state_dict": opt.cpu_adam.state_dict,
+                                  "load_state_dict": opt.cpu_adam.load_state_dict})()
+    gs = [np.ones(64, np.float32) for _ in range(6)]
+    opt.apply_step([g.copy() for g in gs], lr=1e-3, denom=1.0)  # spills all
+    events.clear()
+    opt.apply_step([g.copy() for g in gs], lr=1e-3, denom=1.0)  # fetch+step
+
+    def first(kind, key):
+        return next(i for i, (k, p) in enumerate(events)
+                    if k == kind and (key in p if key else True))
+
+    # prefetch-ahead: leaf 1's (and 2's) reads issued before leaf 0 steps
+    assert first("pread", "_1.bin") < first("step", "0"), events
+    assert first("pread", "_2.bin") < first("step", "1"), events
+    # windowed spill: 6 per-leaf fetch commits + ceil(6/2)=3 spill flushes;
+    # the old per-leaf fetch+spill drains would be >= 12
+    n_drains = sum(1 for k, _ in events if k == "drain")
+    assert n_drains <= 9, (n_drains, events)
+
+
+def test_nvme_pipelined_matches_cpu_offload(tmp_path):
+    """The pipelined disk round-trip must be numerically invisible: NVMe
+    and plain-CPU offload engines produce identical loss trajectories."""
+    e_cpu = _engine(device="cpu")
+    e_nvme = _engine(device="nvme", nvme_path=str(tmp_path / "nv2"))
+    for i in range(6):
+        b = random_batch(batch_size=8, seed=i % 2, gas=1)
+        lc = float(e_cpu.train_batch(b))
+        ln = float(e_nvme.train_batch(b))
+        assert abs(lc - ln) < 1e-6, (i, lc, ln)

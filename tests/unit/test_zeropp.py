@@ -95,11 +95,6 @@ def test_qwz_int8_on_the_wire_and_trains(devices8):
     for a, b in zip(lf, lq):
         assert abs(a - b) / max(abs(a), 1e-6) < 0.05, (lf, lq)
     assert lq[-1] < lq[0]
-    # straight-through VJP: the qwZ'd weights LEARN (grads flow).  After 6
-    # steps the attention weights must have moved from their init.
-    w0 = np.asarray(jax.device_get(
-        jax.tree_util.tree_leaves(e_fp.state.params)[0]))
-    del w0
 
 
 def test_qwz_weights_receive_gradients(devices8):
