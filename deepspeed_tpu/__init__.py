@@ -78,6 +78,34 @@ def initialize(args: Any = None,
     if topology is None:
         topology = initialize_topology(ds_config.mesh)
 
+    # elasticity (reference elasticity/elasticity.py:233): with elastic
+    # config enabled, micro-batch and grad-accum are DERIVED from the
+    # current world size so the global batch stays identical across resizes
+    # — the core of elastic resume.
+    ecfg = (ds_config.raw or {}).get("elasticity", {})
+    if ecfg.get("enabled"):
+        from .elasticity.elasticity import compute_elastic_config
+
+        # use the RESOLVED attributes: "auto" values mean unset
+        explicit_batch = any(v is not None for v in (
+            ds_config.train_batch_size,
+            ds_config.train_micro_batch_size_per_gpu,
+            ds_config.gradient_accumulation_steps))
+        if explicit_batch and not ecfg.get("ignore_non_elastic_batch_info"):
+            raise ValueError(
+                "elasticity is enabled but batch sizes are set explicitly; "
+                "remove them or set elasticity.ignore_non_elastic_batch_info "
+                "(reference elasticity v0.1/0.2 contract)")
+        batch, _, info = compute_elastic_config(
+            ds_config.raw, world_size=topology.dp_world_size)
+        ds_config.train_batch_size = batch
+        ds_config.train_micro_batch_size_per_gpu = info["micro_batch_per_gpu"]
+        ds_config.gradient_accumulation_steps = info["gradient_accumulation_steps"]
+        logger.info(
+            f"elasticity: world={topology.dp_world_size} -> train_batch="
+            f"{batch} micro={info['micro_batch_per_gpu']} "
+            f"gas={info['gradient_accumulation_steps']}")
+
     engine_cls = DeepSpeedTPUEngine
     if ds_config.hybrid_engine.enabled:
         from .runtime.hybrid_engine import DeepSpeedHybridEngine

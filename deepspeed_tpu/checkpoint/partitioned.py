@@ -94,6 +94,7 @@ def save_partitioned(engine, save_dir: str, tag: str,
             "client_state": client_state or {},
             "zero_stage": engine.config.zero_config.stage,
             "mesh": engine.topology.axis_sizes,
+            "elasticity": (engine.config.raw or {}).get("elasticity", {}),
         }
         with open(os.path.join(path, META_FILE), "w") as f:
             json.dump(meta, f, indent=2, default=str)
@@ -148,6 +149,25 @@ def load_partitioned(engine, load_dir: str, tag: Optional[str] = None,
     path = os.path.join(load_dir, tag)
     with open(os.path.join(path, META_FILE)) as f:
         meta = json.load(f)
+
+    # elastic resume (reference DSElasticAgent + --load_universal): a
+    # different mesh than the checkpoint's is fine — shards reassemble and
+    # re-place into the current topology below.  With elasticity configured,
+    # the config must not have drifted across the resize (reference
+    # ensure_immutable_elastic_config, elasticity.py:208).
+    saved_mesh = meta.get("mesh")
+    if saved_mesh and dict(saved_mesh) != dict(engine.topology.axis_sizes):
+        log_dist(f"elastic resume: resharding checkpoint mesh {saved_mesh} "
+                 f"-> current {engine.topology.axis_sizes}")
+    # config drift breaks the batch-size guarantee at ANY scale, not just
+    # across resizes — validate on every elastic resume
+    saved_el = meta.get("elasticity") or {}
+    cur_el = (engine.config.raw or {}).get("elasticity", {})
+    if saved_el.get("enabled") or cur_el.get("enabled"):
+        from ..elasticity.elasticity import ensure_immutable_elastic_config
+
+        ensure_immutable_elastic_config({"elasticity": cur_el},
+                                        {"elasticity": saved_el})
     full = _assemble(path)
 
     from jax.sharding import NamedSharding

@@ -33,6 +33,14 @@ AxisName = Union[str, Sequence[str]]
 
 _INITIALIZED = False
 
+#: rank/size env vars accepted at rendezvous, in priority order: our
+#: launcher's contract first, then each multinode backend's native variable
+#: (launcher/multinode_runner.py builds commands that set/propagate these)
+RANK_ENVS = ("DSTPU_PROCESS_ID", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+             "SLURM_PROCID", "MV2_COMM_WORLD_RANK")
+SIZE_ENVS = ("DSTPU_NUM_PROCESSES", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+             "SLURM_NTASKS", "MV2_COMM_WORLD_SIZE")
+
 
 # --------------------------------------------------------------------------
 # host-level control plane
@@ -55,8 +63,25 @@ def init_distributed(dist_backend: str = "xla",
         return
     coordinator_address = coordinator_address or os.environ.get("DSTPU_COORDINATOR")
     if coordinator_address:
-        num_processes = int(num_processes or os.environ.get("DSTPU_NUM_PROCESSES", "1"))
-        process_id = int(process_id if process_id is not None else os.environ.get("DSTPU_PROCESS_ID", "0"))
+        def _env_first(names, default=None):
+            for nm in names:
+                v = os.environ.get(nm)
+                if v is not None:
+                    return v
+            return default
+
+        # rank/size may come from our launcher (DSTPU_*) or from the MPI /
+        # SLURM backend that started us (launcher/multinode_runner.py:
+        # OpenMPI, MPICH/IMPI hydra, SLURM, MVAPICH)
+        num_processes = int(num_processes or _env_first(SIZE_ENVS, "1"))
+        process_id = int(process_id if process_id is not None
+                         else _env_first(RANK_ENVS, "0"))
+        if num_processes <= 1:
+            # a 1-process job needs no rendezvous, and joining one would
+            # fail if the XLA backend is already up (single-host launcher
+            # runs set the coordinator env unconditionally)
+            _INITIALIZED = True
+            return
         logger.info(f"init_distributed: joining {coordinator_address} "
                     f"({process_id}/{num_processes})")
         jax.distributed.initialize(coordinator_address=coordinator_address,

@@ -113,14 +113,47 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "pdsh", "openmpi", "mpich", "impi",
+                                 "slurm", "mvapich"],
+                        help="multinode backend (reference multinode_runner)")
+    parser.add_argument("--elastic_training", action="store_true",
+                        help="watchdog relaunch on failure with per-attempt "
+                             "host re-discovery (reference DSElasticAgent)")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3)
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.elastic_training:
+        if args.launcher != "ssh":
+            parser.error("--elastic_training currently relaunches over "
+                         "ssh only; --launcher "
+                         f"{args.launcher} is not supported with it")
+        from ..elasticity.elastic_agent import ElasticAgent
+
+        agent = ElasticAgent(hostfile=args.hostfile, include=args.include,
+                             exclude=args.exclude,
+                             max_restarts=args.max_elastic_restarts,
+                             master_addr=args.master_addr,
+                             master_port=args.master_port,
+                             ssh_port=args.ssh_port)
+        return agent.run(args.script, args.script_args)
 
     if args.hostfile:
         hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
     else:
         hosts = OrderedDict([("localhost", 1)])
+
+    if args.launcher != "ssh":
+        from .multinode_runner import get_runner
+
+        runner = get_runner(args.launcher, hosts,
+                            master_addr=args.master_addr,
+                            master_port=args.master_port)
+        cmd = runner.get_cmd(args.script, args.script_args)
+        logger.info(f"launcher[{args.launcher}]: {' '.join(cmd)}")
+        return subprocess.call(cmd)
 
     cmds = build_launch_commands(hosts, args.script, args.script_args,
                                  args.master_addr, args.master_port,

@@ -122,3 +122,61 @@ def test_checkpoint_module_api():
     g = jax.grad(lambda x: checkpointing.checkpoint(f, x))(x)
     assert np.isfinite(float(out))
     assert g.shape == x.shape
+
+
+# ------------------------- multinode runners --------------------------------
+def test_multinode_runner_commands():
+    """Command construction for every backend (reference
+    tests/unit/launcher/test_multinode_runner.py over
+    multinode_runner.py:55-411)."""
+    from collections import OrderedDict
+
+    from deepspeed_tpu.launcher.multinode_runner import RUNNERS, get_runner
+
+    hosts = OrderedDict([("worker-0", 1), ("worker-1", 1)])
+    for name, cls in RUNNERS.items():
+        r = get_runner(name, hosts, master_port=1234,
+                       export_env={"FOO": "bar"})
+        cmd = r.get_cmd("train.py", ["--x", "1"])
+        joined = " ".join(cmd)
+        assert cmd[0] == cls.launcher_binary, (name, cmd)
+        assert "train.py" in joined and "--x" in joined, (name, cmd)
+        # every backend must deliver coordinator + world size
+        assert "DSTPU_COORDINATOR" in joined, (name, cmd)
+        assert "worker-0:1234" in joined, (name, cmd)
+        assert "DSTPU_NUM_PROCESSES" in joined and "2" in joined, (name, cmd)
+        assert "FOO" in joined, (name, cmd)
+
+    # backend-specific shapes
+    slurm = get_runner("slurm", hosts).get_cmd("t.py", [])
+    assert "--ntasks" in slurm and "worker-0,worker-1" in " ".join(slurm)
+    ompi = get_runner("openmpi", hosts).get_cmd("t.py", [])
+    assert "-n" in ompi and "worker-0:1,worker-1:1" in " ".join(ompi)
+    pdsh = get_runner("pdsh", hosts).get_cmd("t.py", [])
+    assert "DSTPU_PROCESS_ID=%n" in " ".join(pdsh)  # pdsh rank substitution
+
+    with pytest.raises(ValueError, match="unknown launcher"):
+        get_runner("nope", hosts)
+
+
+def test_comm_env_rank_discovery(monkeypatch):
+    """comm.init_distributed resolves rank/size from MPI/SLURM env when
+    DSTPU_* is absent (the runners' rank contract)."""
+    from deepspeed_tpu.comm import comm as C
+
+    captured = {}
+
+    def fake_init(coordinator_address, num_processes, process_id):
+        captured.update(addr=coordinator_address, n=num_processes,
+                        pid=process_id)
+
+    monkeypatch.setattr(C, "_INITIALIZED", False)
+    monkeypatch.setattr(C.jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv("DSTPU_COORDINATOR", "w0:29500")
+    monkeypatch.delenv("DSTPU_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("DSTPU_PROCESS_ID", raising=False)
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    C.init_distributed()
+    assert captured == {"addr": "w0:29500", "n": 4, "pid": 3}
+    monkeypatch.setattr(C, "_INITIALIZED", True)  # leave global as the suite expects

@@ -1,0 +1,203 @@
+"""Elastic training tests (reference tests/unit/elasticity/ +
+DSElasticAgent, elasticity/elastic_agent.py:32)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+EL = {"enabled": True, "max_train_batch_size": 32,
+      "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 64}
+
+
+def _cfg(**extra):
+    cfg = {"optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "zero_optimization": {"stage": 1},
+           "elasticity": dict(EL)}
+    cfg.update(extra)
+    return cfg
+
+
+def test_initialize_derives_batch_from_world(devices8):
+    """With elasticity on, micro/gas come from the world size and the
+    GLOBAL batch is world-size independent."""
+    initialize_topology(MeshConfig(data=4), jax.devices()[:4])
+    e4, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(), config=_cfg(mesh={"data": 4}),
+        topology=deepspeed_tpu.get_topology())
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e8, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(), config=_cfg(mesh={"data": 8}),
+        topology=deepspeed_tpu.get_topology())
+    assert e4.train_batch_size() == e8.train_batch_size()
+    assert e4.train_micro_batch_size_per_gpu() * 4 * \
+        e4.gradient_accumulation_steps() == e4.train_batch_size()
+    assert e8.train_micro_batch_size_per_gpu() * 8 * \
+        e8.gradient_accumulation_steps() == e8.train_batch_size()
+
+
+def test_initialize_rejects_explicit_batch_with_elasticity(devices8):
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    with pytest.raises(ValueError, match="elasticity"):
+        deepspeed_tpu.initialize(
+            model=simple_mlp_spec(),
+            config=_cfg(train_micro_batch_size_per_gpu=4, mesh={"data": 8}),
+            topology=deepspeed_tpu.get_topology())
+
+
+def test_elastic_resume_4_to_8_devices(devices8, tmp_path):
+    """The VERDICT done-criterion: train on 4 devices, save, resume on 8 —
+    the loss continuation is identical to an uninterrupted 8-device run
+    (same global batches, exact fp32 state round-trip, resharded load)."""
+    def batch(i, bs):
+        return random_batch(batch_size=bs, seed=i % 3, gas=1)
+
+    def make(ndev):
+        initialize_topology(MeshConfig(data=ndev), jax.devices()[:ndev])
+        e, *_ = deepspeed_tpu.initialize(
+            model=simple_mlp_spec(), config=_cfg(mesh={"data": ndev}),
+            topology=deepspeed_tpu.get_topology())
+        return e
+
+    # uninterrupted control on 8 devices
+    ctrl = make(8)
+    gb = ctrl.train_batch_size()
+    ctrl_losses = [float(ctrl.train_batch(batch(i, gb))) for i in range(6)]
+
+    # elastic run: 3 steps on 4 devices -> save -> resume on 8 -> 3 steps
+    e4 = make(4)
+    assert e4.train_batch_size() == gb  # same global batch at both scales
+    for i in range(3):
+        e4.train_batch(batch(i, gb))
+    e4.save_checkpoint(str(tmp_path), tag="resize", partitioned=True)
+
+    e8 = make(8)
+    e8.load_checkpoint(str(tmp_path))
+    assert e8.global_steps == 3
+    resumed = [float(e8.train_batch(batch(i, gb))) for i in range(3, 6)]
+    np.testing.assert_allclose(resumed, ctrl_losses[3:], rtol=1e-5, atol=1e-6)
+
+
+def test_elastic_resume_immutability_enforced(devices8, tmp_path):
+    """A drifted elastic config across a resize must be rejected
+    (reference ensure_immutable_elastic_config, elasticity.py:208)."""
+    initialize_topology(MeshConfig(data=4), jax.devices()[:4])
+    e4, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(), config=_cfg(mesh={"data": 4}),
+        topology=deepspeed_tpu.get_topology())
+    e4.train_batch(random_batch(batch_size=e4.train_batch_size(), seed=0, gas=1))
+    e4.save_checkpoint(str(tmp_path), tag="t", partitioned=True)
+
+    drifted = dict(EL, max_train_batch_size=16)
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e8, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(), config=_cfg(elasticity=drifted, mesh={"data": 8}),
+        topology=deepspeed_tpu.get_topology())
+    with pytest.raises(ValueError, match="elastic config changed"):
+        e8.load_checkpoint(str(tmp_path))
+
+
+def test_elastic_agent_restarts_until_success(tmp_path):
+    """The watchdog relaunches a failing job; the third attempt succeeds."""
+    marker = tmp_path / "attempts"
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import sys, pathlib\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n")
+    agent = ElasticAgent(max_restarts=5, restart_delay_s=0.0)
+    rc = agent.run(str(script))
+    assert rc == 0
+    assert agent.attempts == 3
+    assert int(marker.read_text()) == 3
+
+
+def test_elastic_agent_gives_up_after_max_restarts(tmp_path):
+    script = tmp_path / "always_fail.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    agent = ElasticAgent(max_restarts=2, restart_delay_s=0.0)
+    rc = agent.run(str(script))
+    assert rc != 0
+    assert agent.attempts == 3  # 1 try + 2 restarts
+
+
+def test_elastic_agent_rediscovers_hosts_each_attempt(tmp_path, monkeypatch):
+    """Membership change between attempts: the hostfile is re-read, and the
+    relaunch uses the NEW world size (the reference agent's rendezvous
+    membership change -> restart at new scale)."""
+    hf = tmp_path / "hostfile"
+    hf.write_text("localhost slots=1\n")
+    agent = ElasticAgent(hostfile=str(hf), max_restarts=2, restart_delay_s=0.0)
+
+    calls = []
+
+    def fake_attempt(cmds):
+        calls.append(len(cmds))
+        if len(calls) == 1:
+            hf.write_text("hostA slots=1\nhostB slots=1\n")  # resize up
+            return 1  # first attempt dies
+        return 0
+
+    monkeypatch.setattr(agent, "_run_attempt", fake_attempt)
+    rc = agent.run("train.py")
+    assert rc == 0
+    assert agent.world_sizes == [1, 2], agent.world_sizes
+    assert calls == [1, 2]
+
+
+def test_launcher_elastic_flag(tmp_path):
+    """--elastic_training routes through the agent end-to-end."""
+    from deepspeed_tpu.launcher import runner
+
+    marker = tmp_path / "n"
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import sys, pathlib\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 1 else 1)\n")
+    rc = runner.main(["--elastic_training", "--max_elastic_restarts", "3",
+                      str(script)])
+    assert rc == 0
+    assert int(marker.read_text()) == 2
+
+
+def test_elastic_immutability_checked_at_same_scale(devices8, tmp_path):
+    """Config drift is rejected even when the mesh did NOT change (the
+    most common restart; code-review r3 finding)."""
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(), config=_cfg(mesh={"data": 8}),
+        topology=deepspeed_tpu.get_topology())
+    e.train_batch(random_batch(batch_size=e.train_batch_size(), seed=0, gas=1))
+    e.save_checkpoint(str(tmp_path), tag="t", partitioned=True)
+
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e2, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config=_cfg(elasticity=dict(EL, max_train_batch_size=16),
+                    mesh={"data": 8}),
+        topology=deepspeed_tpu.get_topology())
+    with pytest.raises(ValueError, match="elastic config changed"):
+        e2.load_checkpoint(str(tmp_path))
+
+
+def test_elasticity_accepts_auto_batch(devices8):
+    """'auto' batch values are unset, not explicit — elasticity must accept
+    them (HF integrations pass 'auto')."""
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config=_cfg(train_batch_size="auto", mesh={"data": 8}),
+        topology=deepspeed_tpu.get_topology())
+    assert e.train_batch_size() == 16  # the most world-size-compatible batch
