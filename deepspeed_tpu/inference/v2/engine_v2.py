@@ -39,6 +39,10 @@ class RaggedInferenceConfig(ConfigModel):
     max_seqs: int = 8
     max_pages_per_seq: int = 16
     min_prefill_bucket: int = 16
+    # weight-only quantization (reference inference/quantization/): 0 = off
+    quant_bits: int = 0
+    quant_group: int = 128
+    quant_min_size: int = 1 << 14  # per-matrix eligibility floor
 
     @property
     def jnp_dtype(self):
@@ -70,7 +74,9 @@ class InferenceEngineV2:
         if not hasattr(model, "config") or not isinstance(model.config, TransformerConfig):
             raise TypeError("InferenceEngineV2 needs a models/* model carrying "
                             "a TransformerConfig")
-        self.cfg: TransformerConfig = model.config
+        # own COPY of the model config: quantization flags must not leak
+        # into other engines sharing the model object
+        self.cfg: TransformerConfig = dataclasses.replace(model.config)
         block = self.config.block
         if block.num_pages < block.max_pages_per_seq:
             raise ValueError(
@@ -80,6 +86,16 @@ class InferenceEngineV2:
         if params is None:
             params = model.init_params(jax.random.PRNGKey(seed))
         self.params = cast_tree(params, self.config.jnp_dtype)
+        self.param_bytes = sum(l.size * l.dtype.itemsize for l in
+                               jax.tree_util.tree_leaves(self.params))
+        if self.config.quant_bits:
+            from ..quantization import quantize_inference_params
+
+            self.cfg.wq_bits = int(self.config.quant_bits)
+            self.cfg.wq_group = int(self.config.quant_group)
+            self.params, _, self.param_bytes = quantize_inference_params(
+                self.params, self.cfg.wq_bits, self.cfg.wq_group,
+                min_size=self.config.quant_min_size)
         pool = PagedKVCache.init(self.cfg.n_layers, self.cfg.kv_heads,
                                  self.cfg.head_dim, block, self.config.jnp_dtype)
         self._k_pool, self._v_pool = pool["k"], pool["v"]

@@ -24,8 +24,9 @@ from typing import Any, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...models.transformer import (TransformerConfig, _norm, _repeat_kv,
-                                   attn_qkv, logits_fn, mlp_block)
+from ...models.transformer import (MODEL_AXIS, TransformerConfig, _mm,
+                                   _norm, _repeat_kv, attn_qkv, logits_fn,
+                                   mlp_block)
 
 
 def _ffn(cfg: TransformerConfig, layer, x):
@@ -68,7 +69,7 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
         scores = jnp.where(causal, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, S, -1)
-        attn_delta = (attn @ layer["attn"]["wo"]
+        attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
                       + (layer["attn"]["bo"] if cfg.use_bias else 0))
         if cfg.parallel_block:
             return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c)
@@ -118,7 +119,7 @@ def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
         scores = jnp.where(vis[:, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
-        attn_delta = (attn @ layer["attn"]["wo"]
+        attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
                       + (layer["attn"]["bo"] if cfg.use_bias else 0))
         if cfg.parallel_block:
             return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c)

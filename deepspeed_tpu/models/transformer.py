@@ -70,6 +70,11 @@ class TransformerConfig:
     # ZeRO++ qwZ: per-layer weight gathers move int8 codes + block scales
     # instead of bf16 (set by the engine when zero_quantized_weights is on)
     qwz: bool = False
+    # weight-only quantized inference (reference inference/quantization/):
+    # big matmul weights stored as int8/int4 codes + group scales; 0 = off.
+    # Set by InferenceEngineV2 on ITS OWN config copy, never on a shared one.
+    wq_bits: int = 0
+    wq_group: int = 128
 
     @property
     def kv_heads(self) -> int:
@@ -202,6 +207,19 @@ def _qwz(cfg: TransformerConfig, w, *tp_entries):
     return qwz_gather(w, P(*tp_entries), get_topology().mesh, w.dtype)
 
 
+def _mm(cfg: TransformerConfig, x, leaf, *tp_entries):
+    """``x @ W`` through the weight-access seam: W is either a plain array
+    (optionally qwZ-gathered) or a weight-only-quantized {"wq", "scale"}
+    dict (reference inference/quantization weight-only path) — then the
+    matmul runs the Pallas in-VMEM-dequant kernel."""
+    if isinstance(leaf, dict) and "wq" in leaf:
+        from ..ops.pallas.wq_matmul import wq_matmul
+
+        return wq_matmul(x, leaf["wq"], leaf["scale"], bits=cfg.wq_bits,
+                         group=cfg.wq_group)
+    return x @ _qwz(cfg, leaf, *tp_entries)
+
+
 def _norm(x, scale, bias, kind: str, eps: float):
     xf = x.astype(jnp.float32)
     if kind == "rmsnorm":
@@ -296,9 +314,9 @@ def attn_qkv(cfg: TransformerConfig, layer, x, positions):
     a = layer["attn"]
     qb = cfg.use_bias or cfg.qkv_bias
     h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
-    q = (h @ _qwz(cfg, a["wq"], None, MODEL_AXIS) + (a["bq"] if qb else 0)).reshape(B, T, NH, D)
-    k = (h @ _qwz(cfg, a["wk"], None, MODEL_AXIS) + (a["bk"] if qb else 0)).reshape(B, T, KVH, D)
-    v = (h @ _qwz(cfg, a["wv"], None, MODEL_AXIS) + (a["bv"] if qb else 0)).reshape(B, T, KVH, D)
+    q = (_mm(cfg, h, a["wq"], None, MODEL_AXIS) + (a["bq"] if qb else 0)).reshape(B, T, NH, D)
+    k = (_mm(cfg, h, a["wk"], None, MODEL_AXIS) + (a["bk"] if qb else 0)).reshape(B, T, KVH, D)
+    v = (_mm(cfg, h, a["wv"], None, MODEL_AXIS) + (a["bv"] if qb else 0)).reshape(B, T, KVH, D)
     if cfg.position == "rope":
         q = _rope(q, cfg.rope_theta, positions, cfg.rotary_pct)
         k = _rope(k, cfg.rope_theta, positions, cfg.rotary_pct)
@@ -325,14 +343,14 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
         h, aux = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
                          training=training)
     elif cfg.activation == "swiglu":
-        h = (jax.nn.silu(h @ _qwz(cfg, m["w_gate"], None, MODEL_AXIS))
-             * (h @ _qwz(cfg, m["w_up"], None, MODEL_AXIS))) \
-            @ _qwz(cfg, m["w_down"], MODEL_AXIS, None)
+        h = _mm(cfg, jax.nn.silu(_mm(cfg, h, m["w_gate"], None, MODEL_AXIS))
+                * _mm(cfg, h, m["w_up"], None, MODEL_AXIS),
+                m["w_down"], MODEL_AXIS, None)
     else:
         act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
-        h = act(h @ _qwz(cfg, m["w_up"], None, MODEL_AXIS)
-                + (m["b_up"] if cfg.use_bias else 0)) \
-            @ _qwz(cfg, m["w_down"], MODEL_AXIS, None)
+        h = _mm(cfg, act(_mm(cfg, h, m["w_up"], None, MODEL_AXIS)
+                         + (m["b_up"] if cfg.use_bias else 0)),
+                m["w_down"], MODEL_AXIS, None)
         if cfg.use_bias:
             h = h + m["b_down"]
     return x + h, aux
@@ -349,7 +367,7 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
     v = _repeat_kv(v, NH // KVH)
     attn = attn_fn(q, k, v, cfg.causal, mask)
     attn = attn.reshape(B, S, NH * D)
-    attn_delta = attn @ _qwz(cfg, a["wo"], MODEL_AXIS, None) \
+    attn_delta = _mm(cfg, attn, a["wo"], MODEL_AXIS, None) \
         + (a["bo"] if cfg.use_bias else 0)
     if cfg.parallel_block:
         # falcon/phi: attention and MLP both read the block input
@@ -394,7 +412,10 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None):
 def logits_fn(cfg: TransformerConfig, params, hidden):
     if cfg.tie_embeddings:
         return hidden @ params["embed"]["tok"].T
-    return hidden @ params["lm_head"]["w"]
+    w = params["lm_head"]["w"]
+    if isinstance(w, dict):  # weight-only quantized head
+        return _mm(cfg, hidden, w)
+    return hidden @ w
 
 
 def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
@@ -502,7 +523,8 @@ def _block_decode(cfg: TransformerConfig, x, layer, k_cache, v_cache, position):
     scores = jnp.where(slot <= limit, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, T, NH * D)
-    attn_delta = attn @ a["wo"] + (a["bo"] if cfg.use_bias else 0)
+    attn_delta = _mm(cfg, attn, a["wo"], MODEL_AXIS, None) \
+        + (a["bo"] if cfg.use_bias else 0)
     if cfg.parallel_block:
         out, _ = mlp_block(cfg, layer, x, training=False)
         return out + attn_delta, k_cache, v_cache

@@ -173,3 +173,69 @@ def test_prefill_bucket_capped_to_model_context():
         [RaggedRequest(prompt_ids=list(range(1, 34)), max_new_tokens=4)])
     (toks,) = out.values()
     assert len(toks) >= 1
+
+
+# ----------------- weight-only quantized inference (ZeRO++-adjacent) -------
+def test_wq_matmul_matches_dequant():
+    """Pallas/XLA weight-quantized matmul == explicit dequant matmul, int8
+    and packed int4 (reference inference/quantization weight-only path)."""
+    from deepspeed_tpu.ops.pallas.wq_matmul import (dequantize_weight,
+                                                    quantize_weight,
+                                                    wq_matmul)
+    rng = np.random.RandomState(0)
+    for bits in (8, 4):
+        for K, N in [(128, 64), (200, 96)]:  # 200: padded packing
+            w = jnp.asarray(rng.randn(K, N).astype(np.float32))
+            x = jnp.asarray(rng.randn(5, K).astype(np.float32))
+            codes, scale = quantize_weight(w, bits, group=64)
+            wd = dequantize_weight(codes, scale, bits=bits, group=64, k=K,
+                                   dtype=jnp.float32)
+            # quantization error bounded by half a step per group
+            assert float(jnp.abs(wd - w).max()) <= \
+                float(jnp.abs(w).max()) / (254 if bits == 8 else 14) + 1e-6
+            for impl in ("xla", "pallas"):  # pallas: interpret mode on CPU
+                y = wq_matmul(x, codes, scale, bits=bits, group=64, impl=impl)
+                np.testing.assert_allclose(np.asarray(y), np.asarray(x @ wd),
+                                           rtol=2e-5, atol=2e-5,
+                                           err_msg=f"{bits}b {impl}")
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_v2_engine_generates_with_quantized_weights(bits):
+    """The paged engine generates with int8/int4 weights: logits close to
+    bf16, weight bytes measurably lower."""
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=64, attn_impl="xla")
+    params = model.init_params(jax.random.PRNGKey(0))
+    cfg = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=32,
+                                max_seqs=2, max_pages_per_seq=8)
+    qcfg = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=32,
+                                 max_seqs=2, max_pages_per_seq=8,
+                                 quant_bits=bits, quant_group=64,
+                                 quant_min_size=1024)  # tiny test matrices
+    e_fp = InferenceEngineV2(model, cfg, params=params)
+    e_q = InferenceEngineV2(model, qcfg, params=params)
+    # flags stay on the engine's own config copy
+    assert model.config.wq_bits == 0
+    # HBM at rest: int8 ~2x lower, int4 ~4x lower on the quantized leaves
+    assert e_q.param_bytes < e_fp.param_bytes * (0.72 if bits == 8 else 0.6)
+
+    prompt = list(range(1, 20))
+    from deepspeed_tpu.inference.v2.model_runner import paged_prefill
+    ids = np.zeros((32,), np.int32)
+    ids[:len(prompt)] = prompt
+    rows = np.arange(4, dtype=np.int32)
+    lf, *_ = paged_prefill(e_fp.cfg, e_fp.params, e_fp._k_pool, e_fp._v_pool,
+                           jnp.asarray(ids), jnp.asarray(rows),
+                           jnp.int32(len(prompt)))
+    lq, *_ = paged_prefill(e_q.cfg, e_q.params, e_q._k_pool, e_q._v_pool,
+                           jnp.asarray(ids), jnp.asarray(rows),
+                           jnp.int32(len(prompt)))
+    lf, lq = np.asarray(lf, np.float64), np.asarray(lq, np.float64)
+    cos = float((lf * lq).sum() / (np.linalg.norm(lf) * np.linalg.norm(lq)))
+    assert cos > (0.999 if bits == 8 else 0.98), cos
+
+    out = e_q.generate_all([RaggedRequest(prompt_ids=prompt, max_new_tokens=8)])
+    toks = list(out.values())[0]
+    assert len(toks) == 8 and all(0 <= t < 256 for t in toks)
