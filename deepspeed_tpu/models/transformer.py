@@ -65,6 +65,10 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    moe_drop_tokens: bool = True  # False => dropless sort+grouped-matmul path
+    # PR-MoE residual experts (reference moe/layer.py use_residual): a dense
+    # MLP runs beside the MoE and a learned 2-way coefficient mixes them
+    moe_use_residual: bool = False
     # ALST-style tiled logits+loss: sequence chunk size (0 = off)
     loss_chunk: int = 0
     # ZeRO++ qwZ: per-layer weight gathers move int8 codes + block scales
@@ -101,7 +105,7 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     H, L = cfg.hidden_size, cfg.n_layers
     D, NH, KVH = cfg.head_dim, cfg.n_heads, cfg.kv_heads
     F, V = cfg.ffn_size, cfg.vocab_size
-    keys = jax.random.split(rng, 12)
+    keys = jax.random.split(rng, 13)
     dt = cfg.dtype
     std = 0.02
 
@@ -138,6 +142,10 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         layers["mlp"]["w_gate"] = nrm(keys[8], L, E, H, F)
         layers["mlp"]["w_up"] = nrm(keys[10], L, E, H, F)
         layers["mlp"]["w_down"] = nrm(keys[9], L, E, F, H, s=proj_out_std)
+        if cfg.moe_use_residual:  # PR-MoE: dense residual MLP + mixer
+            layers["mlp"]["res_w_up"] = nrm(keys[11], L, H, F)
+            layers["mlp"]["res_w_down"] = nrm(keys[12], L, F, H, s=proj_out_std)
+            layers["mlp"]["coef"] = jnp.zeros((L, H, 2), dt)
     elif cfg.activation == "swiglu":
         layers["mlp"]["w_gate"] = nrm(keys[7], L, H, F)
         layers["mlp"]["w_up"] = nrm(keys[8], L, H, F)
@@ -179,6 +187,9 @@ def transformer_partition_rules(cfg: TransformerConfig) -> List[Tuple[str, P]]:
             (r"mlp/router$", P(*lead, None, None)),  # gate replicated
             (r"mlp/w_(gate|up)$", P(*lead, "expert", None, MODEL_AXIS)),
             (r"mlp/w_down$", P(*lead, "expert", MODEL_AXIS, None)),
+            (r"mlp/res_w_up$", P(*lead, None, MODEL_AXIS)),  # PR-MoE dense
+            (r"mlp/res_w_down$", P(*lead, MODEL_AXIS, None)),
+            (r"mlp/coef$", P(*lead, None, None)),
         ]
     else:
         rules += [
@@ -339,9 +350,20 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
 
         moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                             capacity_factor=cfg.moe_capacity_factor,
-                            aux_loss_coef=cfg.moe_aux_coef)
-        h, aux = moe_ffn(h, m["router"], m, moe_cfg, activation=cfg.activation,
-                         training=training)
+                            aux_loss_coef=cfg.moe_aux_coef,
+                            drop_tokens=cfg.moe_drop_tokens)
+        moe_out, aux = moe_ffn(h, m["router"], m, moe_cfg,
+                               activation=cfg.activation, training=training)
+        if cfg.moe_use_residual:
+            # PR-MoE (reference moe/layer.py use_residual): dense MLP beside
+            # the MoE, mixed by a learned per-token 2-way coefficient
+            act = jax.nn.silu if cfg.activation == "swiglu" else jax.nn.gelu
+            res = _mm(cfg, act(_mm(cfg, h, m["res_w_up"], None, MODEL_AXIS)),
+                      m["res_w_down"], MODEL_AXIS, None)  # plain dense MLP
+            coef = jax.nn.softmax((h @ m["coef"]).astype(jnp.float32), -1)
+            h = (moe_out * coef[..., 0:1] + res * coef[..., 1:2]).astype(x.dtype)
+        else:
+            h = moe_out
     elif cfg.activation == "swiglu":
         h = _mm(cfg, jax.nn.silu(_mm(cfg, h, m["w_gate"], None, MODEL_AXIS))
                 * _mm(cfg, h, m["w_up"], None, MODEL_AXIS),

@@ -49,25 +49,11 @@ def top_k_gating(logits: jnp.ndarray, cfg: MoEConfig, capacity: int,
     drop_tokens=True path).
     """
     T, E = logits.shape
-    if cfg.noisy_gate_policy == "Jitter" and rng is not None:
-        logits = logits * jax.random.uniform(rng, logits.shape, minval=0.98, maxval=1.02)
-    elif cfg.noisy_gate_policy == "RSample" and rng is not None:
-        logits = logits + jax.random.normal(rng, logits.shape) / E
-
-    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
-
-    # top-k expert indices per token
-    _, expert_idx = jax.lax.top_k(gates, cfg.top_k)  # [T, K]
+    # gate probabilities, top-k routing and the load-balance aux are shared
+    # with the dropless path (_gate_and_aux); this function adds only the
+    # capacity/drop machinery
+    gates, expert_idx, _, aux = _gate_and_aux(logits, cfg, rng)
     onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, K, E]
-
-    # load-balancing aux loss (reference sharded_moe.py top2gating): uses the
-    # top-1 assignment fraction x mean gate prob
-    me = jnp.mean(gates, axis=0)  # [E]
-    ce = jnp.mean(onehot[:, 0, :], axis=0)  # fraction routed top-1
-    aux = jnp.sum(me * ce) * E * cfg.aux_loss_coef
-    if cfg.z_loss_coef > 0:
-        aux = aux + cfg.z_loss_coef * jnp.mean(
-            jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)))
 
     # position of each (token, k) within its expert's buffer: cumulative count
     # over tokens for that expert, k-major so k=0 assignments take priority
@@ -91,6 +77,87 @@ def top_k_gating(logits: jnp.ndarray, cfg: MoEConfig, capacity: int,
     return combine, dispatch, aux
 
 
+def _gate_and_aux(logits: jnp.ndarray, cfg: MoEConfig, rng=None):
+    """Shared top-k gate probabilities + load-balance aux (no capacity)."""
+    E = logits.shape[-1]
+    if cfg.noisy_gate_policy == "Jitter" and rng is not None:
+        logits = logits * jax.random.uniform(rng, logits.shape, minval=0.98,
+                                             maxval=1.02)
+    elif cfg.noisy_gate_policy == "RSample" and rng is not None:
+        logits = logits + jax.random.normal(rng, logits.shape) / E
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    _, expert_idx = jax.lax.top_k(gates, cfg.top_k)  # [T, K]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(onehot[:, 0, :], axis=0)
+    aux = jnp.sum(me * ce) * E * cfg.aux_loss_coef
+    if cfg.z_loss_coef > 0:
+        aux = aux + cfg.z_loss_coef * jnp.mean(
+            jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)))
+    gate_k = jnp.take_along_axis(gates, expert_idx, axis=1)  # [T, K]
+    gate_k = gate_k / jnp.maximum(jnp.sum(gate_k, -1, keepdims=True), 1e-9)
+    return gates, expert_idx, gate_k, aux
+
+
+def _expert_ffn_blocks(xs, experts, block_expert, activation, block_rows):
+    """The three grouped matmuls of one FFN over sorted+padded tokens."""
+    from ..ops.pallas.grouped_matmul import grouped_matmul
+
+    gm = lambda a, w: grouped_matmul(a, w, block_expert, block_rows)  # noqa: E731
+    if activation == "swiglu":
+        h = jax.nn.silu(gm(xs, experts["w_gate"])) * gm(xs, experts["w_up"])
+    else:
+        h = jax.nn.gelu(gm(xs, experts["w_up"]))
+    return gm(h, experts["w_down"])
+
+
+def moe_ffn_dropless(x: jnp.ndarray, gate_w: jnp.ndarray,
+                     experts: Dict[str, jnp.ndarray], cfg: MoEConfig,
+                     activation: str = "swiglu", rng=None,
+                     block_rows: int = 128) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """drop_tokens=False (reference top-k gating with drop_tokens=False /
+    Megablocks dropless): NO token is ever dropped.  Tokens are sorted by
+    expert and padded to block boundaries (static worst-case P = T*K +
+    E*block_rows), then the grouped Pallas matmul streams block-diagonal
+    expert FFNs through the MXU.
+    """
+    B, S, H = x.shape
+    T = B * S
+    E = cfg.num_experts
+    K = cfg.top_k
+    xt = x.reshape(T, H)
+
+    logits = xt @ gate_w
+    _, expert_idx, gate_k, aux = _gate_and_aux(logits, cfg, rng)
+
+    flat_e = expert_idx.reshape(T * K)
+    flat_g = gate_k.reshape(T * K)
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    token_of = order // K
+
+    counts = jnp.bincount(flat_e, length=E)  # tokens per expert
+    starts_raw = jnp.cumsum(counts) - counts
+    padded = ((counts + block_rows - 1) // block_rows) * block_rows
+    starts = jnp.cumsum(padded) - padded  # block-aligned expert starts
+    rank_in_e = jnp.arange(T * K) - starts_raw[sorted_e]
+    dest = starts[sorted_e] + rank_in_e  # [T*K] rows in the padded buffer
+
+    # static worst case of sum(padded), rounded to whole blocks
+    P = (-(-(T * K) // block_rows) + E) * block_rows
+    xs = jnp.zeros((P, H), x.dtype).at[dest].set(xt[token_of])
+    block_starts = jnp.arange(P // block_rows) * block_rows
+    # expert of each block: the unique expert whose padded span covers it
+    # (blocks past the used region get expert 0 on zero rows -> zero output)
+    block_expert = jnp.searchsorted(starts, block_starts, side="right") - 1
+    block_expert = jnp.clip(block_expert, 0, E - 1).astype(jnp.int32)
+
+    ys = _expert_ffn_blocks(xs, experts, block_expert, activation, block_rows)
+    contrib = ys[dest] * flat_g[order][:, None].astype(ys.dtype)
+    out = jnp.zeros((T, H), x.dtype).at[token_of].add(contrib.astype(x.dtype))
+    return out.reshape(B, S, H), aux
+
+
 def moe_ffn(x: jnp.ndarray, gate_w: jnp.ndarray, experts: Dict[str, jnp.ndarray],
             cfg: MoEConfig, activation: str = "swiglu", rng=None,
             training: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -99,6 +166,8 @@ def moe_ffn(x: jnp.ndarray, gate_w: jnp.ndarray, experts: Dict[str, jnp.ndarray]
     experts: stacked weights {w_gate/w_up: [E, H, F], w_down: [E, F, H]}
     (w_gate only for swiglu).  Returns (out [B, S, H], aux_loss).
     """
+    if not cfg.drop_tokens:
+        return moe_ffn_dropless(x, gate_w, experts, cfg, activation, rng)
     B, S, H = x.shape
     T = B * S
     xt = x.reshape(T, H)

@@ -1,0 +1,69 @@
+"""Grouped (block-diagonal) expert matmul — Megablocks-style, Pallas TPU.
+
+Reference parity: the grouped MoE GEMMs in
+``deepspeed/inference/v2/kernels/cutlass_ops`` (grouped_gemm) and the
+dropless-MoE direction of ``moe/sharded_moe.py`` — tokens are sorted by
+expert and padded so every row-block belongs to exactly ONE expert; the
+kernel then streams blocks through the MXU, selecting each block's expert
+weight matrix via a scalar-prefetched block->expert map (the TPU version
+of Megablocks' block-diagonal sparsity).
+
+``x``: [P, H] sorted+padded tokens, ``w``: [E, H, F] stacked expert
+weights, ``block_expert``: [P / block_rows] int32.  Returns [P, F].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _gmm_kernel(be_ref, x_ref, w_ref, o_ref):
+    # w_ref block was selected by the scalar-prefetched index map: it is
+    # already THIS block's expert matrix
+    x = x_ref[...].astype(jnp.float32)  # [bs, H]
+    w = w_ref[0].astype(jnp.float32)  # [H, F]
+    o_ref[...] = (x @ w).astype(o_ref.dtype)
+
+
+def grouped_matmul(x: jnp.ndarray, w: jnp.ndarray,
+                   block_expert: jnp.ndarray, block_rows: int = 128,
+                   impl: str = "auto") -> jnp.ndarray:
+    """Block-grouped ``x @ w[block_expert[block]]``.
+
+    Every ``block_rows`` rows of ``x`` share one expert.  P must be a
+    multiple of ``block_rows`` (the no-drop router pads per expert)."""
+    P, H = x.shape
+    E, _, F = w.shape
+    assert P % block_rows == 0, (P, block_rows)
+    n_blocks = P // block_rows
+
+    if impl == "xla" or (impl == "auto" and _interpret()):
+        wb = w[block_expert]  # [n_blocks, H, F]
+        xb = x.reshape(n_blocks, block_rows, H)
+        return jnp.einsum("bph,bhf->bpf", xb.astype(jnp.float32),
+                          wb.astype(jnp.float32)).reshape(P, F).astype(x.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((block_rows, H), lambda i, be: (i, 0)),
+            pl.BlockSpec((1, H, F), lambda i, be: (be[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, F), lambda i, be: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, F), x.dtype),
+        interpret=_interpret(),
+    )(block_expert, x, w)

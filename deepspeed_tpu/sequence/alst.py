@@ -1,0 +1,147 @@
+"""ALST (Arctic Long Sequence Training) for EXTERNAL models.
+
+Reference parity: ``runtime/sequence_parallel/ulysses_sp.py`` —
+``UlyssesSPAttentionHF`` (:49) registers Ulysses all-to-all attention into
+an outside (HF) model, ``UlyssesSPDataLoaderAdapter`` (:471) re-shards an
+existing dataloader's batches on the sequence dim, and the tiled-compute
+autograd functions ``SequenceTiledCompute``/``TiledMLP`` (:669/:838) /
+``TiledFusedLogitsLoss`` (:960) bound activation memory by processing the
+sequence in chunks.
+
+TPU translation: the adapter pieces are *function wrappers* a user applies
+to their own JAX model code — no module registry or monkey-patching:
+
+* ``ulysses_sp_attention(inner)`` — wrap ANY [B, S, NH, D] attention
+  callable; the all-to-alls are sharding constraints over the 'sequence'
+  mesh axis (sequence/ulysses.py).
+* ``sequence_tiled_compute(fn, chunk)`` — run an elementwise-over-sequence
+  fn (MLP, norm, ...) chunk-by-chunk under ``lax.scan`` with per-chunk
+  remat: activation memory is one chunk's, not the full sequence's.
+* ``tiled_fused_logits_loss(fn, ...)`` — scan a (sum, count) loss over
+  sequence chunks so the [B, S, V] logits never materialize.
+* ``UlyssesSPDataLoaderAdapter`` — wrap any batch iterator; leaves are
+  re-laid-out with the sequence dim sharded over the 'sequence' axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, SEQ_AXIS, get_topology
+from .ulysses import ulysses_attention
+
+
+def ulysses_sp_attention(inner: Optional[Callable] = None) -> Callable:
+    """Return an attention callable for an external model: same signature
+    as the user's ``inner`` ([B, S, NH, D] q/k/v -> [B, S, NH, D]), with the
+    Ulysses head-scatter/seq-gather all-to-alls around it (reference
+    UlyssesSPAttentionHF.register_with_transformers, ulysses_sp.py:49)."""
+
+    def attn(q, k, v, causal: bool = True, mask=None):
+        return ulysses_attention(q, k, v, causal=causal, mask=mask,
+                                 inner=inner)
+
+    return attn
+
+
+def sequence_tiled_compute(fn: Callable, chunk: int, seq_dim: int = 1,
+                           remat: bool = True) -> Callable:
+    """Wrap ``fn(x) -> y`` (length-preserving along ``seq_dim``, elementwise
+    across sequence positions — an MLP, a norm stack ...) to run in
+    sequence chunks under ``lax.scan`` (reference SequenceTiledCompute /
+    TiledMLP, ulysses_sp.py:669/838): activation memory for the backward is
+    one chunk's, re-computed per chunk when ``remat``."""
+
+    def tiled(x, *args):
+        S = x.shape[seq_dim]
+        if S % chunk != 0:
+            raise ValueError(f"sequence {S} not divisible by chunk {chunk}")
+        n = S // chunk
+        xc = jnp.moveaxis(x, seq_dim, 0).reshape(n, chunk, *(
+            x.shape[:seq_dim] + x.shape[seq_dim + 1:]))
+
+        def chunk_fn(c):
+            # c: [chunk, ...rest] -> restore the user's axis layout
+            return fn(jnp.moveaxis(c, 0, seq_dim), *args)
+
+        run = jax.checkpoint(chunk_fn) if remat else chunk_fn
+
+        def body(_, c):
+            return None, jnp.moveaxis(run(c), seq_dim, 0)
+
+        _, yc = jax.lax.scan(body, None, xc)
+        y = yc.reshape(S, *yc.shape[2:])
+        return jnp.moveaxis(y, 0, seq_dim)
+
+    return tiled
+
+
+def tiled_fused_logits_loss(fn: Callable, hidden: jnp.ndarray,
+                            targets: jnp.ndarray, chunk: int,
+                            seq_dim: int = 1) -> jnp.ndarray:
+    """Scan ``fn(h_chunk, t_chunk) -> (loss_sum, weight_sum)`` over sequence
+    chunks and return ``total_sum / total_weight`` — the full [B, S, V]
+    logits never exist (reference TiledFusedLogitsLoss, ulysses_sp.py:960).
+    ``fn`` typically computes head-projection + CE inside."""
+    S = hidden.shape[seq_dim]
+    if S % chunk != 0:
+        raise ValueError(f"sequence {S} not divisible by chunk {chunk}")
+    n = S // chunk
+    hc = jnp.moveaxis(hidden, seq_dim, 0).reshape(
+        n, chunk, *hidden.shape[:seq_dim], *hidden.shape[seq_dim + 1:])
+    tc = jnp.moveaxis(targets, seq_dim, 0).reshape(
+        n, chunk, *targets.shape[:seq_dim], *targets.shape[seq_dim + 1:])
+
+    @jax.checkpoint
+    def chunk_fn(h, t):
+        s, w = fn(jnp.moveaxis(h, 0, seq_dim), jnp.moveaxis(t, 0, seq_dim))
+        return s.astype(jnp.float32), w.astype(jnp.float32)
+
+    def body(carry, xs):
+        s, w = carry
+        ds, dw = chunk_fn(*xs)
+        return (s + ds, w + dw), None
+
+    (total, weight), _ = jax.lax.scan(
+        body, (jnp.asarray(0.0, jnp.float32), jnp.asarray(0.0, jnp.float32)),
+        (hc, tc))
+    return total / jnp.maximum(weight, 1.0)
+
+
+class UlyssesSPDataLoaderAdapter:
+    """Wrap ANY batch iterator so yielded array leaves come out with dim
+    ``seq_dim`` sharded over the 'sequence' mesh axis (reference
+    UlyssesSPDataLoaderAdapter, ulysses_sp.py:471).  Leaves whose
+    ``seq_dim`` size does not divide the sequence axis stay batch-sharded
+    only (e.g. scalar labels)."""
+
+    def __init__(self, loader: Any, seq_dim: int = 1):
+        self.loader = loader
+        self.seq_dim = seq_dim
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self) -> Iterator:
+        topo = get_topology()
+        sp = topo.seq_parallel_size
+        bp = topo.dp_world_size  # batch-shard product (repl x data x expert)
+
+        def place(x):
+            x = jnp.asarray(x)
+            entries = [None] * x.ndim
+            # shard a dim only when its size divides the axis group; odd
+            # leaves (scalar metadata, lengths, ...) stay replicated
+            if x.ndim > 0 and x.shape[0] % max(bp, 1) == 0:
+                entries[0] = BATCH_AXES
+            if x.ndim > self.seq_dim and x.shape[self.seq_dim] % max(sp, 1) == 0:
+                entries[self.seq_dim] = SEQ_AXIS
+            return jax.device_put(
+                x, jax.sharding.NamedSharding(topo.mesh, P(*entries)))
+
+        for batch in self.loader:
+            yield jax.tree_util.tree_map(place, batch)
