@@ -86,19 +86,13 @@ def _peak_for(device) -> float:
     return PEAK_BF16_FLOPS["cpu"]
 
 
-def main() -> None:
+def _run(size: str, seq: int, micro_bs: int, steps: int) -> dict:
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.llama import llama_model
     from deepspeed_tpu.models.transformer import flops_per_token
-
-    on_tpu = jax.default_backend() != "cpu"
-    size = "160m" if on_tpu else "tiny"
-    seq = 1024 if on_tpu else 64
-    micro_bs = 8 if on_tpu else 2
-    steps = 20 if on_tpu else 3
 
     model = llama_model(size, max_seq_len=seq)
     config = {
@@ -140,12 +134,42 @@ def main() -> None:
     model_flops = flops_per_token(model.config, seq) * tokens
     mfu = model_flops / dt / (n_chips * _peak_for(jax.devices()[0]))
 
-    print(json.dumps({
-        "metric": f"llama-{size} bf16 zero1 tokens/sec/chip (seq={seq}, mfu={mfu:.3f})",
+    return {
+        "metric": f"llama-{size} bf16 zero1 tokens/sec/chip "
+                  f"(seq={seq}, bs={micro_bs}, mfu={mfu:.3f})",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.54, 3),
-    }))
+    }
+
+
+def main() -> None:
+    import jax
+
+    on_tpu = jax.default_backend() != "cpu"
+    size = os.environ.get("DSTPU_BENCH_SIZE", "160m" if on_tpu else "tiny")
+    seq = int(os.environ.get("DSTPU_BENCH_SEQ", 1024 if on_tpu else 64))
+    steps = int(os.environ.get("DSTPU_BENCH_STEPS", 20 if on_tpu else 3))
+    if os.environ.get("DSTPU_BENCH_BS"):
+        ladder = [int(os.environ["DSTPU_BENCH_BS"])]
+    else:
+        # larger micro-batch feeds the MXU better (M = bs*seq rows); fall
+        # back on OOM so a too-ambitious first rung can't zero the bench
+        ladder = [16, 8] if on_tpu else [2]
+    result = None
+    for i, bs in enumerate(ladder):
+        try:
+            result = _run(size, seq, bs, steps)
+            break
+        except Exception as e:
+            # only memory pressure justifies the next (smaller) rung; other
+            # failures would just fail again after a full recompile
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "memory" in str(e).lower()
+            if not oom or i + 1 >= len(ladder):
+                raise
+            print(f"bench: bs={bs} OOM; trying bs={ladder[i + 1]}",
+                  file=sys.stderr)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -41,6 +41,16 @@ class PruneConfig:
     modules: List[str] = dataclasses.field(default_factory=lambda: ["*"])
 
 
+@dataclasses.dataclass
+class StructuredPruneConfig:
+    """Head / FFN-channel pruning (reference basic_layer.py
+    HeadPruning_Compress / ChannelPruning_Compress)."""
+
+    enabled: bool = False
+    ratio: float = 0.25  # fraction of heads/channels REMOVED
+    schedule_offset: int = 0
+
+
 def _matches(key: str, patterns: List[str]) -> bool:
     for p in patterns:
         if p == "*" or re.search(p, key):
@@ -86,9 +96,92 @@ class CompressionScheduler:
             method=sp.get("method", "l1"),
             ratio=float(sp.get("ratio", 0.5)),
             schedule_offset=int(sp.get("schedule_offset", 0)))
+        hp = (config.get("head_pruning", {}).get("shared_parameters", {}))
+        cp = (config.get("channel_pruning", {}).get("shared_parameters", {}))
+        self.head_prune = StructuredPruneConfig(
+            enabled=hp.get("enabled", False),
+            ratio=1.0 - float(hp.get("dense_ratio", 1.0 - hp.get("ratio", 0.25))),
+            schedule_offset=int(hp.get("schedule_offset", 0)))
+        self.channel_prune = StructuredPruneConfig(
+            enabled=cp.get("enabled", False),
+            ratio=1.0 - float(cp.get("dense_ratio", 1.0 - cp.get("ratio", 0.25))),
+            schedule_offset=int(cp.get("schedule_offset", 0)))
         self._masks: Optional[Any] = None
+        self._head_keep: Optional[Any] = None  # [L, H_keep] kept head indices
+        self._chan_keep: Optional[Any] = None  # [L, F_keep] kept channels
 
-    def transform_params(self, params: Any, global_step: int) -> Any:
+    # -- structured pruning (reference basic_layer.py HeadPruning_Compress /
+    # ChannelPruning_Compress over the transformer layout) ------------------
+    def _structured_keeps(self, params: Any, n_heads: Optional[int],
+                          do_head: bool, do_chan: bool) -> None:
+        layers = params.get("layers") if isinstance(params, dict) else None
+        if layers is None or "mlp" not in layers:
+            if self.head_prune.enabled or self.channel_prune.enabled:
+                logger.warning("structured pruning needs the models/* "
+                               "transformer layout; disabling")
+                self.head_prune.enabled = self.channel_prune.enabled = False
+            return
+        mlp, attn = layers["mlp"], layers["attn"]
+        if do_chan and self._chan_keep is None and \
+                mlp.get("w_up") is not None and mlp["w_up"].ndim == 3:
+            up, down = mlp["w_up"], mlp["w_down"]  # [L,H,F], [L,F,H]
+            imp = jnp.linalg.norm(up, axis=1) * jnp.linalg.norm(down, axis=2)
+            if mlp.get("w_gate") is not None and mlp["w_gate"].ndim == 3:
+                imp = imp * jnp.linalg.norm(mlp["w_gate"], axis=1)
+            F = up.shape[-1]
+            keep = max(1, int(round(F * (1.0 - self.channel_prune.ratio))))
+            self._chan_keep = jnp.sort(
+                jnp.argsort(imp, axis=-1)[:, F - keep:], axis=-1)  # [L, keep]
+            mask = jnp.zeros((self._chan_keep.shape[0], F), bool)
+            self._chan_mask = jax.vmap(
+                lambda m, k: m.at[k].set(True))(mask, self._chan_keep)
+        if do_head:
+            if not n_heads:
+                logger.warning("head_pruning enabled but n_heads was not "
+                               "passed to init_compression/transform_params; "
+                               "no heads will be pruned")
+            elif self._head_keep is None:
+                wo = attn["wo"]  # [L, NH*D, H]
+                L, ND, H = wo.shape
+                D = ND // n_heads
+                imp = jnp.linalg.norm(wo.reshape(L, n_heads, D * H), axis=-1)
+                keep = max(1, int(round(n_heads * (1.0 - self.head_prune.ratio))))
+                self._head_keep = jnp.sort(
+                    jnp.argsort(imp, axis=-1)[:, n_heads - keep:], axis=-1)
+                hmask = jnp.zeros((L, n_heads), bool)
+                hmask = jax.vmap(
+                    lambda m, k: m.at[k].set(True))(hmask, self._head_keep)
+                self._head_col = jnp.repeat(hmask, D, axis=-1)  # [L, NH*D]
+
+    def _apply_structured_masks(self, params: Any, do_head: bool,
+                                do_chan: bool) -> Any:
+        layers = params["layers"]
+        mlp = dict(layers["mlp"])
+        attn = dict(layers["attn"])
+        if do_chan and getattr(self, "_chan_mask", None) is not None:
+            mask = self._chan_mask
+            for name in ("w_up", "w_gate"):
+                if mlp.get(name) is not None:
+                    mlp[name] = mlp[name] * mask[:, None, :].astype(mlp[name].dtype)
+            if mlp.get("b_up") is not None:
+                mlp["b_up"] = mlp["b_up"] * mask.astype(mlp["b_up"].dtype)
+            mlp["w_down"] = mlp["w_down"] * mask[:, :, None].astype(mlp["w_down"].dtype)
+        if do_head and getattr(self, "_head_col", None) is not None:
+            col = self._head_col
+            # zero the head's output rows (kills its contribution) and its
+            # query columns (kills its compute's gradient signal)
+            attn["wo"] = attn["wo"] * col[:, :, None].astype(attn["wo"].dtype)
+            attn["wq"] = attn["wq"] * col[:, None, :].astype(attn["wq"].dtype)
+            if attn.get("bq") is not None:
+                attn["bq"] = attn["bq"] * col.astype(attn["bq"].dtype)
+        out = dict(params)
+        out["layers"] = dict(layers)
+        out["layers"]["mlp"] = mlp
+        out["layers"]["attn"] = attn
+        return out
+
+    def transform_params(self, params: Any, global_step: int,
+                         n_heads: Optional[int] = None) -> Any:
         """Forward-time parameter transform (compile-friendly: the branch on
         step happens host-side per boundary)."""
         out = params
@@ -111,17 +204,97 @@ class CompressionScheduler:
                 lambda w, m: w * m.astype(w.dtype) if m is not None else w,
                 out, self._masks,
                 is_leaf=lambda x: hasattr(x, "ndim") or x is None)
+        do_head = (self.head_prune.enabled
+                   and global_step >= self.head_prune.schedule_offset)
+        do_chan = (self.channel_prune.enabled
+                   and global_step >= self.channel_prune.schedule_offset)
+        if do_head or do_chan:
+            self._structured_keeps(out, n_heads, do_head, do_chan)
+            out = self._apply_structured_masks(out, do_head, do_chan)
         return out
 
 
 def init_compression(params: Any, deepspeed_config: Dict[str, Any],
-                     global_step: int = 0) -> Tuple[Any, CompressionScheduler]:
+                     global_step: int = 0,
+                     n_heads: Optional[int] = None) -> Tuple[Any, CompressionScheduler]:
     """Reference init_compression: returns (transformed params, scheduler)."""
     sched = CompressionScheduler(deepspeed_config.get("compression_training", {}))
-    return sched.transform_params(params, global_step), sched
+    return sched.transform_params(params, global_step, n_heads=n_heads), sched
 
 
-def redundancy_clean(params: Any, scheduler: CompressionScheduler) -> Any:
+def redundancy_clean(params: Any, scheduler: CompressionScheduler,
+                     model_config: Any = None) -> Any:
     """Fold pruning masks permanently into weights (reference
-    redundancy_clean)."""
-    return scheduler.transform_params(params, global_step=10 ** 9)
+    redundancy_clean, compression/compress.py).
+
+    With ``model_config`` (a models/* TransformerConfig), structured
+    head/channel pruning PHYSICALLY shrinks the arrays — pruned FFN
+    channels and attention heads are sliced out and the config's
+    ``intermediate_size`` / ``n_heads`` updated — instead of leaving
+    zeroed rows/columns behind.  Returns ``params`` (masks folded), or
+    ``(params, new_config)`` when a config is given."""
+    n_heads = getattr(model_config, "n_heads", None)
+    out = scheduler.transform_params(params, global_step=10 ** 9,
+                                     n_heads=n_heads)
+    if model_config is None:
+        return out
+
+    import copy
+
+    cfg = copy.copy(model_config)
+    layers = dict(out["layers"])
+    mlp = dict(layers["mlp"])
+    attn = dict(layers["attn"])
+
+    if scheduler._chan_keep is not None:
+        keep = scheduler._chan_keep  # [L, F_keep]
+        fk = keep.shape[-1]
+        for name in ("w_up", "w_gate"):
+            if mlp.get(name) is not None:
+                mlp[name] = jnp.take_along_axis(mlp[name], keep[:, None, :], axis=2)
+        if mlp.get("b_up") is not None:
+            mlp["b_up"] = jnp.take_along_axis(mlp["b_up"], keep, axis=1)
+        mlp["w_down"] = jnp.take_along_axis(mlp["w_down"], keep[:, :, None], axis=1)
+        cfg.intermediate_size = int(fk)
+        logger.info(f"redundancy_clean: FFN channels "
+                    f"{model_config.ffn_size} -> {fk}")
+
+    if scheduler._head_keep is not None and n_heads:
+        if getattr(model_config, "kv_heads", n_heads) != n_heads:
+            logger.warning("redundancy_clean: physical head pruning needs "
+                           "MHA (kv_heads == n_heads); keeping masked heads")
+        else:
+            keep = scheduler._head_keep  # [L, H_keep]
+            hk = keep.shape[-1]
+            L = keep.shape[0]
+            D = attn["wo"].shape[1] // n_heads
+
+            def take_heads(w, head_dim):
+                # reshape the packed NH*D dim into [NH, D] and gather heads
+                shape = list(w.shape)
+                split = shape[:head_dim] + [n_heads, D] + shape[head_dim + 1:]
+                idx_shape = [1] * len(split)
+                idx_shape[0] = L
+                idx_shape[head_dim] = hk
+                idx = keep.reshape(idx_shape)
+                taken = jnp.take_along_axis(w.reshape(split), idx, axis=head_dim)
+                shape[head_dim] = hk * D
+                return taken.reshape(shape)
+
+            for name in ("wq", "wk", "wv"):
+                attn[name] = take_heads(attn[name], 2)
+            for name in ("bq", "bk", "bv"):
+                if attn.get(name) is not None:
+                    attn[name] = take_heads(attn[name], 1)
+            attn["wo"] = take_heads(attn["wo"], 1)
+            cfg.head_dim_override = int(D)  # head_dim no longer hidden/NH
+            cfg.n_heads = int(hk)
+            if cfg.n_kv_heads is not None:
+                cfg.n_kv_heads = int(hk)
+            logger.info(f"redundancy_clean: heads {n_heads} -> {hk}")
+
+    layers["mlp"] = mlp
+    layers["attn"] = attn
+    out = dict(out)
+    out["layers"] = layers
+    return out, cfg

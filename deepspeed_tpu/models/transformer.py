@@ -80,13 +80,17 @@ class TransformerConfig:
     wq_bits: int = 0
     wq_group: int = 128
 
+    #: set when structured head pruning changed n_heads (compression
+    #: redundancy_clean): head_dim is then no longer hidden/n_heads
+    head_dim_override: Optional[int] = None
+
     @property
     def kv_heads(self) -> int:
         return self.n_kv_heads or self.n_heads
 
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.n_heads
+        return self.head_dim_override or self.hidden_size // self.n_heads
 
     @property
     def ffn_size(self) -> int:

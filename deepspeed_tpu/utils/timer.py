@@ -91,6 +91,11 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed = 0.0
         self._start = None
+        # per-window stats: the engine only DRAINS the device queue at the
+        # reporting boundary, so a single step's dt is async-dispatch noise;
+        # the window [boundary, boundary] is real wall time
+        self._win_elapsed = 0.0
+        self._win_steps = 0
 
     def start(self):
         self._start = time.perf_counter()
@@ -103,11 +108,18 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
             self.total_elapsed += dt
+            self._win_elapsed += dt
+            self._win_steps += 1
             if report_speed and self.global_step_count % self.steps_per_output == 0:
+                per_step = self._win_elapsed / max(self._win_steps, 1)
+                win_sps = self._win_steps * self.batch_size / \
+                    max(self._win_elapsed, 1e-9)
                 self.logging(
                     f"step={self.global_step_count} "
-                    f"samples/sec={self.avg_samples_per_sec():.2f} "
-                    f"iter_time={dt * 1000:.1f}ms")
+                    f"samples/sec={win_sps:.2f} "
+                    f"iter_time={per_step * 1000:.1f}ms")
+                self._win_elapsed = 0.0
+                self._win_steps = 0
 
     def avg_samples_per_sec(self) -> float:
         if self.total_elapsed == 0:
