@@ -45,10 +45,77 @@ class Autotuner:
         keys = list(self.space)
         combos = [dict(zip(keys, vals))
                   for vals in itertools.product(*self.space.values())]
-        if self.mode == "random":
+        if self.mode in ("random", "model"):
+            # model mode keeps the FULL grid as the proposal pool (the
+            # max_trials budget limits runs, not the searchable space) and
+            # shuffles so the seed trials span it
             rng = np.random.RandomState(0)
             rng.shuffle(combos)
+        if self.mode == "model":
+            return combos
         return combos[:self.max_trials]
+
+    # -- cost model (reference autotuning/tuner/model_based_tuner.py +
+    # cost_model.py: fit observed trials, propose the best predicted) -------
+    def _featurize(self, cand: Dict[str, Any]) -> np.ndarray:
+        feats = []
+        for key, values in self.space.items():
+            onehot = [1.0 if cand.get(key) == v else 0.0 for v in values]
+            feats.extend(onehot)
+            if isinstance(cand.get(key), (int, float)):
+                feats.append(float(np.log2(max(cand[key], 1))))
+            else:
+                feats.append(0.0)
+        return np.asarray(feats + [1.0])
+
+    def _fit_predict(self, tried: List[Tuple[Dict[str, Any], float]],
+                     pool: List[Dict[str, Any]]) -> List[float]:
+        """Ridge regression over one-hot + log features: a dependency-free
+        stand-in for the reference's XGBoost cost model."""
+        X = np.stack([self._featurize(c) for c, _ in tried])
+        y = np.asarray([t for _, t in tried])
+        lam = 1e-3
+        w = np.linalg.solve(X.T @ X + lam * np.eye(X.shape[1]), X.T @ y)
+        return [float(self._featurize(c) @ w) for c in pool]
+
+    def _param_count(self) -> Optional[int]:
+        if not hasattr(self, "_n_params"):
+            try:
+                import jax
+
+                spec = self.model_factory()
+                shapes = jax.eval_shape(spec.init_params, jax.random.PRNGKey(0))
+                self._n_params = sum(int(np.prod(l.shape)) for l in
+                                     jax.tree_util.tree_leaves(shapes))
+            except Exception:
+                self._n_params = None
+        return self._n_params
+
+    def _estimate_state_bytes(self, cand: Dict[str, Any]) -> Optional[int]:
+        """Analytical ZeRO memory floor (reference fast-mode memory
+        estimators): live params + master + moments + grads, divided by the
+        stage's shard group.  Activations are excluded (lower bound)."""
+        import jax
+
+        n = self._param_count()
+        if n is None:
+            return None
+        stage = cand.get("zero_stage",
+                         self.base_config.get("zero_optimization", {}).get("stage", 0))
+        shards = max(1, len(jax.devices()))
+        live = 2 * n / (shards if stage >= 3 else 1)
+        grads = 4 * n / (shards if stage >= 2 else 1)
+        state = 12 * n / (shards if stage >= 1 else 1)  # fp32 master + m + v
+        return int(live + grads + state)
+
+    def _device_memory(self) -> Optional[int]:
+        import jax
+
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return int(stats.get("bytes_limit", 0)) or None
+        except Exception:
+            return None
 
     def _trial_config(self, cand: Dict[str, Any]) -> Dict[str, Any]:
         cfg = dict(self.base_config)
@@ -89,15 +156,62 @@ class Autotuner:
 
     def tune(self) -> Dict[str, Any]:
         """Returns the best candidate and records all results (reference
-        Autotuner.tune, autotuner.py:404)."""
+        Autotuner.tune, autotuner.py:404).
+
+        mode="model": after ``model_seed_trials`` seed runs, a cost model
+        fit on the observed throughputs proposes each next candidate
+        (reference ModelBasedTuner); grid/random run the pool in order.
+        Candidates whose analytical memory floor exceeds device HBM are
+        skipped without compiling (reference fast-mode estimators)."""
+        pool = self._candidates()
+        hbm = self._device_memory()
+        if hbm:
+            kept = []
+            for cand in pool:
+                est = self._estimate_state_bytes(cand)
+                if est is not None and est > hbm:
+                    logger.info(f"autotuning: {cand} pruned (state floor "
+                                f"{est / 1e9:.1f}GB > HBM {hbm / 1e9:.1f}GB)")
+                    self.results.append({"config": cand, "throughput": None,
+                                         "pruned": True})
+                else:
+                    kept.append(cand)
+            pool = kept
+
         best, best_tput = None, -1.0
-        for cand in self._candidates():
+        tried: List[Tuple[Dict[str, Any], float]] = []
+
+        def run_one(cand):
+            nonlocal best, best_tput
             tput = self._run_trial(cand)
             self.results.append({"config": cand, "throughput": tput})
             logger.info(f"autotuning: {cand} -> "
                         f"{'FAIL' if tput is None else f'{tput:.0f} tok/s'}")
-            if tput is not None and tput > best_tput:
-                best, best_tput = cand, tput
+            if tput is not None:
+                tried.append((cand, tput))
+                if tput > best_tput:
+                    best, best_tput = cand, tput
+
+        if self.mode == "model":
+            seeds = min(3, len(pool))
+            for cand in pool[:seeds]:
+                run_one(cand)
+            remaining = pool[seeds:]
+            budget = self.max_trials - seeds
+            while remaining and budget > 0:
+                if tried:
+                    preds = self._fit_predict(tried, remaining)
+                    nxt = remaining.pop(int(np.argmax(preds)))
+                else:
+                    # every seed failed: keep probing in pool order until
+                    # something works to bootstrap the cost model
+                    nxt = remaining.pop(0)
+                run_one(nxt)
+                budget -= 1
+        else:
+            for cand in pool:
+                run_one(cand)
+
         if best is None:
             raise RuntimeError("all autotuning trials failed")
         return {"best": best, "throughput": best_tput,

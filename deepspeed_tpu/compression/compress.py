@@ -210,7 +210,11 @@ class CompressionScheduler:
                    and global_step >= self.channel_prune.schedule_offset)
         if do_head or do_chan:
             self._structured_keeps(out, n_heads, do_head, do_chan)
-            out = self._apply_structured_masks(out, do_head, do_chan)
+            # _structured_keeps may have disabled the feature (wrong layout)
+            do_head = do_head and self.head_prune.enabled
+            do_chan = do_chan and self.channel_prune.enabled
+            if do_head or do_chan:
+                out = self._apply_structured_masks(out, do_head, do_chan)
         return out
 
 

@@ -210,3 +210,13 @@ def test_structured_pruning_respects_per_method_offsets():
     masked2 = sched.transform_params(params, 1000, n_heads=cfg.n_heads)
     up2 = np.asarray(masked2["layers"]["mlp"]["w_up"])
     assert np.any(np.all(up2 == 0, axis=1))
+
+
+def test_structured_pruning_non_transformer_degrades_gracefully():
+    """Wrong layout: warn + disable, do NOT crash (code-review r3)."""
+    params = {"w1": jnp.ones((8, 8)), "w2": jnp.ones((8, 4))}
+    comp = {"compression_training": {
+        "head_pruning": {"shared_parameters": {"enabled": True}}}}
+    out, sched = init_compression(params, comp, n_heads=4)
+    assert not sched.head_prune.enabled
+    np.testing.assert_allclose(np.asarray(out["w1"]), np.ones((8, 8)))
