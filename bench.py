@@ -114,9 +114,18 @@ def _run(size: str, seq: int, micro_bs: int, steps: int) -> dict:
         ids = rng.randint(0, vocab, (1, micro_bs * dp, seq)).astype(np.int32)
         return {"input_ids": jnp.asarray(ids)}
 
-    # warmup / compile
-    loss = engine.train_batch(batch())
-    jax.block_until_ready(loss)
+    # warmup / compile.  Several steps, not one: donation-variant compiles
+    # and device-queue ramp land in steps 2-4, and a single warmup step let
+    # them pollute the timed window (round-2's 0.236 "MFU" was this —
+    # steady state measured 0.384 with a proper warmup, docs/PERF_NOTES.md)
+    warmup = int(os.environ.get("DSTPU_BENCH_WARMUP", "5"))
+    loss = None
+    for _ in range(warmup):
+        loss = engine.train_batch(batch())
+    # real host roundtrip: see the tail comment — block_until_ready alone
+    # can return early through the tunnel
+    if loss is not None:
+        float(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):

@@ -18,11 +18,21 @@ from ..op_builder import AsyncIOBuilder
 
 
 class AsyncIOHandle:
+    """One async-I/O queue.
+
+    ``backend``: "auto" prefers the io_uring engine (kernel async I/O,
+    fd-cached, short-transfer resubmission) and falls back to the worker
+    thread pool where io_uring is unavailable; "threads"/"uring" force one.
+    """
+
     def __init__(self, thread_count: int = 4, block_size: int = 1 << 20,
-                 use_odirect: bool = False):
+                 use_odirect: bool = False, backend: str = "auto"):
         self._lib = AsyncIOBuilder().load()
-        self._h = self._lib.dstpu_aio_create(thread_count, block_size,
-                                             int(use_odirect))
+        code = {"auto": 0, "threads": 1, "uring": 2}[backend]
+        self._h = self._lib.dstpu_aio_create_ex(thread_count, block_size,
+                                                int(use_odirect), code)
+        if not self._h:
+            raise OSError(f"aio: backend {backend!r} unavailable")
         self._bufs = {}  # op id -> buffer keep-alive
 
     def __del__(self):
@@ -56,8 +66,70 @@ class AsyncIOHandle:
     # reference API names
     wait = drain
 
+    def wait_op(self, op_id: int) -> None:
+        """Block until ONE submitted op completes (the pipelined swapper
+        waits per-tensor instead of draining the whole queue)."""
+        err = self._lib.dstpu_aio_wait(self._h, op_id)
+        self._bufs.pop(op_id, None)
+        if err:
+            raise IOError(f"aio: op {op_id} failed")
+
+    @property
+    def backend(self) -> str:
+        return "uring" if self._lib.dstpu_aio_backend_kind(self._h) else "threads"
+
     def pending(self) -> int:
         return self._lib.dstpu_aio_pending(self._h)
+
+
+class PinnedBufferPool:
+    """Page-aligned, mlock'd staging buffers (reference
+    deepspeed_pin_tensor.cpp): reused across swap ops so O_DIRECT and DMA
+    paths never see pageable memory.  ``get`` returns an np.uint8 view;
+    ``put`` recycles it."""
+
+    def __init__(self):
+        self._lib = AsyncIOBuilder().load()
+        self._free = {}  # nbytes -> [ptr]
+        self._out = {}  # ptr -> nbytes, currently checked out
+
+    def get(self, nbytes: int) -> np.ndarray:
+        nbytes = int(nbytes)
+        bucket = self._free.get(nbytes)
+        if bucket:
+            ptr = bucket.pop()
+        else:
+            ptr = self._lib.dstpu_pin_alloc(nbytes)
+            if not ptr:
+                raise MemoryError(f"pin_alloc({nbytes}) failed")
+        import ctypes
+
+        arr = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), shape=(nbytes,))
+        self._out[ptr] = nbytes
+        return arr
+
+    def put(self, arr: np.ndarray) -> None:
+        """Recycle a buffer minted by ``get``.  Double-puts and foreign /
+        re-based arrays raise: silently accepting them would alias pinned
+        memory across two later ``get`` calls."""
+        ptr = arr.ctypes.data
+        nbytes = self._out.pop(ptr, None)
+        if nbytes is None:
+            raise ValueError("PinnedBufferPool.put: not a checked-out pool "
+                             "buffer (double put, a view, or foreign array)")
+        self._free.setdefault(nbytes, []).append(ptr)
+
+    def close(self) -> None:
+        """Free recycled buffers; checked-out ones are freed too — callers
+        must not touch pool arrays after close."""
+        for nbytes, ptrs in self._free.items():
+            for p in ptrs:
+                self._lib.dstpu_pin_free(p, nbytes)
+        self._free.clear()
+        for ptr, nbytes in self._out.items():
+            self._lib.dstpu_pin_free(ptr, nbytes)
+        self._out.clear()
 
 
 _DEFAULT: Optional[AsyncIOHandle] = None

@@ -112,6 +112,16 @@ class AsyncIOBuilder(OpBuilder):
         lib.dstpu_aio_drain.argtypes = [ctypes.c_void_p]
         lib.dstpu_aio_pending.restype = ctypes.c_int64
         lib.dstpu_aio_pending.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_create_ex.restype = ctypes.c_void_p
+        lib.dstpu_aio_create_ex.argtypes = [ctypes.c_int, ctypes.c_int,
+                                            ctypes.c_int, ctypes.c_int]
+        lib.dstpu_aio_wait.restype = ctypes.c_int
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dstpu_aio_backend_kind.restype = ctypes.c_int
+        lib.dstpu_aio_backend_kind.argtypes = [ctypes.c_void_p]
+        lib.dstpu_pin_alloc.restype = ctypes.c_void_p
+        lib.dstpu_pin_alloc.argtypes = [ctypes.c_int64]
+        lib.dstpu_pin_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         return lib
 
 

@@ -102,7 +102,12 @@ class ZeroConfig(ConfigModel):
     stage3_max_live_parameters: int = 1_000_000_000
     stage3_max_reuse_distance: int = 1_000_000_000
     stage3_prefetch_bucket_size: int = 50_000_000
-    stage3_param_persistence_threshold: int = 100_000
+    # Params at or below this many elements keep an unpartitioned live copy
+    # at stage 3 (reference persistence_threshold, default 1e5 there because
+    # every fetch pays fixed Python-hook + NCCL-launch overhead).  Default 0
+    # here: XLA compiles per-layer gathers into the step with no per-op
+    # launch cost, so persistence is purely an opt-in memory/latency trade.
+    stage3_param_persistence_threshold: int = 0
     stage3_gather_16bit_weights_on_model_save: bool = False
     # ZeRO++ style knobs: quantized weight gather / hierarchical partition
     zero_quantized_weights: bool = False

@@ -324,7 +324,8 @@ class DeepSpeedTPUEngine:
         p = cast_tree(master_params, self.compute_dtype)
         return self.zero_plan.constrain(p, "param")
 
-    def _micro_step_body(self, state: TrainState, batch, rng) -> Tuple[TrainState, jnp.ndarray]:
+    def _micro_grads(self, state: TrainState, batch, rng):
+        """One micro-batch's gradients (accum dtype, grad-sharded) + loss."""
         compute_params = self._compute_params(state.params)
 
         def scaled_loss_fn(p, b=None):
@@ -340,6 +341,10 @@ class DeepSpeedTPUEngine:
             grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
         grads = cast_tree(grads, self.grad_accum_dtype)
         grads = self.zero_plan.constrain(grads, "grad")
+        return grads, loss
+
+    def _micro_step_body(self, state: TrainState, batch, rng) -> Tuple[TrainState, jnp.ndarray]:
+        grads, loss = self._micro_grads(state, batch, rng)
         new_acc = jax.tree_util.tree_map(jnp.add, state.grad_acc, grads)
         state = dataclasses.replace(state, grad_acc=new_acc,
                                     micro_step=state.micro_step + 1)
@@ -393,7 +398,11 @@ class DeepSpeedTPUEngine:
                                       self.topology.mesh)
         return grads, jnp.mean(losses)
 
-    def _apply_step_body(self, state: TrainState) -> TrainState:
+    def _apply_step_body(self, state: TrainState, grads_src=None) -> TrainState:
+        """Boundary update.  ``grads_src``: gradients to apply instead of
+        ``state.grad_acc`` — the fused gas=1 path feeds the micro-step's
+        gradients straight through, skipping the accumulation-buffer
+        read/modify/write entirely."""
         gas = self.config.gradient_accumulation_steps or 1
         denom = jnp.asarray(float(gas), jnp.float32)
         if self.fp16_enabled:
@@ -410,7 +419,8 @@ class DeepSpeedTPUEngine:
             state = dataclasses.replace(state, opt_state=opt_state)
 
         grads = jax.tree_util.tree_map(
-            lambda g: (g.astype(jnp.float32) / denom), state.grad_acc)
+            lambda g: (g.astype(jnp.float32) / denom),
+            state.grad_acc if grads_src is None else grads_src)
         grads = self.zero_plan.constrain(grads, "master")
 
         norm = global_grad_norm(grads)
@@ -438,7 +448,9 @@ class DeepSpeedTPUEngine:
                 (state.params, state.opt_state, grads))
             new_scale = state.loss_scale
 
-        zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc)
+        # fused path: the acc buffer was never written, it is still zeros
+        zero_acc = (state.grad_acc if grads_src is not None
+                    else jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc))
         return dataclasses.replace(
             state,
             params=new_params,
@@ -453,7 +465,18 @@ class DeepSpeedTPUEngine:
 
     def _train_batch_body(self, state: TrainState, batches, rng) -> Tuple[TrainState, jnp.ndarray]:
         """Fused full step: scan micro-batches then apply.  ``batches`` has a
-        leading gradient-accumulation dim."""
+        leading gradient-accumulation dim.  At gas=1 the micro-batch's
+        gradients feed the update directly — no accumulation-buffer
+        round-trip (the buffer stays zeros)."""
+        gas = self.config.gradient_accumulation_steps or 1
+        if gas == 1:
+            batch = jax.tree_util.tree_map(lambda x: x[0], batches)
+            # same rng stream as the scan path (split, don't use raw) so a
+            # seeded run reproduces across both paths
+            grads, loss = self._micro_grads(state, batch,
+                                            jax.random.split(rng, 1)[0])
+            state = self._apply_step_body(state, grads_src=grads)
+            return state, loss.astype(jnp.float32)
         state, loss = self._micro_scan_body(state, batches, rng)
         state = self._apply_step_body(state)
         return state, loss

@@ -161,9 +161,21 @@ class ZeroShardingPlan:
 
     # -- public API ----------------------------------------------------------
     def param_spec(self, path_str: str, shape: Tuple[int, ...]) -> P:
-        """Sharding of the live (compute) parameters."""
+        """Sharding of the live (compute) parameters.
+
+        ``stage3_param_persistence_threshold`` (reference
+        partitioned_param_coordinator persistence, stage3.py
+        persistence_threshold): live copies of params at or below the
+        threshold stay unpartitioned — the reference keeps them permanently
+        gathered to skip tiny fetch collectives; here they simply never get
+        a ZeRO axis (master/optimizer state still shards)."""
         spec = self._check_divisible(self.base_spec(path_str, len(shape)), shape, path_str)
         if self.stage >= 3:
+            persist = int(getattr(self.config,
+                                  "stage3_param_persistence_threshold", 0) or 0)
+            n_elem = int(np.prod(shape)) if shape else 1
+            if n_elem <= persist:
+                return spec
             spec = self._extend_with_zero(spec, shape, path_str)
         return spec
 

@@ -121,6 +121,30 @@ def test_zero_stage3_params_sharded(devices8):
     assert any(s is not None for s in spec), f"stage-3 param not sharded: {spec}"
 
 
+def test_zero_stage3_persistence_threshold(devices8):
+    """Params at/below stage3_param_persistence_threshold keep an
+    unpartitioned live copy (reference persistence semantics); master state
+    still shards."""
+    engine = _make_engine({"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 10_000_000}})
+    # every SimpleModel param is under the (huge) threshold -> all live
+    # params replicated; the fp32 master remains zero-sharded
+    leaf = engine.state.params["layer_0"]["w"]
+    plan = engine.zero_plan
+    live = plan.param_spec("layer_0/w", tuple(leaf.shape))
+    master = plan.master_spec("layer_0/w", tuple(leaf.shape))
+    assert all(s is None for s in live), f"persistent param sharded: {live}"
+    assert any(s is not None for s in master), \
+        f"master must shard regardless of persistence: {master}"
+    # threshold below the param size -> live param shards again
+    engine2 = _make_engine({"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 1}})
+    live2 = engine2.zero_plan.param_spec("layer_0/w", tuple(leaf.shape))
+    assert any(s is not None for s in live2)
+    # and it still trains
+    _loss_decreases(engine, steps=5)
+
+
 def test_zero_stage0_params_replicated(devices8):
     engine = _make_engine({"zero_optimization": {"stage": 0}})
     leaf = engine.state.params["layer_0"]["w"]
