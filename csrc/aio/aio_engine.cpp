@@ -286,7 +286,7 @@ struct UringEngine : EngineBase {
     cleanup();
   }
 
-  bool broken = false;  // poisoned by a hard submit error
+  std::atomic<bool> broken{false};  // poisoned by a hard submit error
 
   unsigned unsubmitted = 0;  // pushed SQEs not yet handed to the kernel
 
@@ -459,9 +459,16 @@ struct UringEngine : EngineBase {
   void reap_loop() {
     std::vector<std::pair<int64_t, int>> batch;
     for (;;) {
-      int r = sys_io_uring_enter(ring_fd, 0, 1, IORING_ENTER_GETEVENTS);
-      if (r < 0 && errno != EINTR && errno != EBUSY && errno != EAGAIN)
-        ::usleep(1000);  // broken ring: don't hot-spin while draining state
+      if (broken.load()) {
+        // no CQE will ever arrive for locally-retired chunks, and the
+        // destructor cannot wake us with a NOP (push_sqe refuses once
+        // broken) — poll instead of blocking so stop is honored
+        ::usleep(500);
+      } else {
+        int r = sys_io_uring_enter(ring_fd, 0, 1, IORING_ENTER_GETEVENTS);
+        if (r < 0 && errno != EINTR && errno != EBUSY && errno != EAGAIN)
+          ::usleep(1000);  // enter itself failing: don't hot-spin
+      }
       std::unique_lock<std::mutex> l(mu);
       // Sweep the CQ and ADVANCE cq_head before retiring chunks: retirement
       // may resubmit (short transfers), and a resubmission backoff must not

@@ -292,8 +292,10 @@ def _pick_attn(cfg: TransformerConfig) -> Callable:
         try:
             from ..ops.pallas.flash_attention import flash_attention
 
-            return lambda q, k, v, causal, mask=None: flash_attention(
+            fn = lambda q, k, v, causal, mask=None: flash_attention(  # noqa: E731
                 q, k, v, causal=causal, segment_mask=mask)
+            fn.handles_gqa = True  # reads grouped kv heads via index maps
+            return fn
         except Exception:
             return xla_attention
     if impl == "ulysses":
@@ -389,8 +391,11 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
     a = layer["attn"]
 
     q, k, v = attn_qkv(cfg, layer, x, positions)
-    k = _repeat_kv(k, NH // KVH)
-    v = _repeat_kv(v, NH // KVH)
+    if not getattr(attn_fn, "handles_gqa", False):
+        # GQA-aware impls (flash) read each kv head once through the kernel
+        # index map; everyone else gets the materialized repeat
+        k = _repeat_kv(k, NH // KVH)
+        v = _repeat_kv(v, NH // KVH)
     attn = attn_fn(q, k, v, cfg.causal, mask)
     attn = attn.reshape(B, S, NH * D)
     attn_delta = _mm(cfg, attn, a["wo"], MODEL_AXIS, None) \

@@ -23,18 +23,10 @@ import jax
 import jax.numpy as jnp
 
 
-def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                        biases: Sequence[Optional[jnp.ndarray]] = ()
-                        ) -> jnp.ndarray:
-    """DS4Sci_EvoformerAttention semantics.
-
-    q/k/v: [*, S, N, H, D]  (batch dims, n_seq, n_res(keys), heads, dim) —
-    attention runs over the N (residue) axis per (batch, S, head).
-    biases: up to two arrays broadcastable to [*, S, H, N_q, N_k]
-    (reference: bias1 [B, N, 1, 1, K] mask bias, bias2 [B, 1, H, Q, K]
-    pair bias — both are just broadcast adds here).
-    Returns [*, S, N, H, D].
-    """
+def evoformer_attention_xla(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                            biases: Sequence[Optional[jnp.ndarray]] = ()
+                            ) -> jnp.ndarray:
+    """Unfused reference path (materializes [.., H, Q, K] scores)."""
     if len(biases) > 2:
         raise ValueError("evoformer attention takes at most two biases")
     d = q.shape[-1]
@@ -45,6 +37,46 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             scores = scores + b.astype(jnp.float32)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        biases: Sequence[Optional[jnp.ndarray]] = (),
+                        impl: str = "auto") -> jnp.ndarray:
+    """DS4Sci_EvoformerAttention semantics.
+
+    q/k/v: [B, S, N, H, D]  (batch, n_seq, n_res(keys), heads, dim) —
+    attention runs over the N (residue) axis per (batch, S, head).
+    biases: up to two arrays (reference: bias1 [B, S, 1, 1, K] mask bias,
+    bias2 [B, 1, H, Q, K] pair bias).  Returns [B, S, N, H, D].
+
+    ``impl``: "pallas" = fused blocked online-softmax kernels with
+    hand-written bias gradients (ops/pallas/evoformer_attn.py — the
+    CUTLASS-kernel equivalent, never materializing [.., Q, K] in HBM);
+    "xla" = unfused einsum path; "auto" picks pallas when the operands are
+    5-D with the exact reference bias layouts, else falls back to xla.
+    """
+    if len(biases) > 2:
+        raise ValueError("evoformer attention takes at most two biases")
+    use_pallas = impl == "pallas"
+    if impl == "auto" and q.ndim == 5:
+        B, S, Q, H, D = q.shape
+        K = k.shape[2]
+        # per-POSITION shapes: the kernel treats biases[0] as the mask bias
+        # and biases[1] as the pair bias; a lone pair-shaped bias in slot 0
+        # must keep going through the broadcasting XLA path
+        shapes_ok = (
+            (len(biases) < 1 or biases[0] is None
+             or biases[0].shape == (B, S, 1, 1, K))
+            and (len(biases) < 2 or biases[1] is None
+                 or biases[1].shape == (B, 1, H, Q, K)))
+        # the fused kernel pays off once scores stop fitting comfortably;
+        # tiny shapes go through XLA (also keeps CPU CI fast)
+        use_pallas = shapes_ok and D in (16, 32, 64, 128)
+    if use_pallas:
+        from .pallas.evoformer_attn import evoformer_attention_pallas
+
+        return evoformer_attention_pallas(q, k, v, biases)
+    return evoformer_attention_xla(q, k, v, biases)
 
 
 # torch-API-compatible alias

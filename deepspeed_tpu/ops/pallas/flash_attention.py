@@ -75,21 +75,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)  # [bq, 1]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q=None, valid_k=None):
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q=None, valid_k=None,
+         q_per_kv=1):
+    """q: [B*NH, Sq, D]; k/v: [B*KVH, Sk, D] with NH = KVH * q_per_kv —
+    GQA reads each kv head once via the index map instead of materializing
+    the repeat (the reference's kv-replication copy)."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     valid_k = valid_k if valid_k is not None else seq_k
     bq = min(block_q, seq_q)
     bk = min(block_k, seq_k)
     grid = (bh, pl.cdiv(seq_q, bq))
+    g = q_per_kv
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                           block_k=bk, seq_k=valid_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
@@ -139,10 +144,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q, seq_k):
+    """Grid (B*KVH, nk, q_per_kv) — group index fastest, so the dk/dv
+    output block (indexed (bkv, jk), ignoring the group axis) is revisited
+    consecutively and accumulates each grouped q head's contribution in
+    VMEM (GQA: dk = sum over the group)."""
     k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
     v_blk = v_ref[0].astype(jnp.float32)
     bk, d = k_blk.shape
     jk = pl.program_id(1)
+    gi = pl.program_id(2)
     k_start = jk * bk
     k_valid_until = seq_k
     nq = pl.cdiv(seq_q, block_q)
@@ -175,16 +185,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(gi == 0)
+    def _():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    dk_ref[0] += dk.astype(dk_ref.dtype)
+    dv_ref[0] += dv.astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
+def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
+         res, do):
     q, k, v, out, lse = res
     bh, seq_q, d = q.shape
+    bkv = k.shape[0]
     seq_k = k.shape[1]
     bq = min(block_q, seq_q)
     bk = min(block_k, seq_k)
+    g = q_per_kv
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [BH, Sq, 1]
@@ -195,8 +214,8 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
         grid=(bh, pl.cdiv(seq_q, bq)),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
@@ -209,18 +228,18 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, seq_q=valid_q, seq_k=valid_k),
-        grid=(bh, pl.cdiv(seq_k, bk)),
+        grid=(bkv, pl.cdiv(seq_k, bk), g),
         in_specs=[
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, j, gi: (b * g + gi, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, j, gi: (b * g + gi, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j, gi: (b * g + gi, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j, gi: (b * g + gi, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -231,19 +250,25 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k):
-    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k,
+                q_per_kv):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
+                  valid_k, q_per_kv)
     return out
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k):
-    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k)
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
+                    valid_k, q_per_kv):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
+                    valid_k, q_per_kv)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do):
-    return _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, res, do)
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, valid_q, valid_k,
+                    q_per_kv, res, do):
+    return _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k,
+                q_per_kv, res, do)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -254,27 +279,40 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
                     block_q: int = 512, block_k: int = 512, impl: str = "pallas"):
     """Public API on [B, S, NH, D] (matching models/transformer.py).
 
+    GQA-native: k/v may carry KVH < NH heads (NH % KVH == 0) — each kv
+    head is read once via the kernel's index map instead of materializing
+    the NH/KVH-fold repeat in HBM.
+
     ``segment_mask``: optional [B, S_k] padding mask (1 = keep); falls back
     to the XLA path when given (masked flash variant: future work).
     """
-    if segment_mask is not None:
-        from ...models.transformer import xla_attention
-
-        return xla_attention(q, k, v, causal, segment_mask)
     B, Sq, NH, D = q.shape
+    KVH = k.shape[2]
+    if segment_mask is not None:
+        from ...models.transformer import _repeat_kv, xla_attention
+
+        return xla_attention(q, _repeat_kv(k, NH // KVH),
+                             _repeat_kv(v, NH // KVH), causal, segment_mask)
     Sk = k.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    if NH % KVH != 0:
+        raise ValueError(f"n_heads {NH} not a multiple of kv heads {KVH}")
+    q_per_kv = NH // KVH
     if impl == "jax":  # stock kernel for comparison
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as jax_fa)
 
-        out = jax_fa(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                     v.transpose(0, 2, 1, 3), causal=causal, sm_scale=scale)
+        from ...models.transformer import _repeat_kv
+
+        out = jax_fa(q.transpose(0, 2, 1, 3),
+                     _repeat_kv(k, q_per_kv).transpose(0, 2, 1, 3),
+                     _repeat_kv(v, q_per_kv).transpose(0, 2, 1, 3),
+                     causal=causal, sm_scale=scale)
         return out.transpose(0, 2, 1, 3)
 
     qh = q.transpose(0, 2, 1, 3).reshape(B * NH, Sq, D)
-    kh = k.transpose(0, 2, 1, 3).reshape(B * NH, Sk, D)
-    vh = v.transpose(0, 2, 1, 3).reshape(B * NH, Sk, D)
+    kh = k.transpose(0, 2, 1, 3).reshape(B * KVH, Sk, D)
+    vh = v.transpose(0, 2, 1, 3).reshape(B * KVH, Sk, D)
     # pad to block multiples: pl.ds clamps out-of-bounds starts, which would
     # silently mislabel columns in edge blocks; masks use the true lengths
     bq = min(block_q, Sq)
@@ -285,6 +323,7 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
         qh = jnp.pad(qh, ((0, 0), (0, pad_q), (0, 0)))
         kh = jnp.pad(kh, ((0, 0), (0, pad_k), (0, 0)))
         vh = jnp.pad(vh, ((0, 0), (0, pad_k), (0, 0)))
-    out = _flash_bhsd(qh, kh, vh, scale, causal, block_q, block_k, Sq, Sk)
+    out = _flash_bhsd(qh, kh, vh, scale, causal, block_q, block_k, Sq, Sk,
+                      q_per_kv)
     out = out[:, :Sq]
     return out.reshape(B, NH, Sq, D).transpose(0, 2, 1, 3)

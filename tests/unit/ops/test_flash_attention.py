@@ -55,3 +55,38 @@ def test_gqa_via_repeat():
     q, k, v = _qkv(s=64)
     out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
     assert out.shape == q.shape
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_native_matches_repeated(causal):
+    """GQA-native path (KVH < NH through kernel index maps) vs explicitly
+    repeated kv: forward and all three gradients."""
+    from deepspeed_tpu.models.transformer import _repeat_kv
+
+    b, s, nh, kvh, d = 2, 64, 8, 2, 32
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, s, nh, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kvh, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = flash_attention(q, _repeat_kv(k, nh // kvh), _repeat_kv(v, nh // kvh),
+                          causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+    def loss_gqa(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_rep(q, k, v):
+        return jnp.sum(flash_attention(
+            q, _repeat_kv(k, nh // kvh), _repeat_kv(v, nh // kvh),
+            causal=causal, block_q=32, block_k=32) ** 2)
+
+    g_gqa = jax.grad(loss_gqa, argnums=(0, 1, 2))(q, k, v)
+    # the repeat's VJP sums each group back to [b, s, kvh, d] for us
+    g_rep = jax.grad(loss_rep, argnums=(0, 1, 2))(q, k, v)
+    for a, r, name in zip(g_gqa, g_rep, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=5e-4,
+                                   rtol=1e-3, err_msg=name)
