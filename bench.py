@@ -94,7 +94,25 @@ def _run(size: str, seq: int, micro_bs: int, steps: int) -> dict:
     from deepspeed_tpu.models.llama import llama_model
     from deepspeed_tpu.models.transformer import flops_per_token
 
-    model = llama_model(size, max_seq_len=seq)
+    # big models need remat + bf16 grad accumulation + tiled loss to fit
+    # one chip's HBM; 160m runs leaner without them (see docs/PERF_NOTES.md)
+    big = size in ("1b", "7b", "13b", "70b")
+    remat = os.environ.get("DSTPU_BENCH_REMAT", "1" if big else "0") == "1"
+    acc = os.environ.get("DSTPU_BENCH_ACC", "bf16" if big else "fp32")
+    if os.environ.get("DSTPU_BENCH_LOSS_CHUNK"):
+        chunk = int(os.environ["DSTPU_BENCH_LOSS_CHUNK"])
+    elif big:
+        # largest divisor of seq-1 (the shifted-label length) up to 512
+        n = seq - 1
+        chunk = max(d for d in range(1, min(n, 512) + 1) if n % d == 0)
+    else:
+        chunk = 0
+    over = {}
+    if remat:
+        over.update(remat=True, remat_policy="nothing_saveable")
+    if chunk:
+        over["loss_chunk"] = chunk
+    model = llama_model(size, max_seq_len=seq, **over)
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
@@ -102,6 +120,7 @@ def _run(size: str, seq: int, micro_bs: int, steps: int) -> dict:
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},
         "gradient_clipping": 1.0,
+        "data_types": {"grad_accum_dtype": acc},
     }
     engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
     dp = engine.topology.dp_world_size

@@ -159,6 +159,19 @@ static int sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
   return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete, flags,
                       nullptr, 0);
 }
+// bounded wait (EXT_ARG, kernel 5.11+): lets the reaper wake periodically
+// even when no CQE ever arrives (hard-submit-error shutdown)
+static int sys_io_uring_enter_timeout(int fd, unsigned min_complete,
+                                      unsigned flags, long timeout_ns) {
+  struct __kernel_timespec {
+    long long tv_sec;
+    long long tv_nsec;
+  } ts{0, timeout_ns};
+  struct io_uring_getevents_arg arg{};
+  arg.ts = (uint64_t)(uintptr_t)&ts;
+  return (int)syscall(__NR_io_uring_enter, fd, 0, min_complete,
+                      flags | IORING_ENTER_EXT_ARG, &arg, sizeof(arg));
+}
 static int sys_io_uring_register(int fd, unsigned opcode, void* arg,
                                  unsigned nr_args) {
   return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
@@ -205,6 +218,7 @@ struct UringEngine : EngineBase {
   int64_t submitted_ops = 0, completed_ops = 0, errors = 0;
   std::thread reaper;
   std::atomic<bool> stop{false};
+  bool ext_arg = false;  // IORING_FEAT_EXT_ARG: timed reaper waits
   bool odirect;
   int64_t max_chunk;
 
@@ -216,6 +230,7 @@ struct UringEngine : EngineBase {
     if (ring_fd < 0) throw 1;
     sq_entries = p.sq_entries;
     cq_entries = p.cq_entries;
+    ext_arg = (p.features & IORING_FEAT_EXT_ARG) != 0;
 
     sq_mm_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
     cq_mm_len = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
@@ -464,10 +479,22 @@ struct UringEngine : EngineBase {
         // destructor cannot wake us with a NOP (push_sqe refuses once
         // broken) — poll instead of blocking so stop is honored
         ::usleep(500);
+      } else if (ext_arg) {
+        // bounded wait: a hard submit error can flip ``broken`` while we
+        // are parked here with no CQE ever coming; wake every 50ms to
+        // re-check instead of blocking forever
+        int r = sys_io_uring_enter_timeout(ring_fd, 1, IORING_ENTER_GETEVENTS,
+                                           50'000'000L);
+        if (r < 0 && errno != EINTR && errno != EBUSY && errno != EAGAIN &&
+            errno != ETIME)
+          ::usleep(1000);
       } else {
-        int r = sys_io_uring_enter(ring_fd, 0, 1, IORING_ENTER_GETEVENTS);
+        // pre-5.11 kernel: no timed enter; poll non-blocking
+        int r = sys_io_uring_enter(ring_fd, 0, 0, IORING_ENTER_GETEVENTS);
         if (r < 0 && errno != EINTR && errno != EBUSY && errno != EAGAIN)
-          ::usleep(1000);  // enter itself failing: don't hot-spin
+          ::usleep(1000);
+        else
+          ::usleep(500);
       }
       std::unique_lock<std::mutex> l(mu);
       // Sweep the CQ and ADVANCE cq_head before retiring chunks: retirement

@@ -133,6 +133,50 @@ def broadcast_host(value, src: int = 0):
     return multihost_utils.broadcast_one_to_all(value, is_source=jax.process_index() == src)
 
 
+def _obj_to_array(obj):
+    import pickle
+
+    import numpy as np
+
+    raw = np.frombuffer(pickle.dumps(obj), np.uint8)
+    return raw
+
+
+def broadcast_object_list(object_list, src: int = 0):
+    """Reference ``dist.broadcast_object_list`` (comm/comm.py): every
+    process ends with process ``src``'s objects.  Host control plane:
+    objects are pickled to byte arrays and ride broadcast_one_to_all
+    (length first, so payload shapes agree across processes)."""
+    if jax.process_count() <= 1:
+        return list(object_list)
+    import pickle
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    is_src = jax.process_index() == src
+    payloads = [_obj_to_array(o) if is_src else np.zeros(0, np.uint8)
+                for o in object_list]
+    lens = multihost_utils.broadcast_one_to_all(
+        np.array([p.size for p in payloads], np.int64), is_source=is_src)
+    out = []
+    for i, n in enumerate(lens):
+        buf = payloads[i] if is_src else np.zeros(int(n), np.uint8)
+        buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_src)
+        out.append(pickle.loads(buf.tobytes()))
+    return out
+
+
+def all_gather_object(obj):
+    """Reference ``dist.all_gather_object``: returns the list of every
+    process's object, ordered by process index.  Implemented as
+    process_count successive broadcasts (control-plane; not a hot path)."""
+    n = jax.process_count()
+    if n <= 1:
+        return [obj]
+    return [broadcast_object_list([obj], src=p)[0] for p in range(n)]
+
+
 # --------------------------------------------------------------------------
 # in-program collectives (use inside shard_map / pjit bodies)
 # --------------------------------------------------------------------------

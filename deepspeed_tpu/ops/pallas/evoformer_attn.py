@@ -310,7 +310,8 @@ def _bwd(sm_scale, block_q, block_k, has_b1, has_b2, res, do5):
         out_shape=out_shape,
         interpret=_interpret(),
     )(q5p, k5p, v5p, do5p, lse_p, delta_p, *bias_args)
-    dq = res_a[0][:, :, :, :Q] if has_b1 else res_a[:, :, :, :Q]
+    # out_shape is a list, so pallas_call returns a list even with one entry
+    dq = res_a[0][:, :, :, :Q]
     db1 = res_a[1][:, :, :K] if has_b1 else None
 
     # ---- pass B: dk/dv + dbias2, grid (B, H, nk, S) — s fastest

@@ -324,9 +324,14 @@ class DeepSpeedTPUEngine:
         p = cast_tree(master_params, self.compute_dtype)
         return self.zero_plan.constrain(p, "param")
 
-    def _micro_grads(self, state: TrainState, batch, rng):
-        """One micro-batch's gradients (accum dtype, grad-sharded) + loss."""
-        compute_params = self._compute_params(state.params)
+    def _micro_grads(self, state: TrainState, batch, rng, compute_params=None):
+        """One micro-batch's gradients (accum dtype, grad-sharded) + loss.
+
+        ``compute_params``: pre-cast compute-dtype params — the fused
+        gas>1 scan casts the fp32 master ONCE outside the scan instead of
+        re-casting every micro-step (params only change at the boundary)."""
+        if compute_params is None:
+            compute_params = self._compute_params(state.params)
 
         def scaled_loss_fn(p, b=None):
             loss = self._model_loss(p, b if b is not None else batch, rng)
@@ -484,11 +489,16 @@ class DeepSpeedTPUEngine:
     def _micro_scan_body(self, state: TrainState, batches, rng):
         gas = self.config.gradient_accumulation_steps or 1
         rngs = jax.random.split(rng, gas)
+        compute_params = self._compute_params(state.params)
 
         def body(st, xs):
             batch, r = xs
-            st, loss = self._micro_step_body(st, batch, r)
-            return st, loss
+            grads, loss = self._micro_grads(st, batch, r,
+                                            compute_params=compute_params)
+            new_acc = jax.tree_util.tree_map(jnp.add, st.grad_acc, grads)
+            st = dataclasses.replace(st, grad_acc=new_acc,
+                                     micro_step=st.micro_step + 1)
+            return st, loss.astype(jnp.float32)
 
         state, losses = jax.lax.scan(body, state, (batches, rngs))
         return state, jnp.mean(losses)
@@ -822,6 +832,64 @@ class DeepSpeedTPUEngine:
 
     def train_batch_size(self) -> int:
         return self.config.train_batch_size
+
+    def set_train_batch_size(self, train_batch_size: int) -> None:
+        """Adjust the global batch by changing ONLY gradient accumulation
+        (reference ``engine.set_train_batch_size``, engine.py — micro batch
+        and DP width stay fixed).  The next ``train_batch`` call retraces
+        with the new gas (its leading batch dim changes)."""
+        denom = (self.config.train_micro_batch_size_per_gpu
+                 * self.topology.dp_world_size)
+        if train_batch_size % denom != 0:
+            raise ValueError(
+                f"train_batch_size {train_batch_size} not divisible by "
+                f"micro_batch*dp = {denom}")
+        self.config.gradient_accumulation_steps = train_batch_size // denom
+        self.config.train_batch_size = train_batch_size
+        # gas is a trace-time constant (apply's grad denominator): rebuild
+        # the jit wrappers so cached programs with the old gas can't serve
+        # the DS-compat cadence (state avals alone wouldn't force a retrace)
+        self._compile_steps()
+
+    def set_train_micro_batch_size(self, micro_batch_size: int) -> None:
+        """Change the micro-batch size, keeping gas (reference
+        ``engine.set_train_micro_batch_size``); train_batch follows."""
+        self.config.train_micro_batch_size_per_gpu = int(micro_batch_size)
+        self.config.train_batch_size = (
+            micro_batch_size * (self.config.gradient_accumulation_steps or 1)
+            * self.topology.dp_world_size)
+        self._compile_steps()
+
+    def no_sync(self):
+        """Reference ``engine.no_sync`` context (engine.py): inside it,
+        micro-steps must not pay a cross-data-replica gradient reduction;
+        invalid under ZeRO >= 2 (sharded grads REQUIRE the reduce-scatter
+        — same assert as the reference).
+
+        Under SPMD the gradient psum is placed by the XLA partitioner
+        inside the compiled micro/fused program, and the fused
+        ``train_batch`` path already amortizes scheduling across the gas
+        scan — so there is no per-micro-step Python-issued allreduce to
+        suppress; the context's value here is the stage guard and API
+        compatibility for ported scripts."""
+        import contextlib
+
+        if self.config.zero_config.stage >= 2:
+            raise AssertionError(
+                "no_sync is not compatible with ZeRO stage >= 2: gradients "
+                "are partitioned and every micro-step's reduce-scatter is "
+                "load-bearing (reference engine.no_sync assert)")
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = getattr(self, "_in_no_sync", False)
+            self._in_no_sync = True
+            try:
+                yield
+            finally:
+                self._in_no_sync = prev
+
+        return ctx()
 
     def zero_optimization_stage(self) -> int:
         return self.config.zero_config.stage

@@ -11,6 +11,8 @@ from deepspeed_tpu.models.transformer import (TransformerConfig,
                                               transformer_forward)
 from deepspeed_tpu.runtime.domino import DominoConfig, domino_transformer_forward
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 
 def _mesh(tp):
     return Mesh(np.array(jax.devices()[:tp]), ("model",))

@@ -151,6 +151,24 @@ def test_zero_stage0_params_replicated(devices8):
     assert all(s is None for s in leaf.sharding.spec)
 
 
+def test_no_sync_and_batch_size_setters(devices8):
+    engine = _make_engine({"zero_optimization": {"stage": 1}})
+    with engine.no_sync():
+        engine.train_batch(random_batch(batch_size=8, gas=1))
+    # stage >= 2 must refuse (reference engine.no_sync assert)
+    e2 = _make_engine({"zero_optimization": {"stage": 2}})
+    with pytest.raises(AssertionError):
+        e2.no_sync()
+    # gas-only batch resize; next call retraces at the new shape
+    micro = engine.config.train_micro_batch_size_per_gpu
+    dp = engine.topology.dp_world_size
+    engine.set_train_batch_size(micro * dp * 2)
+    assert engine.gradient_accumulation_steps() == 2
+    engine.train_batch(random_batch(batch_size=8, gas=2))
+    with pytest.raises(ValueError):
+        engine.set_train_batch_size(micro * dp * 2 + 1)
+
+
 def test_checkpoint_roundtrip(tmp_path):
     engine = _make_engine()
     for i in range(3):

@@ -15,6 +15,8 @@ from deepspeed_tpu.inference.v2 import (BlockAllocator, InferenceEngineV2,
 from deepspeed_tpu.models.llama import llama_model
 from deepspeed_tpu.models.transformer import forward_with_cache, init_kv_cache
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 
 def test_block_allocator():
     a = BlockAllocator(8)

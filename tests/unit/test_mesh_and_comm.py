@@ -117,3 +117,14 @@ def test_comms_logger(devices8):
     f(jnp.arange(8.0))
     assert "all_reduce" in logger.comms_dict
     logger.configure(enabled=False)
+
+
+def test_object_collectives_single_process():
+    """Host control-plane object collectives (reference all_gather_object /
+    broadcast_object_list); single-process path returns inputs."""
+    from deepspeed_tpu.comm import comm
+
+    objs = [{"a": 1}, "two"]
+    assert comm.broadcast_object_list(objs) == objs
+    assert comm.broadcast_object_list(objs) is not objs  # copy, like torch
+    assert comm.all_gather_object({"rank": 0}) == [{"rank": 0}]

@@ -134,6 +134,22 @@ def test_evoformer_pallas_matches_xla():
         np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
                                    rtol=2e-4, atol=2e-4)
 
+    # gradient parity WITHOUT biases (the default autodiff path must not
+    # assume the bias-grad outputs exist)
+    g_nb_p = jax.grad(lambda q: jnp.sum(jnp.square(
+        evoformer_attention_pallas(q, k, v, [], block_q=8, block_k=8))))(q)
+    g_nb_x = jax.grad(lambda q: jnp.sum(jnp.square(
+        evoformer_attention_xla(q, k, v, []))))(q)
+    np.testing.assert_allclose(np.asarray(g_nb_p), np.asarray(g_nb_x),
+                               rtol=2e-3, atol=2e-3, err_msg="no-bias dq")
+    # and with only the pair bias in slot 1
+    g_b2_p = jax.grad(lambda b2: jnp.sum(jnp.square(
+        evoformer_attention_pallas(q, k, v, [None, b2], block_q=8, block_k=8))))(b2)
+    g_b2_x = jax.grad(lambda b2: jnp.sum(jnp.square(
+        evoformer_attention_xla(q, k, v, [None, b2]))))(b2)
+    np.testing.assert_allclose(np.asarray(g_b2_p), np.asarray(g_b2_x),
+                               rtol=2e-3, atol=2e-3, err_msg="lone dbias2")
+
     def loss_p(q, k, v, b1, b2):
         return jnp.sum(jnp.square(evoformer_attention_pallas(
             q, k, v, [b1, b2], block_q=8, block_k=8)))

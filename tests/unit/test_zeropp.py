@@ -19,6 +19,8 @@ from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
 from deepspeed_tpu.runtime.zero.zeropp import (dequantize_lastdim,
                                                quantize_lastdim)
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 SEQ = 16
 VOCAB = 64
 
