@@ -101,10 +101,14 @@ def _run(size: str, seq: int, micro_bs: int, steps: int) -> dict:
     acc = os.environ.get("DSTPU_BENCH_ACC", "bf16" if big else "fp32")
     if os.environ.get("DSTPU_BENCH_LOSS_CHUNK"):
         chunk = int(os.environ["DSTPU_BENCH_LOSS_CHUNK"])
-    elif big:
-        # largest divisor of seq-1 (the shifted-label length) up to 512
+    elif big and seq > 2:
+        # largest divisor of seq-1 (the shifted-label length) up to 512;
+        # a near-prime seq-1 would degenerate into thousands of tiny
+        # chunks — then materializing the logits beats tiling
         n = seq - 1
         chunk = max(d for d in range(1, min(n, 512) + 1) if n % d == 0)
+        if chunk < 32:
+            chunk = 0
     else:
         chunk = 0
     over = {}

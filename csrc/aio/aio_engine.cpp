@@ -488,14 +488,9 @@ struct UringEngine : EngineBase {
         if (r < 0 && errno != EINTR && errno != EBUSY && errno != EAGAIN &&
             errno != ETIME)
           ::usleep(1000);
-      } else {
-        // pre-5.11 kernel: no timed enter; poll non-blocking
-        int r = sys_io_uring_enter(ring_fd, 0, 0, IORING_ENTER_GETEVENTS);
-        if (r < 0 && errno != EINTR && errno != EBUSY && errno != EAGAIN)
-          ::usleep(1000);
-        else
-          ::usleep(500);
       }
+      // pre-5.11 fallback (no timed enter): sweep first, sleep only when
+      // the CQ was empty — pending completions never pay a poll delay
       std::unique_lock<std::mutex> l(mu);
       // Sweep the CQ and ADVANCE cq_head before retiring chunks: retirement
       // may resubmit (short transfers), and a resubmission backoff must not
@@ -510,9 +505,12 @@ struct UringEngine : EngineBase {
         head++;
       }
       __atomic_store_n(cq_head, head, __ATOMIC_RELEASE);
+      bool swept_nothing = batch.empty();
       for (auto& [cid, res] : batch) on_cqe_locked(l, cid, res);
       flush_locked(l);  // hand any resubmissions to the kernel
       if (stop && (inflight.empty() || broken)) return;
+      l.unlock();
+      if (!ext_arg && !broken.load() && swept_nothing) ::usleep(500);
     }
   }
 

@@ -29,6 +29,16 @@ from ...models.transformer import (MODEL_AXIS, TransformerConfig, _mm,
                                    mlp_block)
 
 
+def _use_paged_kernel() -> bool:
+    """Pallas kernels on TPU by default; DSTPU_PAGED_KERNEL=0/1 forces
+    either path (read at trace time — tests force the kernel in interpret
+    mode on CPU)."""
+    import os
+
+    default = "1" if jax.default_backend() == "tpu" else "0"
+    return os.environ.get("DSTPU_PAGED_KERNEL", default) == "1"
+
+
 def _ffn(cfg: TransformerConfig, layer, x):
     """mlp_block shared with the training forward; inference drops aux loss."""
     out, _aux = mlp_block(cfg, layer, x, training=False)
@@ -54,6 +64,8 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
         x = x + params["embed"]["pos"][pos_idx][None]
     positions = jnp.arange(S)[None]
 
+    use_flash = _use_paged_kernel()
+
     def body(x, inputs):
         layer, k_c, v_c = inputs  # k_c: [P+1, ps, KVH, D]
         q, k, v = attn_qkv(cfg, layer, x, positions)
@@ -61,14 +73,22 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
                                     .astype(k_c.dtype))
         v_c = v_c.at[page_rows].set(v[0].reshape(S // ps, ps, *v.shape[2:])
                                     .astype(v_c.dtype))
-        kk = _repeat_kv(k, cfg.n_heads // cfg.kv_heads)
-        vv = _repeat_kv(v, cfg.n_heads // cfg.kv_heads)
-        scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
-        scores = scores / math.sqrt(cfg.head_dim)
-        causal = jnp.arange(S)[None, None, :, None] >= jnp.arange(S)[None, None, None, :]
-        scores = jnp.where(causal, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, S, -1)
+        if use_flash:
+            # GQA-native flash kernel: no [S, S] score materialization.
+            # Pad tokens past ``length`` see only earlier slots (causal)
+            # and their outputs are discarded; real tokens see real slots.
+            from ...ops.pallas.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True).reshape(1, S, -1)
+        else:
+            kk = _repeat_kv(k, cfg.n_heads // cfg.kv_heads)
+            vv = _repeat_kv(v, cfg.n_heads // cfg.kv_heads)
+            scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
+            scores = scores / math.sqrt(cfg.head_dim)
+            causal = jnp.arange(S)[None, None, :, None] >= jnp.arange(S)[None, None, None, :]
+            scores = jnp.where(causal, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, S, -1)
         attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
                       + (layer["attn"]["bo"] if cfg.use_bias else 0))
         if cfg.parallel_block:
@@ -105,20 +125,31 @@ def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
     slot_pos = jnp.arange(S)[None]  # [1, S]
     vis = slot_pos <= positions[:, None]  # [B, S]
 
+    use_kernel = _use_paged_kernel()
+
     def body(x, inputs):
         layer, k_c, v_c = inputs
         q, k, v = attn_qkv(cfg, layer, x, positions[:, None])
         k_c = k_c.at[page_idx, off].set(k[:, 0].astype(k_c.dtype))
         v_c = v_c.at[page_idx, off].set(v[:, 0].astype(v_c.dtype))
-        kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
-        vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
-        kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
-        vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
-        scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
-        scores = scores / math.sqrt(cfg.head_dim)
-        scores = jnp.where(vis[:, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
+        if use_kernel:
+            # Pallas paged kernel: pages addressed in place through the
+            # scalar-prefetched table — no [B, S, KVH, D] materialization
+            # (reference ragged_ops decode kernels)
+            from ...ops.pallas.paged_attention import paged_decode_attention
+
+            attn = paged_decode_attention(q[:, 0], k_c, v_c, page_table,
+                                          positions).reshape(B, 1, -1)
+        else:
+            kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
+            vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
+            kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
+            vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
+            scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
+            scores = scores / math.sqrt(cfg.head_dim)
+            scores = jnp.where(vis[:, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
         attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
                       + (layer["attn"]["bo"] if cfg.use_bias else 0))
         if cfg.parallel_block:

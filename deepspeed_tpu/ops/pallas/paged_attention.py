@@ -1,0 +1,108 @@
+"""Paged decode attention (Pallas TPU kernel).
+
+The TPU-native replacement for the reference's ragged decode kernels
+(``inference/v2/kernels/ragged_ops``): one query token per sequence
+attends over that sequence's KV *pages in place* — the page table is a
+scalar-prefetch operand and each grid step's K/V block is addressed
+``k_pool[page_table[b, jp]]`` directly, so the padded [B, S, KVH, D]
+gather the XLA fallback materializes per layer per token never exists.
+
+Layout: q [B, KVH, G, D] (GQA groups folded next to their kv head);
+pools [P, ps, KVH, D]; page_table [B, MP] int32 (trash-filled past each
+sequence's pages); positions [B] int32 (slot of the CURRENT token —
+slots > position are masked, so trash pages beyond the length are
+harmless).  Online softmax accumulates across the page grid axis in VMEM
+scratch; the output block is written on the last page step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, ps, scale, n_pages):
+    b = pl.program_id(0)
+    jp = pl.program_id(2)
+
+    @pl.when(jp == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale      # [G, D]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)        # [ps, D]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = q @ k.T                                      # [G, ps]
+    pos = pos_ref[b]
+    slots = jp * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(slots <= pos, s, NEG_INF)
+
+    m_prev, l_prev = m_scr[...], l_scr[...]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = acc_scr[...] * alpha + p @ v
+    m_scr[...] = m_new
+    l_scr[...] = l_new
+
+    @pl.when(jp == n_pages - 1)
+    def _():
+        o_ref[0, 0] = (acc_scr[...] /
+                       jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, positions):
+    """q: [B, NH, D]; pools: [P, ps, KVH, D]; page_table: [B, MP] int32;
+    positions: [B] int32.  Returns [B, NH, D]."""
+    B, NH, D = q.shape
+    P, ps, KVH, Dk = k_pool.shape
+    MP = page_table.shape[1]
+    assert D == Dk and NH % KVH == 0
+    G = NH // KVH
+    scale = 1.0 / math.sqrt(D)
+    qg = q.reshape(B, KVH, G, D)
+
+    grid = (B, KVH, MP)
+    kernel = pl.pallas_call(
+        functools.partial(_decode_kernel, ps=ps, scale=scale, n_pages=MP),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, G, D),
+                             lambda b, h, jp, pt, pos: (b, h, 0, 0)),
+                # the page-table lookup: this block IS the page
+                pl.BlockSpec((1, ps, 1, D),
+                             lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
+                pl.BlockSpec((1, ps, 1, D),
+                             lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, D),
+                                   lambda b, h, jp, pt, pos: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
+        interpret=_interpret(),
+    )
+    out = kernel(page_table, positions, qg, k_pool, v_pool)
+    return out.reshape(B, NH, D)

@@ -348,8 +348,10 @@ class DeepSpeedTPUEngine:
         grads = self.zero_plan.constrain(grads, "grad")
         return grads, loss
 
-    def _micro_step_body(self, state: TrainState, batch, rng) -> Tuple[TrainState, jnp.ndarray]:
-        grads, loss = self._micro_grads(state, batch, rng)
+    def _micro_step_body(self, state: TrainState, batch, rng,
+                         compute_params=None) -> Tuple[TrainState, jnp.ndarray]:
+        grads, loss = self._micro_grads(state, batch, rng,
+                                        compute_params=compute_params)
         new_acc = jax.tree_util.tree_map(jnp.add, state.grad_acc, grads)
         state = dataclasses.replace(state, grad_acc=new_acc,
                                     micro_step=state.micro_step + 1)
@@ -493,12 +495,8 @@ class DeepSpeedTPUEngine:
 
         def body(st, xs):
             batch, r = xs
-            grads, loss = self._micro_grads(st, batch, r,
-                                            compute_params=compute_params)
-            new_acc = jax.tree_util.tree_map(jnp.add, st.grad_acc, grads)
-            st = dataclasses.replace(st, grad_acc=new_acc,
-                                     micro_step=st.micro_step + 1)
-            return st, loss.astype(jnp.float32)
+            return self._micro_step_body(st, batch, r,
+                                         compute_params=compute_params)
 
         state, losses = jax.lax.scan(body, state, (batches, rngs))
         return state, jnp.mean(losses)
@@ -882,12 +880,7 @@ class DeepSpeedTPUEngine:
 
         @contextlib.contextmanager
         def ctx():
-            prev = getattr(self, "_in_no_sync", False)
-            self._in_no_sync = True
-            try:
-                yield
-            finally:
-                self._in_no_sync = prev
+            yield
 
         return ctx()
 

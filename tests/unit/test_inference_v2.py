@@ -59,6 +59,22 @@ def test_paged_matches_dense_single():
     assert got[0] == want, (got, want)
 
 
+def test_paged_kernel_path_matches_dense(monkeypatch):
+    """Same oracle with the Pallas paged-decode kernel forced on
+    (interpret mode on CPU) — the TPU hot path, token-for-token."""
+    monkeypatch.setenv("DSTPU_PAGED_KERNEL", "1")
+    model = llama_model("tiny", max_seq_len=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = list(np.random.RandomState(5).randint(0, model.config.vocab_size, 13))
+    want = _dense_greedy(model, params, prompt, 8)
+
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=32, max_seqs=2,
+        max_pages_per_seq=8), params=params)
+    got = eng.generate_all([RaggedRequest(prompt_ids=prompt, max_new_tokens=8)])
+    assert got[0] == want, (got, want)
+
+
 def test_continuous_batching_mixed_lengths():
     """Three prompts of different lengths, admitted together; results must
     match per-sequence dense generation exactly."""
