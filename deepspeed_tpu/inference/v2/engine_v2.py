@@ -43,6 +43,8 @@ class RaggedInferenceConfig(ConfigModel):
     quant_bits: int = 0
     quant_group: int = 128
     quant_min_size: int = 1 << 14  # per-matrix eligibility floor
+    #: int8 KV pages + per-(page,slot,head) scales: half the KV pool HBM
+    kv_quant: bool = False
 
     @property
     def jnp_dtype(self):
@@ -96,9 +98,9 @@ class InferenceEngineV2:
             self.params, _, self.param_bytes = quantize_inference_params(
                 self.params, self.cfg.wq_bits, self.cfg.wq_group,
                 min_size=self.config.quant_min_size)
-        pool = PagedKVCache.init(self.cfg.n_layers, self.cfg.kv_heads,
-                                 self.cfg.head_dim, block, self.config.jnp_dtype)
-        self._k_pool, self._v_pool = pool["k"], pool["v"]
+        self._pools = PagedKVCache.init(
+            self.cfg.n_layers, self.cfg.kv_heads, self.cfg.head_dim, block,
+            self.config.jnp_dtype, kv_quant=self.config.kv_quant)
         self.block = block
         # A learned-position model cannot attend past its position table; cap
         # the paged window to the model's trained context.
@@ -116,9 +118,9 @@ class InferenceEngineV2:
 
         cfg = self.cfg
         self._decode = jax.jit(
-            lambda *a: paged_decode(cfg, *a), donate_argnums=(1, 2))
+            lambda *a: paged_decode(cfg, *a), donate_argnums=(1,))
         self._prefill = jax.jit(
-            lambda *a: paged_prefill(cfg, *a), donate_argnums=(1, 2))
+            lambda *a: paged_prefill(cfg, *a), donate_argnums=(1,))
 
     # -- request API ---------------------------------------------------------
     def put(self, request: RaggedRequest) -> int:
@@ -223,8 +225,8 @@ class InferenceEngineV2:
             ids[:n] = seq.tokens
             rows = np.full((bucket // ps,), self.block.trash_page, np.int32)
             rows[:len(seq.pages)] = seq.pages
-            logits, self._k_pool, self._v_pool = self._prefill(
-                self.params, self._k_pool, self._v_pool,
+            logits, self._pools = self._prefill(
+                self.params, self._pools,
                 jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
             tok = self._sample(seq, np.asarray(logits, np.float32))
             seq.tokens.append(tok)
@@ -274,8 +276,8 @@ class InferenceEngineV2:
             pos[seq.slot] = seq.length - 1
             act[seq.slot] = True
 
-        logits, self._k_pool, self._v_pool = self._decode(
-            self.params, self._k_pool, self._v_pool,
+        logits, self._pools = self._decode(
+            self.params, self._pools,
             jnp.asarray(last), jnp.asarray(pos),
             jnp.asarray(self._page_table), jnp.asarray(act))
         logits = np.asarray(logits, np.float32)

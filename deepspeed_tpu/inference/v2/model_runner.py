@@ -39,22 +39,47 @@ def _use_paged_kernel() -> bool:
     return os.environ.get("DSTPU_PAGED_KERNEL", default) == "1"
 
 
+def _kv_quantize(x):
+    """[..., KVH, D] -> (int8 codes, fp32 scale [..., KVH]) per head."""
+    s = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0,
+                    1e-8)
+    q = jnp.round(x.astype(jnp.float32) / s[..., None]).astype(jnp.int8)
+    return q, s.astype(jnp.float32)
+
+
+def _pools_per_layer(pools):
+    """Split the pools dict into per-layer scan operands (None-safe)."""
+    return (pools["k"], pools["v"],
+            pools.get("k_scale"), pools.get("v_scale"))
+
+
+def _pools_from_scan(new_pools):
+    """Inverse of _pools_per_layer over the scan outputs."""
+    out = {"k": new_pools[0], "v": new_pools[1]}
+    if new_pools[2] is not None:
+        out["k_scale"], out["v_scale"] = new_pools[2], new_pools[3]
+    return out
+
+
 def _ffn(cfg: TransformerConfig, layer, x):
     """mlp_block shared with the training forward; inference drops aux loss."""
     out, _aux = mlp_block(cfg, layer, x, training=False)
     return out
 
 
-def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
-                  ids, page_rows, length) -> Tuple[jnp.ndarray, Any, Any]:
+def paged_prefill(cfg: TransformerConfig, params, pools,
+                  ids, page_rows, length) -> Tuple[jnp.ndarray, Any]:
     """Prefill one prompt.
 
+    pools: {"k", "v"[, "k_scale", "v_scale"]} page pools (int8 codes +
+    per-(page,slot,head) scales when KV quantization is on).
     ids: [S_pad] bucket-padded prompt; page_rows: [S_pad // page_size]
     page index per chunk (trash for pad chunks); length: real prompt length.
-    Returns (last-token logits [V], k_pool, v_pool).
+    Returns (last-token logits [V], pools).
     """
+    quant = "k_scale" in pools
     S = ids.shape[0]
-    ps = k_pool.shape[2]
+    ps = pools["k"].shape[2]
     x = params["embed"]["tok"][ids][None]  # [1, S, H]
     if cfg.position == "learned":
         # the bucket may pad up to page_size-1 slots past the position
@@ -67,12 +92,20 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
     use_flash = _use_paged_kernel()
 
     def body(x, inputs):
-        layer, k_c, v_c = inputs  # k_c: [P+1, ps, KVH, D]
+        layer, k_c, v_c, ks_c, vs_c = inputs  # k_c: [P+1, ps, KVH, D]
         q, k, v = attn_qkv(cfg, layer, x, positions)
-        k_c = k_c.at[page_rows].set(k[0].reshape(S // ps, ps, *k.shape[2:])
-                                    .astype(k_c.dtype))
-        v_c = v_c.at[page_rows].set(v[0].reshape(S // ps, ps, *v.shape[2:])
-                                    .astype(v_c.dtype))
+        k_pages = k[0].reshape(S // ps, ps, *k.shape[2:])
+        v_pages = v[0].reshape(S // ps, ps, *v.shape[2:])
+        if quant:
+            kq, ksc = _kv_quantize(k_pages)
+            vq, vsc = _kv_quantize(v_pages)
+            k_c = k_c.at[page_rows].set(kq)
+            v_c = v_c.at[page_rows].set(vq)
+            ks_c = ks_c.at[page_rows].set(ksc)
+            vs_c = vs_c.at[page_rows].set(vsc)
+        else:
+            k_c = k_c.at[page_rows].set(k_pages.astype(k_c.dtype))
+            v_c = v_c.at[page_rows].set(v_pages.astype(v_c.dtype))
         if use_flash:
             # GQA-native flash kernel: no [S, S] score materialization.
             # Pad tokens past ``length`` see only earlier slots (causal)
@@ -92,28 +125,32 @@ def paged_prefill(cfg: TransformerConfig, params, k_pool, v_pool,
         attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
                       + (layer["attn"]["bo"] if cfg.use_bias else 0))
         if cfg.parallel_block:
-            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c)
-        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c)
+            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c, ks_c, vs_c)
+        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c, ks_c, vs_c)
 
-    x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
+    ops = (params["layers"],) + _pools_per_layer(pools)
+    x, new_pools = jax.lax.scan(body, x, ops)
+    out_pools = _pools_from_scan(new_pools)
     hidden = _norm(x[:, length - 1], params["final_norm"]["scale"],
                    params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     logits = logits_fn(cfg, params, hidden[:, None])[0, 0]
-    return logits, k_pool, v_pool
+    return logits, out_pools
 
 
-def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
+def paged_decode(cfg: TransformerConfig, params, pools,
                  last_tokens, positions, page_table, active
-                 ) -> Tuple[jnp.ndarray, Any, Any]:
+                 ) -> Tuple[jnp.ndarray, Any]:
     """One token for every decode slot.
 
-    last_tokens: [B]; positions: [B] position of that token; page_table:
-    [B, MP] (trash-filled beyond each sequence's pages); active: [B] bool.
-    Returns (logits [B, V], k_pool, v_pool).
+    pools: page pools dict (see paged_prefill).  last_tokens: [B];
+    positions: [B] position of that token; page_table: [B, MP]
+    (trash-filled beyond each sequence's pages); active: [B] bool.
+    Returns (logits [B, V], pools).
     """
+    quant = "k_scale" in pools
     B = last_tokens.shape[0]
-    ps = k_pool.shape[2]
-    trash = k_pool.shape[1] - 1
+    ps = pools["k"].shape[2]
+    trash = pools["k"].shape[1] - 1
     x = params["embed"]["tok"][last_tokens][:, None]  # [B, 1, H]
     if cfg.position == "learned":
         x = x + params["embed"]["pos"][positions][:, None]
@@ -128,21 +165,37 @@ def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
     use_kernel = _use_paged_kernel()
 
     def body(x, inputs):
-        layer, k_c, v_c = inputs
+        layer, k_c, v_c, ks_c, vs_c = inputs
         q, k, v = attn_qkv(cfg, layer, x, positions[:, None])
-        k_c = k_c.at[page_idx, off].set(k[:, 0].astype(k_c.dtype))
-        v_c = v_c.at[page_idx, off].set(v[:, 0].astype(v_c.dtype))
+        if quant:
+            kq, ksc = _kv_quantize(k[:, 0])
+            vq, vsc = _kv_quantize(v[:, 0])
+            k_c = k_c.at[page_idx, off].set(kq)
+            v_c = v_c.at[page_idx, off].set(vq)
+            ks_c = ks_c.at[page_idx, off].set(ksc)
+            vs_c = vs_c.at[page_idx, off].set(vsc)
+        else:
+            k_c = k_c.at[page_idx, off].set(k[:, 0].astype(k_c.dtype))
+            v_c = v_c.at[page_idx, off].set(v[:, 0].astype(v_c.dtype))
         if use_kernel:
             # Pallas paged kernel: pages addressed in place through the
             # scalar-prefetched table — no [B, S, KVH, D] materialization
             # (reference ragged_ops decode kernels)
             from ...ops.pallas.paged_attention import paged_decode_attention
 
-            attn = paged_decode_attention(q[:, 0], k_c, v_c, page_table,
-                                          positions).reshape(B, 1, -1)
+            attn = paged_decode_attention(
+                q[:, 0], k_c, v_c, page_table, positions,
+                k_scale=ks_c, v_scale=vs_c).reshape(B, 1, -1)
         else:
             kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
             vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
+            if quant:
+                kk = kk.astype(jnp.float32) \
+                    * ks_c[page_table].reshape(B, S, -1)[..., None]
+                vv = vv.astype(jnp.float32) \
+                    * vs_c[page_table].reshape(B, S, -1)[..., None]
+                kk = kk.astype(x.dtype)
+                vv = vv.astype(x.dtype)
             kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
             vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
             scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
@@ -153,11 +206,13 @@ def paged_decode(cfg: TransformerConfig, params, k_pool, v_pool,
         attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
                       + (layer["attn"]["bo"] if cfg.use_bias else 0))
         if cfg.parallel_block:
-            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c)
-        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c)
+            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c, ks_c, vs_c)
+        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c, ks_c, vs_c)
 
-    x, (k_pool, v_pool) = jax.lax.scan(body, x, (params["layers"], k_pool, v_pool))
+    ops = (params["layers"],) + _pools_per_layer(pools)
+    x, new_pools = jax.lax.scan(body, x, ops)
+    out_pools = _pools_from_scan(new_pools)
     hidden = _norm(x, params["final_norm"]["scale"],
                    params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     logits = logits_fn(cfg, params, hidden)[:, 0]
-    return logits, k_pool, v_pool
+    return logits, out_pools

@@ -65,12 +65,25 @@ class BlockAllocator:
 
 
 class PagedKVCache:
-    """Device arrays of the page pool."""
+    """Device arrays of the page pool.
+
+    ``kv_quant``: store K/V as int8 codes + one fp32 scale per
+    (page, slot, kv-head) — half the pool HBM of bf16, so twice the KV
+    capacity (the reference's blocked-KV analogue of weight-only
+    quantization, applied to the cache).  Quantize-on-write,
+    dequantize-on-read; the paged Pallas kernel dequantizes in VMEM."""
 
     @staticmethod
     def init(n_layers: int, kv_heads: int, head_dim: int,
-             block: KVBlockConfig, dtype=jnp.bfloat16) -> Dict[str, Any]:
+             block: KVBlockConfig, dtype=jnp.bfloat16,
+             kv_quant: bool = False) -> Dict[str, Any]:
         shape = (n_layers, block.num_pages + 1, block.page_size, kv_heads, head_dim)
+        if kv_quant:
+            sshape = shape[:-1]
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32)}
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

@@ -32,8 +32,12 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, ps, scale, n_pages):
+def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   ps, scale, n_pages, quant):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     jp = pl.program_id(2)
 
@@ -46,6 +50,11 @@ def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32) * scale      # [G, D]
     k = k_ref[0, :, 0, :].astype(jnp.float32)        # [ps, D]
     v = v_ref[0, :, 0, :].astype(jnp.float32)
+    if quant:  # int8 codes * per-(slot, head) scale, dequantized in VMEM.
+        # Scales ride as [P, ps, KVH, 1] blocks mirroring K/V's rank so the
+        # in-kernel loads stay the 2-D shapes Mosaic provably lowers.
+        k = k * ks_ref[0, :, 0, :]                   # [ps, 1] broadcast
+        v = v * vs_ref[0, :, 0, :]
     s = q @ k.T                                      # [G, ps]
     pos = pos_ref[b]
     slots = jp * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -67,32 +76,47 @@ def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                        jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q, k_pool, v_pool, page_table, positions):
-    """q: [B, NH, D]; pools: [P, ps, KVH, D]; page_table: [B, MP] int32;
+def paged_decode_attention(q, k_pool, v_pool, page_table, positions,
+                           k_scale=None, v_scale=None):
+    """q: [B, NH, D]; pools: [P, ps, KVH, D] (int8 codes when ``k_scale``/
+    ``v_scale`` [P, ps, KVH] given); page_table: [B, MP] int32;
     positions: [B] int32.  Returns [B, NH, D]."""
     B, NH, D = q.shape
     P, ps, KVH, Dk = k_pool.shape
     MP = page_table.shape[1]
     assert D == Dk and NH % KVH == 0
+    quant = k_scale is not None
     G = NH // KVH
     scale = 1.0 / math.sqrt(D)
     qg = q.reshape(B, KVH, G, D)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D),
+                     lambda b, h, jp, pt, pos: (b, h, 0, 0)),
+        # the page-table lookup: this block IS the page
+        pl.BlockSpec((1, ps, 1, D),
+                     lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
+        pl.BlockSpec((1, ps, 1, D),
+                     lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
+    ]
+    args = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, ps, 1, 1),
+                         lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
+            pl.BlockSpec((1, ps, 1, 1),
+                         lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
+        ]
+        args += [k_scale[..., None], v_scale[..., None]]
+
     grid = (B, KVH, MP)
     kernel = pl.pallas_call(
-        functools.partial(_decode_kernel, ps=ps, scale=scale, n_pages=MP),
+        functools.partial(_decode_kernel, ps=ps, scale=scale, n_pages=MP,
+                          quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, G, D),
-                             lambda b, h, jp, pt, pos: (b, h, 0, 0)),
-                # the page-table lookup: this block IS the page
-                pl.BlockSpec((1, ps, 1, D),
-                             lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
-                pl.BlockSpec((1, ps, 1, D),
-                             lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, G, D),
                                    lambda b, h, jp, pt, pos: (b, h, 0, 0)),
             scratch_shapes=[
@@ -104,5 +128,5 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, positions):
         out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
         interpret=_interpret(),
     )
-    out = kernel(page_table, positions, qg, k_pool, v_pool)
+    out = kernel(page_table, positions, *args)
     return out.reshape(B, NH, D)

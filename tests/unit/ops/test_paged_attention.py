@@ -71,3 +71,25 @@ def test_paged_decode_trash_pages_ignored():
         q, k_pool.at[-1].set(0), v_pool.at[-1].set(0), page_table, positions)
     np.testing.assert_allclose(np.asarray(out), np.asarray(clean),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_paged_decode_quantized_matches_dequant():
+    """Kernel dequant-in-VMEM path vs dequantize-then-gather reference."""
+    rng = np.random.RandomState(2)
+    B, NH, D, ps, MP, KVH = 2, 4, 16, 8, 3, 2
+    P = 8
+    q = jnp.asarray(rng.randn(B, NH, D), jnp.float32)
+    codes_k = jnp.asarray(rng.randint(-127, 128, (P, ps, KVH, D)), jnp.int8)
+    codes_v = jnp.asarray(rng.randint(-127, 128, (P, ps, KVH, D)), jnp.int8)
+    ks = jnp.asarray(rng.rand(P, ps, KVH) * 0.05 + 0.01, jnp.float32)
+    vs = jnp.asarray(rng.rand(P, ps, KVH) * 0.05 + 0.01, jnp.float32)
+    positions = jnp.asarray([10, 20], jnp.int32)
+    table = jnp.asarray([[0, 1, 7], [2, 3, 4]], jnp.int32)
+
+    out = paged_decode_attention(q, codes_k, codes_v, table, positions,
+                                 k_scale=ks, v_scale=vs)
+    ref = _reference(q, codes_k.astype(jnp.float32) * ks[..., None],
+                     codes_v.astype(jnp.float32) * vs[..., None],
+                     table, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)

@@ -244,12 +244,12 @@ def test_v2_engine_generates_with_quantized_weights(bits):
     ids = np.zeros((32,), np.int32)
     ids[:len(prompt)] = prompt
     rows = np.arange(4, dtype=np.int32)
-    lf, *_ = paged_prefill(e_fp.cfg, e_fp.params, e_fp._k_pool, e_fp._v_pool,
-                           jnp.asarray(ids), jnp.asarray(rows),
-                           jnp.int32(len(prompt)))
-    lq, *_ = paged_prefill(e_q.cfg, e_q.params, e_q._k_pool, e_q._v_pool,
-                           jnp.asarray(ids), jnp.asarray(rows),
-                           jnp.int32(len(prompt)))
+    lf, _ = paged_prefill(e_fp.cfg, e_fp.params, e_fp._pools,
+                          jnp.asarray(ids), jnp.asarray(rows),
+                          jnp.int32(len(prompt)))
+    lq, _ = paged_prefill(e_q.cfg, e_q.params, e_q._pools,
+                          jnp.asarray(ids), jnp.asarray(rows),
+                          jnp.int32(len(prompt)))
     lf, lq = np.asarray(lf, np.float64), np.asarray(lq, np.float64)
     cos = float((lf * lq).sum() / (np.linalg.norm(lf) * np.linalg.norm(lq)))
     assert cos > (0.999 if bits == 8 else 0.98), cos
@@ -257,3 +257,45 @@ def test_v2_engine_generates_with_quantized_weights(bits):
     out = e_q.generate_all([RaggedRequest(prompt_ids=prompt, max_new_tokens=8)])
     toks = list(out.values())[0]
     assert len(toks) == 8 and all(0 <= t < 256 for t in toks)
+
+
+def test_kv_quant_int8_pool(monkeypatch):
+    """int8 KV pages: pool bytes < half of fp32, prefill logits exact
+    (storage-only quantization), decode logits close to the fp pool."""
+    from deepspeed_tpu.inference.v2.model_runner import (paged_decode,
+                                                         paged_prefill)
+
+    model = llama_model("tiny", max_seq_len=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    mk = lambda **kw: InferenceEngineV2(model, RaggedInferenceConfig(  # noqa: E731
+        dtype="fp32", page_size=8, num_pages=32, max_seqs=2,
+        max_pages_per_seq=8, **kw), params=params)
+    e_fp, e_q = mk(), mk(kv_quant=True)
+    nbytes = lambda pools: sum(x.size * x.dtype.itemsize  # noqa: E731
+                               for x in jax.tree_util.tree_leaves(pools))
+    assert nbytes(e_q._pools) < nbytes(e_fp._pools) * 0.5
+
+    prompt = list(np.random.RandomState(6).randint(0, 256, 13))
+    ids = np.zeros((16,), np.int32)
+    ids[:13] = prompt
+    rows = np.arange(2, dtype=np.int32)
+    args = (jnp.asarray(ids), jnp.asarray(rows), jnp.int32(13))
+    lf, pools_fp = paged_prefill(e_fp.cfg, e_fp.params, e_fp._pools, *args)
+    lq, pools_q = paged_prefill(e_q.cfg, e_q.params, e_q._pools, *args)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lq), rtol=1e-5,
+                               atol=1e-5)  # prefill attends pre-quant k/v
+
+    table = np.full((2, 8), e_fp.block.trash_page, np.int32)
+    table[0, :2] = rows
+    tok = jnp.asarray([int(np.argmax(np.asarray(lf))), 0], jnp.int32)
+    dargs = (tok, jnp.asarray([13, 0], jnp.int32), jnp.asarray(table),
+             jnp.asarray([True, False]))
+    df, _ = paged_decode(e_fp.cfg, e_fp.params, pools_fp, *dargs)
+    dq, _ = paged_decode(e_q.cfg, e_q.params, pools_q, *dargs)
+    a, b = np.asarray(df[0], np.float64), np.asarray(dq[0], np.float64)
+    cos = float((a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.999, cos
+
+    # end-to-end generation with quantized KV completes
+    out = e_q.generate_all([RaggedRequest(prompt_ids=prompt, max_new_tokens=6)])
+    assert len(list(out.values())[0]) == 6
