@@ -52,21 +52,32 @@ def _backend_usable() -> bool:
         return True
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((128, 128), jnp.bfloat16); "
-            "(x @ x).block_until_ready(); "
-            "print(jax.default_backend())")
+            "x = (x @ x); "
+            "print(float(x.sum()), jax.default_backend())")
     try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True,
-                              timeout=_PROBE_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        print("bench: backend probe timed out; falling back to cpu",
-              file=sys.stderr)
-        return False
-    if proc.returncode != 0:
-        print(f"bench: backend probe failed; falling back to cpu\n"
-              f"{proc.stderr[-2000:]}", file=sys.stderr)
-        return False
-    return True
+        tries = max(1, int(os.environ.get("DSTPU_BENCH_PROBE_RETRIES", "2")) + 1)
+    except ValueError:
+        tries = 3
+    err = ""
+    for attempt in range(tries):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=_PROBE_TIMEOUT_S)
+            if proc.returncode == 0:
+                return True
+            err = proc.stderr[-2000:]
+        except subprocess.TimeoutExpired:
+            err = "probe timed out"
+        if attempt + 1 < tries:
+            # a wedged chip lease can clear between attempts; wait it out
+            print(f"bench: backend probe failed ({err[:200]}); retrying in "
+                  f"60s ({attempt + 1}/{tries - 1} retries used)",
+                  file=sys.stderr)
+            time.sleep(60)
+    print(f"bench: backend probe failed; falling back to cpu\n{err}",
+          file=sys.stderr)
+    return False
 
 PEAK_BF16_FLOPS = {
     # per-chip peak bf16 FLOP/s

@@ -38,25 +38,47 @@ def _ring_body(qkv, causal: bool):
     my = jax.lax.axis_index(SEQ_AXIS)
     B, S, NH, D = q.shape
     scale = 1.0 / math.sqrt(D)
-    qf = q.astype(jnp.float32)
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def step(t, carry):
-        acc, m_prev, l_prev, k_cur, v_cur = carry
-        src = (my - t) % sp  # global chunk index of the kv currently held
-        s = _chunk_scores(q, k_cur, scale)  # [B, NH, S, S]
+    # bound the materialized score block to [B, NH, S, kc] instead of
+    # [B, NH, S, S]: at long local context (the whole point of CP) the
+    # full block is the memory cliff — online-softmax over k sub-chunks
+    # keeps the same math with S/kc-fold less live score memory
+    import os
+
+    kc_target = int(os.environ.get("DSTPU_RING_CHUNK", "512"))
+    if S <= kc_target:
+        kc = S
+    else:  # largest divisor of S <= target, so the bound holds at any shape
+        kc = max(d for d in range(1, kc_target + 1) if S % d == 0)
+
+    def one_kv_chunk(carry, inputs):
+        acc, m_prev, l_prev = carry
+        k_blk, v_blk, col0 = inputs  # [B, kc, NH, D], scalar col offset
+        s = _chunk_scores(q, k_blk, scale)  # [B, NH, S, kc]
         if causal:
-            rows = my * S + jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
-            cols = src * S + jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+            rows = my * S + jax.lax.broadcasted_iota(jnp.int32, (S, kc), 0)
+            cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (S, kc), 1)
             s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [B, NH, S, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.einsum("bnst,btnd->bsnd", p, v_cur.astype(jnp.float32))
+        pv = jnp.einsum("bnst,btnd->bsnd", p, v_blk.astype(jnp.float32))
         acc = acc * jnp.moveaxis(alpha, 1, 2) + pv
+        return (acc, m_new, l_new), None
+
+    def step(t, carry):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        src = (my - t) % sp  # global chunk index of the kv currently held
+        nc = S // kc
+        k_chunks = jnp.moveaxis(k_cur.reshape(B, nc, kc, NH, D), 1, 0)
+        v_chunks = jnp.moveaxis(v_cur.reshape(B, nc, kc, NH, D), 1, 0)
+        col0s = src * S + jnp.arange(nc) * kc
+        (acc, m_new, l_new), _ = jax.lax.scan(
+            one_kv_chunk, (acc, m_prev, l_prev), (k_chunks, v_chunks, col0s))
         k_nxt = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
         v_nxt = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
         return acc, m_new, l_new, k_nxt, v_nxt
