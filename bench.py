@@ -60,6 +60,7 @@ def _backend_usable() -> bool:
         tries = 3
     err = ""
     for attempt in range(tries):
+        retryable = False
         try:
             proc = subprocess.run([sys.executable, "-c", code],
                                   capture_output=True, text=True,
@@ -68,11 +69,15 @@ def _backend_usable() -> bool:
                 return True
             err = proc.stderr[-2000:]
         except subprocess.TimeoutExpired:
+            # only a hang suggests a wedged chip lease that may clear; a
+            # fast non-zero exit (no TPU plugin at all) never will
             err = "probe timed out"
+            retryable = True
+        if not retryable:
+            break
         if attempt + 1 < tries:
-            # a wedged chip lease can clear between attempts; wait it out
-            print(f"bench: backend probe failed ({err[:200]}); retrying in "
-                  f"60s ({attempt + 1}/{tries - 1} retries used)",
+            print(f"bench: backend probe hung; retrying in 60s "
+                  f"({attempt + 1}/{tries - 1} retries used)",
                   file=sys.stderr)
             time.sleep(60)
     print(f"bench: backend probe failed; falling back to cpu\n{err}",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -45,9 +46,10 @@ def _ring_body(qkv, causal: bool):
     # [B, NH, S, S]: at long local context (the whole point of CP) the
     # full block is the memory cliff — online-softmax over k sub-chunks
     # keeps the same math with S/kc-fold less live score memory
-    import os
-
-    kc_target = int(os.environ.get("DSTPU_RING_CHUNK", "512"))
+    try:
+        kc_target = max(1, int(os.environ.get("DSTPU_RING_CHUNK", "512")))
+    except ValueError:
+        kc_target = 512
     if S <= kc_target:
         kc = S
     else:  # largest divisor of S <= target, so the bound holds at any shape
