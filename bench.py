@@ -58,9 +58,13 @@ def _backend_usable() -> bool:
         tries = max(1, int(os.environ.get("DSTPU_BENCH_PROBE_RETRIES", "2")) + 1)
     except ValueError:
         tries = 3
+    # Both failure modes are worth one retry cycle: a hang is a wedged
+    # chip lease that can clear, and a fast non-zero exit is usually "chip
+    # busy / claim failed" from another process about to release it.  (A
+    # machine with no TPU at all does not reach here: jax falls back to
+    # cpu and the probe SUCCEEDS, reporting backend=cpu.)
     err = ""
     for attempt in range(tries):
-        retryable = False
         try:
             proc = subprocess.run([sys.executable, "-c", code],
                                   capture_output=True, text=True,
@@ -69,15 +73,10 @@ def _backend_usable() -> bool:
                 return True
             err = proc.stderr[-2000:]
         except subprocess.TimeoutExpired:
-            # only a hang suggests a wedged chip lease that may clear; a
-            # fast non-zero exit (no TPU plugin at all) never will
             err = "probe timed out"
-            retryable = True
-        if not retryable:
-            break
         if attempt + 1 < tries:
-            print(f"bench: backend probe hung; retrying in 60s "
-                  f"({attempt + 1}/{tries - 1} retries used)",
+            print(f"bench: backend probe failed ({err[-200:]}); retrying in "
+                  f"60s ({attempt + 1}/{tries - 1} retries used)",
                   file=sys.stderr)
             time.sleep(60)
     print(f"bench: backend probe failed; falling back to cpu\n{err}",

@@ -65,6 +65,43 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """comet_ml writer (reference monitor/comet.py): modern API via
+    comet_ml.start (online/mode/name ride there), Experiment fallback for
+    old installs; events throttled to every ``samples_log_interval``-th
+    step like the reference."""
+
+    def __init__(self, cfg):
+        import comet_ml
+
+        self._interval = max(1, int(getattr(cfg, "samples_log_interval", 1) or 1))
+        base = {k: v for k, v in (("api_key", cfg.api_key),
+                                  ("workspace", cfg.workspace)) if v}
+        if hasattr(comet_ml, "start"):
+            kw = dict(base, project=cfg.project)
+            for name in ("online", "mode", "experiment_key"):
+                v = getattr(cfg, name, None)
+                if v is not None:
+                    kw[name] = v
+            self._exp = comet_ml.start(**{k: v for k, v in kw.items()
+                                          if v is not None})
+        else:  # legacy comet_ml: Experiment takes project_name only
+            kw = dict(base)
+            if cfg.project:
+                kw["project_name"] = cfg.project
+            self._exp = comet_ml.Experiment(**kw)
+        if getattr(cfg, "experiment_name", None):
+            try:
+                self._exp.set_name(cfg.experiment_name)
+            except Exception:
+                pass
+
+    def write_events(self, events: List[Event]) -> None:
+        for tag, value, step in events:
+            if step % self._interval == 0:
+                self._exp.log_metric(tag, value, step=step)
+
+
 class MonitorMaster(Monitor):
     def __init__(self, config):
         self.monitors: List[Monitor] = []
@@ -85,6 +122,11 @@ class MonitorMaster(Monitor):
                                                   config.wandb.group, config.wandb.team))
             except Exception as e:
                 logger.warning(f"W&B monitor unavailable: {e}")
+        if getattr(config, "comet", None) is not None and config.comet.enabled:
+            try:
+                self.monitors.append(CometMonitor(config.comet))
+            except Exception as e:  # comet_ml not installed
+                logger.warning(f"Comet monitor unavailable: {e}")
 
     @property
     def enabled(self) -> bool:

@@ -213,6 +213,21 @@ class WandbConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class CometConfig(ConfigModel):
+    """Reference monitor/config.py CometConfig (comet_ml writer)."""
+
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
+@dataclasses.dataclass
 class CSVConfig(MonitorConfig):
     pass
 
@@ -293,6 +308,7 @@ class DeepSpeedConfig:
     comms_logger: CommsLoggerConfig
     tensorboard: TensorBoardConfig
     wandb: WandbConfig
+    comet: CometConfig
     csv_monitor: CSVConfig
     aio: AIOConfig
     checkpoint: CheckpointConfig
@@ -340,6 +356,7 @@ class DeepSpeedConfig:
         self.comms_logger = CommsLoggerConfig.from_dict(g("comms_logger"))
         self.tensorboard = TensorBoardConfig.from_dict(g("tensorboard"))
         self.wandb = WandbConfig.from_dict(g("wandb"))
+        self.comet = CometConfig.from_dict(g("comet"))
         self.csv_monitor = CSVConfig.from_dict(g("csv_monitor"))
         self.aio = AIOConfig.from_dict(g("aio"))
         self.checkpoint = CheckpointConfig.from_dict(g("checkpoint"))

@@ -120,7 +120,8 @@ class DeepSpeedTPUEngine:
         self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size or 1,
                                           steps_per_output=config.steps_per_print)
         self.monitor = None
-        if config.tensorboard.enabled or config.csv_monitor.enabled or config.wandb.enabled:
+        if config.tensorboard.enabled or config.csv_monitor.enabled \
+                or config.wandb.enabled or config.comet.enabled:
             from ..monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(config)
