@@ -74,6 +74,13 @@ def _backend_usable() -> bool:
             err = proc.stderr[-2000:]
         except subprocess.TimeoutExpired:
             err = "probe timed out"
+        # permanent failures (no plugin/backend at all) never clear —
+        # don't pay the retry sleeps for them
+        permanent = any(s in err for s in
+                        ("Unknown backend", "ModuleNotFoundError",
+                         "ImportError", "not in the list of known backends"))
+        if permanent:
+            break
         if attempt + 1 < tries:
             print(f"bench: backend probe failed ({err[-200:]}); retrying in "
                   f"60s ({attempt + 1}/{tries - 1} retries used)",

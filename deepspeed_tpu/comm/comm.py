@@ -260,6 +260,68 @@ def send_recv_prev(tensor, axis: AxisName = "pipe"):
     return ppermute(tensor, [(i, (i - 1) % n) for i in range(n)], axis)
 
 
+def send(tensor, src: int, dst: int, axis: AxisName = "pipe"):
+    """Reference ``dist.send``/``recv`` pair (comm/comm.py, pipe/p2p.py),
+    SPMD form: EVERY rank on ``axis`` calls this; rank ``src``'s tensor
+    arrives on rank ``dst`` (zeros elsewhere).  One-sided send does not
+    exist under SPMD — src/dst are static and both ends run the same
+    program, exactly like the reference's paired send/recv calls.  For
+    pipeline schedules prefer send_recv_next/prev (whole-ring shifts)."""
+    return ppermute(tensor, [(src, dst)], axis)
+
+
+def recv(tensor, src: int, dst: int, axis: AxisName = "pipe"):
+    """The receiving end of ``send`` — the same collective (call either
+    once); named for torch-API familiarity."""
+    return send(tensor, src, dst, axis)
+
+
+def isend(tensor, src: int, dst: int, axis: AxisName = "pipe"):
+    """Reference ``dist.isend``: under XLA every collective is already
+    asynchronous until its result is consumed (the latency-hiding
+    scheduler overlaps it with compute), so isend == send; there is no
+    handle to wait on."""
+    return send(tensor, src, dst, axis)
+
+
+_MB_ROUNDS: dict = {}
+
+
+def monitored_barrier(name: str = "monitored_barrier",
+                      timeout_s: float = 300.0) -> None:
+    """Reference ``dist.monitored_barrier``: a barrier that reports which
+    host failed to arrive instead of hanging silently.  Host-side: each
+    process stamps in via the jax distributed KV store when available;
+    single-host it is a plain barrier.  A per-process round counter keys
+    every call uniquely, so repeated barriers under the same name neither
+    collide on the KV store nor get satisfied by stale stamps."""
+    import time as _time
+
+    if jax.process_count() <= 1:
+        return
+    client = getattr(jax._src.distributed.global_state, "client", None)
+    if client is None:
+        barrier(name)
+        return
+    rnd = _MB_ROUNDS.get(name, 0)
+    _MB_ROUNDS[name] = rnd + 1
+    me = jax.process_index()
+    client.key_value_set(f"dstpu_mb/{name}/{rnd}/{me}", str(_time.time()))
+    deadline = _time.time() + timeout_s
+    missing = []
+    for p in range(jax.process_count()):
+        remaining_ms = max(1, int((deadline - _time.time()) * 1000))
+        try:
+            client.blocking_key_value_get(f"dstpu_mb/{name}/{rnd}/{p}",
+                                          remaining_ms)
+        except Exception:
+            missing.append(p)
+    if missing:
+        raise TimeoutError(
+            f"monitored_barrier '{name}' round {rnd}: processes {missing} "
+            f"did not arrive within {timeout_s}s")
+
+
 def axis_index(axis: AxisName):
     return lax.axis_index(axis)
 

@@ -128,3 +128,30 @@ def test_object_collectives_single_process():
     assert comm.broadcast_object_list(objs) == objs
     assert comm.broadcast_object_list(objs) is not objs  # copy, like torch
     assert comm.all_gather_object({"rank": 0}) == [{"rank": 0}]
+
+
+def test_p2p_send_recv_edge(devices8):
+    """send/recv SPMD pair: src rank's value lands on dst, zeros elsewhere."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+
+    topo = initialize_topology(MeshConfig(pipe=8, data=1), devices8)
+
+    def body(x):
+        return comm.send(x, src=2, dst=5, axis="pipe")
+
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1) + 1.0  # rank r holds r+1
+    fn = jax.shard_map(body, mesh=topo.mesh, in_specs=P("pipe", None),
+                       out_specs=P("pipe", None), check_vma=False)
+    out = np.asarray(fn(x)).ravel()
+    assert out[5] == 3.0, out  # src rank 2 held value 3.0
+    assert out[2] == 0.0 and out[0] == 0.0
+
+
+def test_monitored_barrier_single_process():
+    from deepspeed_tpu.comm import comm
+
+    comm.monitored_barrier("t")  # no-op single host
+    comm.monitored_barrier("t")  # reentrant under the same name

@@ -17,16 +17,21 @@ def test_csv_monitor_writes_events(tmp_path):
     assert any("1.5" in c for r in rows for c in r)
 
 
-def test_monitor_master_gating(tmp_path):
+def test_monitor_master_gating(tmp_path, monkeypatch):
+    import sys
+
+    # force comet_ml absent regardless of the environment so the failing-
+    # writer path is deterministic (and no network/artifacts if installed)
+    monkeypatch.setitem(sys.modules, "comet_ml", None)
     cfg = DeepSpeedConfig({
         "train_micro_batch_size_per_gpu": 1,
         "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
                         "job_name": "j"},
-        # comet_ml is not installed: must warn and continue, not raise
         "comet": {"enabled": True, "project": "p"},
     })
     master = MonitorMaster(cfg)
-    assert master.enabled  # csv made it in even though comet failed
+    # csv made it in; the comet writer failed its import and was skipped
+    assert len(master.monitors) == 1
     master.write_events([("a", 1.0, 0)])
     assert list(tmp_path.rglob("*.csv"))
 
