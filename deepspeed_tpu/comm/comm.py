@@ -305,6 +305,22 @@ def monitored_barrier(name: str = "monitored_barrier",
         return
     rnd = _MB_ROUNDS.get(name, 0)
     _MB_ROUNDS[name] = rnd + 1
+    # NOTE: like every barrier API, call counts must match across processes;
+    # elastic restarts reset every process together (job-level restart), so
+    # the counters stay aligned.
+    if hasattr(client, "wait_at_barrier"):
+        # preferred: the coordination service's own barrier — cleans up
+        # after itself and distinguishes timeout from transport errors
+        try:
+            client.wait_at_barrier(f"dstpu_mb/{name}/{rnd}",
+                                   int(timeout_s * 1000))
+            return
+        except Exception as e:
+            if "DEADLINE" in str(e).upper() or "timeout" in str(e).lower():
+                raise TimeoutError(
+                    f"monitored_barrier '{name}' round {rnd}: a process did "
+                    f"not arrive within {timeout_s}s") from e
+            raise  # transport/coordination failure: not a peer's fault
     me = jax.process_index()
     client.key_value_set(f"dstpu_mb/{name}/{rnd}/{me}", str(_time.time()))
     deadline = _time.time() + timeout_s
@@ -314,8 +330,17 @@ def monitored_barrier(name: str = "monitored_barrier",
         try:
             client.blocking_key_value_get(f"dstpu_mb/{name}/{rnd}/{p}",
                                           remaining_ms)
+        except Exception as e:
+            # only treat timeouts as non-arrival; propagate real failures
+            if "DEADLINE" in str(e).upper() or "timeout" in str(e).lower():
+                missing.append(p)
+            else:
+                raise
+    if not missing and hasattr(client, "key_value_delete"):
+        try:  # bound coordinator memory: retire this round's stamps
+            client.key_value_delete(f"dstpu_mb/{name}/{rnd}/{me}")
         except Exception:
-            missing.append(p)
+            pass
     if missing:
         raise TimeoutError(
             f"monitored_barrier '{name}' round {rnd}: processes {missing} "

@@ -72,6 +72,32 @@ def _selective_gather(engine) -> None:
                 "replicate under the sharding plan's persistence threshold")
 
 
+
+def _pin_tree_to_host(engine, tree, what: str):
+    """device_put every array leaf of ``tree`` into pinned host memory
+    (scalars stay committed on device — annotating their placement trips
+    the SPMD partitioner).  Returns the re-placed tree, or None with a
+    warning where the backend lacks host memory spaces."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    scalar_sh = NamedSharding(engine.topology.mesh, PartitionSpec())
+
+    def to_host(x):
+        if not hasattr(x, "sharding") or getattr(x, "ndim", 0) < 1:
+            return jax.device_put(x, scalar_sh) if hasattr(x, "sharding") else x
+        try:
+            return jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
+        except Exception as e:
+            raise NotImplementedError(
+                f"host memory spaces unavailable on this backend: {e}") from e
+
+    try:
+        return jax.tree_util.tree_map(to_host, tree)
+    except NotImplementedError as e:
+        logger.warning(f"{what} unavailable: {e}")
+        return None
+
+
 @_register("offload_adam_states")
 def _offload_adam_states(engine) -> None:
     """Pin optimizer moments in host memory; XLA streams them through the
@@ -81,29 +107,8 @@ def _offload_adam_states(engine) -> None:
         logger.warning("offload_adam_states: no device optimizer state "
                        "(host offload already active?); skipping")
         return
-
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    scalar_sh = NamedSharding(engine.topology.mesh, PartitionSpec())
-
-    def to_host(x):
-        # scalars (e.g. the Adam step count) stay on device: annotating a
-        # scalar's placement trips the SPMD partitioner, and there is no
-        # memory to save.  Commit them to the mesh (replicated) so every
-        # argument of the re-jitted step has a consistent placement.
-        if not hasattr(x, "sharding") or getattr(x, "ndim", 0) < 1:
-            return jax.device_put(x, scalar_sh) if hasattr(x, "sharding") else x
-        try:
-            host = x.sharding.with_memory_kind("pinned_host")
-            return jax.device_put(x, host)
-        except Exception as e:  # backend without host memory spaces
-            raise NotImplementedError(
-                f"host memory spaces unavailable on this backend: {e}") from e
-
-    try:
-        new_opt = jax.tree_util.tree_map(to_host, state.opt_state)
-    except NotImplementedError as e:
-        logger.warning(f"offload_adam_states unavailable: {e}")
+    new_opt = _pin_tree_to_host(engine, state.opt_state, "offload_adam_states")
+    if new_opt is None:
         return
     import dataclasses as _dc
 
@@ -114,6 +119,26 @@ def _offload_adam_states(engine) -> None:
     engine._compile_steps(opt_state_memory_kind="pinned_host")
     logger.info("compile pass offload_adam_states: optimizer state pinned "
                 "to host memory")
+
+
+@_register("offload_params")
+def _offload_params(engine) -> None:
+    """Pin the fp32 master params in host memory (ZeRO-Infinity
+    ``offload_param``, reference zero/partition_parameters NVMe/CPU param
+    path): XLA streams each step's param reads from pinned host memory, so
+    HBM holds only activations + transient gathers.  Config-gated via
+    zero_optimization.offload_param.device (engine __init__), also
+    available as an explicit compile pass."""
+    state = engine.state
+    new_params = _pin_tree_to_host(engine, state.params, "offload_params")
+    if new_params is None:
+        return
+    import dataclasses as _dc
+
+    engine.state = _dc.replace(state, params=new_params)
+    engine._compile_steps(param_memory_kind="pinned_host")
+    logger.info("compile pass offload_params: master params pinned to host "
+                "memory")
 
 
 @_register("offload_activation")

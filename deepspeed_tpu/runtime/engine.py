@@ -190,6 +190,20 @@ class DeepSpeedTPUEngine:
 
         self.state = self._init_state()
         self._compile_steps()
+        # ZeRO-Infinity param offload (reference offload_param config): the
+        # fp32 master lives in pinned host memory; the step streams it.
+        # The optimizer-offload path already keeps the master in host RAM
+        # (numpy) so the two are mutually exclusive by construction.
+        if config.zero_config.offload_param.enabled:
+            if self.offload_optimizer is not None:
+                logger.warning(
+                    "offload_param: the optimizer-offload path already keeps "
+                    "the fp32 master in host RAM (numpy) — the offload_param "
+                    "setting is subsumed and the pinned-host pass is skipped")
+            else:
+                from ..compile.backend import PASS_REGISTRY
+
+                PASS_REGISTRY["offload_params"](self)
         log_dist(f"DeepSpeedTPUEngine initialized: zero_stage={config.zero_config.stage} "
                  f"dtype={self.compute_dtype.__name__} mesh={self.topology.axis_sizes} "
                  f"micro_bs={config.train_micro_batch_size_per_gpu} "
@@ -317,12 +331,23 @@ class DeepSpeedTPUEngine:
         finally:
             mc.qwz = old
 
+    def _fetch_params(self, master_params):
+        """Host-offloaded masters (offload_param): stream them into device
+        memory for compute — mixed memory spaces cannot feed dot_general
+        directly (same contract as the opt-moment device_put)."""
+        dev = getattr(self, "_param_dev_shardings", None)
+        if dev is None:
+            return master_params
+        return jax.tree_util.tree_map(
+            lambda x, s: x if s == "keep" else jax.device_put(x, s),
+            master_params, dev)
+
     def _compute_params(self, master_params):
         """fp32 master -> compute-dtype copy, constrained to the live-param
         sharding (stage 3: still sharded; XLA all-gathers per-layer at use,
         in compute dtype — the fetch/release of the reference's
         PartitionedParameterCoordinator, for free)."""
-        p = cast_tree(master_params, self.compute_dtype)
+        p = cast_tree(self._fetch_params(master_params), self.compute_dtype)
         return self.zero_plan.constrain(p, "param")
 
     def _micro_grads(self, state: TrainState, batch, rng, compute_params=None):
@@ -430,6 +455,9 @@ class DeepSpeedTPUEngine:
             lambda g: (g.astype(jnp.float32) / denom),
             state.grad_acc if grads_src is None else grads_src)
         grads = self.zero_plan.constrain(grads, "master")
+        # host-offloaded master: stream to device BEFORE the overflow cond —
+        # branches returning different memory spaces break lowering
+        fetched_params = self._fetch_params(state.params)
 
         norm = global_grad_norm(grads)
         clip = self.config.gradient_clipping
@@ -449,11 +477,12 @@ class DeepSpeedTPUEngine:
         if self.fp16_enabled:
             overflow = check_overflow(grads)
             new_params, new_opt, skipped = jax.lax.cond(
-                overflow, skip_update, do_update, (state.params, state.opt_state, grads))
+                overflow, skip_update, do_update,
+                (fetched_params, state.opt_state, grads))
             new_scale = update_loss_scale(state.loss_scale, overflow, self.config.fp16)
         else:
             new_params, new_opt, skipped = do_update(
-                (state.params, state.opt_state, grads))
+                (fetched_params, state.opt_state, grads))
             new_scale = state.loss_scale
 
         # fused path: the acc buffer was never written, it is still zeros
@@ -502,13 +531,18 @@ class DeepSpeedTPUEngine:
         state, losses = jax.lax.scan(body, state, (batches, rngs))
         return state, jnp.mean(losses)
 
-    def _compile_steps(self, opt_state_memory_kind: Optional[str] = None) -> None:
-        # the offload mode is sticky: once offload_adam_states set it, later
-        # recompiles (e.g. a subsequent offload_activation pass) must keep
-        # the moments host-resident rather than silently reverting
+    def _compile_steps(self, opt_state_memory_kind: Optional[str] = None,
+                       param_memory_kind: Optional[str] = None) -> None:
+        # the offload mode is sticky: once offload_adam_states /
+        # offload_params set it, later recompiles (e.g. a subsequent
+        # offload_activation pass) must keep the state host-resident
+        # rather than silently reverting
         if opt_state_memory_kind is not None:
             self._opt_offload_kind = opt_state_memory_kind
+        if param_memory_kind is not None:
+            self._param_offload_kind = param_memory_kind
         opt_state_memory_kind = getattr(self, "_opt_offload_kind", None)
+        param_memory_kind = getattr(self, "_param_offload_kind", None)
         donate = dict(donate_argnums=(0,))
         self._micro_step = jax.jit(self._micro_step_body, **donate)
         self._eval_fn = None
@@ -518,48 +552,68 @@ class DeepSpeedTPUEngine:
             self._train_batch = jax.jit(self._micro_scan_body, **donate)
             self._apply_step = None
             return
-        if opt_state_memory_kind is not None:
-            # compile/backend.py offload_adam_states moved the moments to
-            # host memory.  "keep" marks leaves that never left device
-            # memory (scalars — annotating their placement trips the SPMD
-            # partitioner).  The step fetches moments to device
-            # (_apply_step_body); results return to host either via
+        if opt_state_memory_kind is not None or param_memory_kind is not None:
+            # Host-resident state (offload_adam_states pass / ZeRO-Infinity
+            # offload_param): the moments are fetched to device inside the
+            # step (_apply_step_body device_put); host-placed PARAM inputs
+            # stream in implicitly.  Results return to host either via
             # out_shardings (TPU: XLA streams them back inside the program)
-            # or via the eager _repin_opt_state fallback (host platforms,
-            # where memory-kind out_shardings are not lowerable).
-            self._opt_dev_shardings = jax.tree_util.tree_map(
-                lambda x: x.sharding.with_memory_kind("device")
-                if hasattr(x, "sharding") and getattr(x, "ndim", 0) >= 1
-                else "keep",
-                self.state.opt_state)
+            # or via the eager _repin_* fallback (host platforms, where
+            # memory-kind out_shardings are not lowerable).  "keep" marks
+            # scalar leaves that never left device memory (annotating their
+            # placement trips the SPMD partitioner).
+            if opt_state_memory_kind is not None:
+                self._opt_dev_shardings = jax.tree_util.tree_map(
+                    lambda x: x.sharding.with_memory_kind("device")
+                    if hasattr(x, "sharding") and getattr(x, "ndim", 0) >= 1
+                    else "keep",
+                    self.state.opt_state)
+            if param_memory_kind is not None:
+                self._param_dev_shardings = jax.tree_util.tree_map(
+                    lambda x: x.sharding.with_memory_kind("device")
+                    if hasattr(x, "sharding") and getattr(x, "ndim", 0) >= 1
+                    else "keep",
+                    self.state.params)
             if jax.default_backend() == "tpu":
                 state_sh = jax.tree_util.tree_map(
                     lambda x: x.sharding if hasattr(x, "sharding") else None,
                     self.state)
                 self._opt_host_shardings = None
+                self._param_host_shardings = None
                 self._apply_step = jax.jit(self._apply_step_body,
                                            out_shardings=state_sh, **donate)
                 self._train_batch = jax.jit(self._train_batch_body,
                                             out_shardings=(state_sh, None),
                                             **donate)
                 return
-            self._opt_host_shardings = jax.tree_util.tree_map(
-                lambda x: x.sharding if hasattr(x, "sharding") else "keep",
-                self.state.opt_state)
+            if opt_state_memory_kind is not None:
+                self._opt_host_shardings = jax.tree_util.tree_map(
+                    lambda x: x.sharding if hasattr(x, "sharding") else "keep",
+                    self.state.opt_state)
+            if param_memory_kind is not None:
+                self._param_host_shardings = jax.tree_util.tree_map(
+                    lambda x: x.sharding if hasattr(x, "sharding") else "keep",
+                    self.state.params)
         self._apply_step = jax.jit(self._apply_step_body, **donate)
         self._train_batch = jax.jit(self._train_batch_body, **donate)
 
     def _repin_opt_state(self) -> None:
-        """After a boundary step, spill the optimizer moments back to host
-        memory (offload_adam_states keeps them HBM-resident only inside the
-        step program)."""
-        if getattr(self, "_opt_host_shardings", None) is None:
-            return
-        self.state = dataclasses.replace(
-            self.state,
-            opt_state=jax.tree_util.tree_map(
-                lambda x, s: x if s == "keep" else jax.device_put(x, s),
-                self.state.opt_state, self._opt_host_shardings))
+        """After a boundary step, spill host-offloaded optimizer moments /
+        master params back to host memory (they are HBM-resident only
+        inside the step program; TPU returns them via out_shardings, host
+        platforms eagerly here)."""
+        if getattr(self, "_opt_host_shardings", None) is not None:
+            self.state = dataclasses.replace(
+                self.state,
+                opt_state=jax.tree_util.tree_map(
+                    lambda x, s: x if s == "keep" else jax.device_put(x, s),
+                    self.state.opt_state, self._opt_host_shardings))
+        if getattr(self, "_param_host_shardings", None) is not None:
+            self.state = dataclasses.replace(
+                self.state,
+                params=jax.tree_util.tree_map(
+                    lambda x, s: x if s == "keep" else jax.device_put(x, s),
+                    self.state.params, self._param_host_shardings))
 
     def compile(self, backend: str = "xla", passes=None):
         """Apply DeepCompile-style passes to the step programs (reference

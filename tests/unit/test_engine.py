@@ -145,6 +145,16 @@ def test_zero_stage3_persistence_threshold(devices8):
     _loss_decreases(engine, steps=5)
 
 
+def test_offload_param_config_reaches_pass(devices8):
+    """zero_optimization.offload_param.device=cpu routes through the
+    offload_params pass; on the CPU backend (no host memory spaces) it
+    must warn and keep training rather than crash — on TPU it pins the
+    fp32 master to pinned_host."""
+    engine = _make_engine({"zero_optimization": {
+        "stage": 3, "offload_param": {"device": "cpu"}}})
+    _loss_decreases(engine, steps=5)
+
+
 def test_zero_stage0_params_replicated(devices8):
     engine = _make_engine({"zero_optimization": {"stage": 0}})
     leaf = engine.state.params["layer_0"]["w"]
