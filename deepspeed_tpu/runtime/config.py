@@ -88,7 +88,21 @@ class ZenFlowConfig(ConfigModel):
 
 @dataclasses.dataclass
 class ZeroConfig(ConfigModel):
-    """zero_optimization block (reference zero/config.py)."""
+    """zero_optimization block (reference zero/config.py).
+
+    Accepted-but-delegated knobs: ``reduce_bucket_size`` /
+    ``allgather_bucket_size`` / ``overlap_comm`` / ``contiguous_gradients``
+    / ``round_robin_gradients`` / ``stage3_prefetch_bucket_size`` /
+    ``stage3_max_live_parameters`` / ``stage3_max_reuse_distance`` /
+    ``sub_group_size`` exist in the reference because its hook-driven
+    runtime hand-schedules buckets, overlap, and prefetch.  Here the
+    collectives are compiled into the step program and the XLA
+    latency-hiding scheduler owns those decisions — the keys are accepted
+    for config compatibility and carry no behavior.  Knobs that DO reach
+    mechanisms: ``stage``, ``offload_param`` / ``offload_optimizer``,
+    ``stage3_param_persistence_threshold``, ``zero_quantized_weights`` /
+    ``zero_quantized_gradients`` / ``zero_hpz_partition_size``,
+    ``mics_shard_size``, ``zenflow``."""
 
     stage: int = 0
     overlap_comm: bool = True
