@@ -23,6 +23,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -143,11 +144,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q, seq_q, seq_k):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, seq_q, seq_k, q_per_kv):
     """Grid (B*KVH, nk, q_per_kv) — group index fastest, so the dk/dv
     output block (indexed (bkv, jk), ignoring the group axis) is revisited
-    consecutively and accumulates each grouped q head's contribution in
-    VMEM (GQA: dk = sum over the group)."""
+    consecutively; each grouped q head's contribution accumulates in fp32
+    VMEM scratch (not the output dtype — bf16 accumulation would lose
+    precision across the group) and the cast happens once at the end."""
     k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
     v_blk = v_ref[0].astype(jnp.float32)
     bk, d = k_blk.shape
@@ -188,11 +191,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(gi == 0)
     def _():
-        dk_ref[0] = jnp.zeros_like(dk_ref[0])
-        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    dk_ref[0] += dk.astype(dk_ref.dtype)
-    dv_ref[0] += dv.astype(dv_ref.dtype)
+    dk_scr[...] += dk
+    dv_scr[...] += dv
+
+    @pl.when(gi == q_per_kv - 1)
+    def _():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
@@ -227,8 +235,13 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, seq_q=valid_q, seq_k=valid_k),
+                          block_q=bq, seq_q=valid_q, seq_k=valid_k,
+                          q_per_kv=g),
         grid=(bkv, pl.cdiv(seq_k, bk), g),
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
         in_specs=[
             pl.BlockSpec((1, seq_q, d), lambda b, j, gi: (b * g + gi, 0, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0)),
