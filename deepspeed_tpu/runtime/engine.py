@@ -694,6 +694,20 @@ class DeepSpeedTPUEngine:
             global_grad_norm=jnp.asarray(norm, jnp.float32))
 
     # ------------------------------------------------------------ public API
+    def _next_training_batch(self):
+        if getattr(self, "_train_iter", None) is None:
+            self._train_iter = iter(self.training_dataloader)
+        try:
+            return next(self._train_iter)
+        except StopIteration:
+            self._train_iter = iter(self.training_dataloader)
+            try:
+                return next(self._train_iter)
+            except StopIteration:
+                raise ValueError(
+                    "training dataloader is empty (fewer samples than one "
+                    "global batch with drop_last?)") from None
+
     def _next_rng(self):
         self._rng, out = jax.random.split(self._rng)
         return out
@@ -706,11 +720,16 @@ class DeepSpeedTPUEngine:
         ``data_iter`` to pull gas micro-batches.
         """
         if batch is None:
-            it = data_iter or self.training_dataloader
-            if it is None:
-                raise ValueError("train_batch needs a batch or a data iterator")
             gas = self.config.gradient_accumulation_steps or 1
-            micro = [next(it) for _ in range(gas)]
+            if data_iter is not None:
+                micro = [next(data_iter) for _ in range(gas)]
+            elif self.training_dataloader is not None:
+                # the dataloader is an iterable, not an iterator: keep one
+                # live iterator and wrap around at epoch end (reference
+                # RepeatingLoader, runtime/dataloader.py)
+                micro = [self._next_training_batch() for _ in range(gas)]
+            else:
+                raise ValueError("train_batch needs a batch or a data iterator")
             batch = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micro)
         if self.flops_profiler is not None:
             self.flops_profiler.start_profile_maybe(self.global_steps, batch)

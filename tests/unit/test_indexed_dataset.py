@@ -1,0 +1,88 @@
+"""Indexed dataset (reference data_sampling/indexed_dataset.py):
+byte-compatible Megatron .bin/.idx roundtrip."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, index_file_path,
+    make_dataset, merge_datasets)
+
+
+def _build(prefix, seqs, dtype=np.int32, docs_every=2):
+    b = MMapIndexedDatasetBuilder(str(prefix), dtype=dtype)
+    for i, s in enumerate(seqs):
+        b.add_item(s)
+        if (i + 1) % docs_every == 0:
+            b.end_document()
+    return b.finalize()
+
+
+def test_roundtrip_and_get(tmp_path):
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 30000, n).astype(np.int32) for n in (5, 17, 1, 64)]
+    _build(tmp_path / "corpus", seqs)
+    ds = MMapIndexedDataset(str(tmp_path / "corpus"))
+    assert len(ds) == 4
+    for want, got in zip(seqs, ds[0:4]):
+        np.testing.assert_array_equal(want, got)
+    np.testing.assert_array_equal(ds.get(3, offset=10, length=20),
+                                  seqs[3][10:30])
+    assert list(ds.doc_idx) == [0, 2, 4]
+
+
+def test_reference_format_header(tmp_path):
+    """The .idx header must be the exact Megatron layout (magic, version,
+    dtype code 4 for int32)."""
+    _build(tmp_path / "c", [np.arange(3, dtype=np.int32)])
+    raw = open(index_file_path(str(tmp_path / "c")), "rb").read()
+    assert raw[:9] == b"MMIDIDX\x00\x00"
+    assert raw[9:17] == (1).to_bytes(8, "little")  # version
+    assert raw[17] == 4  # int32 code, reference dtypes table
+
+
+def test_uint16_tokens_and_merge(tmp_path):
+    a = [np.asarray([1, 2, 3], np.uint16), np.asarray([9], np.uint16)]
+    b = [np.asarray([7, 7], np.uint16)]
+    _build(tmp_path / "a", a, dtype=np.uint16, docs_every=1)
+    _build(tmp_path / "b", b, dtype=np.uint16, docs_every=1)
+    merge_datasets([str(tmp_path / "a"), str(tmp_path / "b")],
+                   str(tmp_path / "m"))
+    m = make_dataset(str(tmp_path / "m"))
+    assert m.dtype == np.uint16
+    np.testing.assert_array_equal(m[0], a[0])
+    np.testing.assert_array_equal(m[2], b[0])
+    assert len(m.doc_idx) == 4  # 3 docs + leading 0
+
+
+def test_make_dataset_validation(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        make_dataset(str(tmp_path / "missing"))
+    with pytest.raises(ValueError):
+        make_dataset(str(tmp_path / "x"), impl="lazy")
+
+
+def test_merge_preserves_trailing_open_document(tmp_path):
+    b = MMapIndexedDatasetBuilder(str(tmp_path / "t"))
+    b.add_item(np.asarray([1, 2], np.int32))
+    b.end_document()
+    b.add_item(np.asarray([3], np.int32))  # trailing, no end_document
+    b.finalize()
+    merge_datasets([str(tmp_path / "t")], str(tmp_path / "tm"))
+    m = MMapIndexedDataset(str(tmp_path / "tm"))
+    assert len(m) == 2  # the trailing sequence survives
+    np.testing.assert_array_equal(m[1], [3])
+
+
+def test_merge_rejects_dtype_mismatch(tmp_path):
+    _build(tmp_path / "i32", [np.asarray([70000], np.int32)])
+    _build(tmp_path / "u16", [np.asarray([1], np.uint16)], dtype=np.uint16)
+    with pytest.raises(ValueError):
+        merge_datasets([str(tmp_path / "u16"), str(tmp_path / "i32")],
+                       str(tmp_path / "bad"))
+
+
+def test_empty_shard_reads_as_len_zero(tmp_path):
+    MMapIndexedDatasetBuilder(str(tmp_path / "e")).finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "e"))
+    assert len(ds) == 0
