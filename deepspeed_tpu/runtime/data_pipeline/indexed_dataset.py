@@ -58,6 +58,10 @@ class MMapIndexedDataset:
             if version != _VERSION:
                 raise ValueError(f"unsupported index version {version}")
             (code,) = struct.unpack("<B", f.read(1))
+            if code not in _CODE_TO_DTYPE:
+                raise ValueError(
+                    f"{index_file_path(path_prefix)}: unknown dtype code "
+                    f"{code} (corrupt index, or a foreign format?)")
             self.dtype = np.dtype(_CODE_TO_DTYPE[code])
             (n_seq,) = struct.unpack("<Q", f.read(8))
             (n_doc,) = struct.unpack("<Q", f.read(8))
@@ -106,7 +110,16 @@ class MMapIndexedDatasetBuilder:
         self.doc_idx: List[int] = [0]
 
     def add_item(self, tokens) -> None:
-        arr = np.asarray(tokens, dtype=self.dtype)
+        arr = np.asarray(tokens)
+        if arr.size and np.issubdtype(arr.dtype, np.integer) \
+                and arr.dtype != self.dtype:
+            info = np.iinfo(self.dtype)
+            lo, hi = int(arr.min()), int(arr.max())
+            if lo < info.min or hi > info.max:
+                raise ValueError(
+                    f"token ids [{lo}, {hi}] do not fit dtype "
+                    f"{self.dtype} — silent casting would wrap them")
+        arr = arr.astype(self.dtype, copy=False)
         self._bin.write(arr.tobytes(order="C"))
         self.sizes.append(arr.size)
 
@@ -115,27 +128,34 @@ class MMapIndexedDatasetBuilder:
 
     def finalize(self) -> str:
         self._bin.close()
-        pointers = np.zeros(len(self.sizes), np.int64)
-        if len(self.sizes) > 1:  # exclusive scan of byte sizes
-            np.cumsum(np.asarray(self.sizes[:-1], np.int64)
-                      * self.dtype.itemsize, out=pointers[1:])
-        with open(index_file_path(self.prefix), "wb") as f:
-            f.write(_MAGIC)
-            f.write(struct.pack("<Q", _VERSION))
-            f.write(struct.pack("<B", _DTYPE_TO_CODE[self.dtype]))
-            f.write(struct.pack("<Q", len(self.sizes)))
-            f.write(struct.pack("<Q", len(self.doc_idx)))
-            f.write(np.asarray(self.sizes, np.int32).tobytes(order="C"))
-            f.write(pointers.tobytes(order="C"))
-            f.write(np.asarray(self.doc_idx, np.int64).tobytes(order="C"))
+        _write_index(self.prefix, self.dtype, self.sizes, self.doc_idx)
         return self.prefix
 
 
+def _write_index(prefix: str, dtype: np.dtype, sizes: List[int],
+                 doc_idx: List[int]) -> None:
+    pointers = np.zeros(len(sizes), np.int64)
+    if len(sizes) > 1:  # exclusive scan of byte sizes
+        np.cumsum(np.asarray(sizes[:-1], np.int64) * dtype.itemsize,
+                  out=pointers[1:])
+    with open(index_file_path(prefix), "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", _VERSION))
+        f.write(struct.pack("<B", _DTYPE_TO_CODE[dtype]))
+        f.write(struct.pack("<Q", len(sizes)))
+        f.write(struct.pack("<Q", len(doc_idx)))
+        f.write(np.asarray(sizes, np.int32).tobytes(order="C"))
+        f.write(pointers.tobytes(order="C"))
+        f.write(np.asarray(doc_idx, np.int64).tobytes(order="C"))
+
+
 def merge_datasets(prefixes: List[str], out_prefix: str) -> str:
-    """Concatenate datasets (reference ``merge_files_``): bulk-copies each
+    """Concatenate datasets (reference ``merge_file_``): bulk-copies each
     ``.bin`` and rebases the index arrays — no per-sequence re-encode.
-    Document boundaries are preserved exactly, including sequences after a
-    shard's last ``end_document`` (they stay in the open trailing doc)."""
+    Doc-boundary semantics match the reference exactly (doc_idx rebased by
+    ``(offset + doc_idx)[1:]``): a shard's trailing OPEN document — items
+    after its last ``end_document`` — fuses into the next shard's first
+    document, so close documents before finalizing shards you merge."""
     import shutil
 
     datasets = [MMapIndexedDataset(p) for p in prefixes]
@@ -157,19 +177,7 @@ def merge_datasets(prefixes: List[str], out_prefix: str) -> str:
             doc_idx.extend(int(d) + seq_base for d in ds.doc_idx[1:])
             seq_base += len(ds)
 
-    pointers = np.zeros(len(sizes), np.int64)
-    if len(sizes) > 1:
-        np.cumsum(np.asarray(sizes[:-1], np.int64) * dtype.itemsize,
-                  out=pointers[1:])
-    with open(index_file_path(out_prefix), "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<Q", _VERSION))
-        f.write(struct.pack("<B", _DTYPE_TO_CODE[dtype]))
-        f.write(struct.pack("<Q", len(sizes)))
-        f.write(struct.pack("<Q", len(doc_idx)))
-        f.write(np.asarray(sizes, np.int32).tobytes(order="C"))
-        f.write(pointers.tobytes(order="C"))
-        f.write(np.asarray(doc_idx, np.int64).tobytes(order="C"))
+    _write_index(out_prefix, dtype, sizes, doc_idx)
     return out_prefix
 
 

@@ -695,18 +695,18 @@ class DeepSpeedTPUEngine:
 
     # ------------------------------------------------------------ public API
     def _next_training_batch(self):
-        if getattr(self, "_train_iter", None) is None:
-            self._train_iter = iter(self.training_dataloader)
+        from .dataloader import RepeatingLoader
+
+        # re-wrap when the loader object was swapped (deepspeed_io rebuild)
+        if getattr(self, "_train_iter_src", None) is not self.training_dataloader:
+            self._train_iter = RepeatingLoader(self.training_dataloader)
+            self._train_iter_src = self.training_dataloader
         try:
             return next(self._train_iter)
         except StopIteration:
-            self._train_iter = iter(self.training_dataloader)
-            try:
-                return next(self._train_iter)
-            except StopIteration:
-                raise ValueError(
-                    "training dataloader is empty (fewer samples than one "
-                    "global batch with drop_last?)") from None
+            raise ValueError(
+                "training dataloader is empty (fewer samples than one "
+                "global batch with drop_last?)") from None
 
     def _next_rng(self):
         self._rng, out = jax.random.split(self._rng)

@@ -90,3 +90,56 @@ def test_gqa_native_matches_repeated(causal):
     for a, r, name in zip(g_gqa, g_rep, ("dq", "dk", "dv")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=5e-4,
                                    rtol=1e-3, err_msg=name)
+
+
+@pytest.mark.slow
+def test_random_shape_sweep_forward():
+    """Randomized shapes: uneven seqs, GQA ratios, odd head dims, cross
+    attention (Sq != Sk), tiny blocks — forward parity vs XLA."""
+    rng = np.random.RandomState(11)
+    from deepspeed_tpu.models.transformer import _repeat_kv
+
+    for trial in range(8):
+        b = int(rng.randint(1, 3))
+        nh = int(rng.choice([1, 2, 4, 8]))
+        kvh = int(rng.choice([h for h in (1, 2, 4, 8) if nh % h == 0]))
+        d = int(rng.choice([8, 16, 32]))
+        sq = int(rng.randint(3, 97))
+        causal = bool(rng.randint(2))
+        sk = sq if causal else int(rng.randint(3, 97))
+        bq = int(rng.choice([16, 32, 64]))
+        bk = int(rng.choice([16, 32, 64]))
+        ks = jax.random.split(jax.random.PRNGKey(trial), 3)
+        q = jax.random.normal(ks[0], (b, sq, nh, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, sk, kvh, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, sk, kvh, d), jnp.float32)
+        ref = xla_attention(q, _repeat_kv(k, nh // kvh),
+                            _repeat_kv(v, nh // kvh), causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=bq, block_k=bk)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=5e-5, rtol=5e-4,
+            err_msg=f"trial {trial}: b={b} sq={sq} sk={sk} nh={nh} "
+                    f"kvh={kvh} d={d} causal={causal} bq={bq} bk={bk}")
+
+
+@pytest.mark.slow
+def test_random_shape_sweep_gradients():
+    """Two randomized gradient-parity draws (full pipeline incl. padding)."""
+    from deepspeed_tpu.models.transformer import _repeat_kv
+
+    for trial, (sq, nh, kvh, d, bq) in enumerate(
+            [(45, 4, 2, 16, 16), (70, 2, 1, 8, 32)]):
+        ks = jax.random.split(jax.random.PRNGKey(100 + trial), 3)
+        q = jax.random.normal(ks[0], (1, sq, nh, d), jnp.float32)
+        k = jax.random.normal(ks[1], (1, sq, kvh, d), jnp.float32)
+        v = jax.random.normal(ks[2], (1, sq, kvh, d), jnp.float32)
+        g_ref = jax.grad(lambda q, k, v: jnp.sum(xla_attention(
+            q, _repeat_kv(k, nh // kvh), _repeat_kv(v, nh // kvh), True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bq) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, r, nm in zip(g_fl, g_ref, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       atol=1e-3, rtol=2e-3,
+                                       err_msg=f"trial {trial} {nm}")
