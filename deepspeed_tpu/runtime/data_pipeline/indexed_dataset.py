@@ -111,8 +111,12 @@ class MMapIndexedDatasetBuilder:
 
     def add_item(self, tokens) -> None:
         arr = np.asarray(tokens)
-        if arr.size and np.issubdtype(arr.dtype, np.integer) \
-                and arr.dtype != self.dtype:
+        if arr.size and arr.dtype != self.dtype:
+            if not np.issubdtype(arr.dtype, np.integer):
+                # float/NaN token arrays would truncate or be undefined
+                raise ValueError(
+                    f"token array dtype {arr.dtype} is not integral; "
+                    "tokenize to ints before building")
             info = np.iinfo(self.dtype)
             lo, hi = int(arr.min()), int(arr.max())
             if lo < info.min or hi > info.max:

@@ -39,6 +39,7 @@ from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
                            ThroughputTimer)
 from .config import DeepSpeedConfig
+from .dataloader import RepeatingLoader
 from .lr_schedules import LRSchedulerShim, get_schedule
 from .module import ModelSpec, as_model_spec
 from .optimizers import build_optimizer
@@ -695,8 +696,6 @@ class DeepSpeedTPUEngine:
 
     # ------------------------------------------------------------ public API
     def _next_training_batch(self):
-        from .dataloader import RepeatingLoader
-
         # re-wrap when the loader object was swapped (deepspeed_io rebuild)
         if getattr(self, "_train_iter_src", None) is not self.training_dataloader:
             self._train_iter = RepeatingLoader(self.training_dataloader)

@@ -19,7 +19,7 @@ from ..utils.logging import logger
 from .precision import clip_by_global_norm, global_grad_norm
 
 __all__ = ["see_memory_usage", "clip_grad_norm_", "flatten_tree",
-           "unflatten_tree", "partition_uniform", "partition_balanced"]
+           "unflatten_tree", "partition_uniform", "partition_balanced", "set_random_seed"]
 
 
 def see_memory_usage(message: str, force: bool = False) -> None:
@@ -91,3 +91,19 @@ def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
         bounds.append(idx)
     bounds.append(n)
     return bounds
+
+
+def set_random_seed(seed: int):
+    """Seed every host RNG the framework touches (reference
+    ``runtime/utils.py set_random_seed``: random, numpy, torch).  Device
+    RNG in JAX is explicit (`jax.random.PRNGKey` threaded through the
+    engine), so this covers the HOST side — dataloader shuffling, samplers,
+    numpy-based augmentation — and returns a fresh PRNGKey for device use."""
+    import random as _random
+    import sys as _sys
+
+    _random.seed(seed)
+    np.random.seed(seed)
+    if "torch" in _sys.modules:  # torch datasets (CPU) are supported
+        _sys.modules["torch"].manual_seed(seed)
+    return jax.random.PRNGKey(seed)

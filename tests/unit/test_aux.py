@@ -221,3 +221,20 @@ def test_autotuner_memory_pruning(monkeypatch, devices8):
     with pytest.raises(RuntimeError, match="all autotuning trials failed"):
         tuner.tune()
     assert all(r.get("pruned") for r in tuner.results), tuner.results
+
+
+def test_set_random_seed():
+    """Reference runtime/utils.py set_random_seed: host RNGs seeded, device
+    key returned."""
+    import random
+
+    import numpy as np
+
+    from deepspeed_tpu.runtime.utils import set_random_seed
+
+    k1 = set_random_seed(1234)
+    a = (random.random(), np.random.rand())
+    k2 = set_random_seed(1234)
+    b = (random.random(), np.random.rand())
+    assert a == b
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
