@@ -90,11 +90,30 @@ class InferenceEngine:
         return logits[:, -1], cache
 
     def _decode_body(self, params, last_logits, cache, start_pos, rng, *,
-                     steps: int, temperature: float = 0.0):
+                     steps: int, temperature: float = 0.0, top_k: int = 0,
+                     top_p: float = 0.0):
         def sample(logits, rng):
-            if temperature > 0:
-                return jax.random.categorical(rng, logits / temperature, axis=-1)
-            return jnp.argmax(logits, axis=-1)
+            if temperature <= 0:
+                return jnp.argmax(logits, axis=-1)
+            logits = logits / temperature
+            # top-k / nucleus filtering (HF-generate parity): keep tokens at
+            # or above a per-row threshold VALUE — cheaper than a scatter of
+            # the sorted keep-mask, identical for distinct logits
+            if top_k and top_k > 0:
+                k = min(int(top_k), logits.shape[-1])  # HF clamps oversize k
+                kth = jnp.sort(logits, axis=-1)[..., -k]
+                logits = jnp.where(logits < kth[..., None], -jnp.inf, logits)
+            if top_p and 0.0 < top_p < 1.0:
+                sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # prefix of sorted order with exclusive-cumulative < top_p
+                # (always keeps the most likely token)
+                n_keep = jnp.sum(cum - probs < top_p, axis=-1)
+                thresh = jnp.take_along_axis(
+                    sorted_desc, (n_keep - 1)[..., None], axis=-1)[..., 0]
+                logits = jnp.where(logits < thresh[..., None], -jnp.inf, logits)
+            return jax.random.categorical(rng, logits, axis=-1)
 
         def body(carry, rng_t):
             logits, cache, pos = carry
@@ -111,8 +130,12 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ public API
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, seed: int = 0):
-        """input_ids: [B, T] prompt; returns [B, T + max_new_tokens]."""
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 seed: int = 0):
+        """input_ids: [B, T] prompt; returns [B, T + max_new_tokens].
+        ``temperature=0`` is greedy; ``top_k``/``top_p`` filter the sampled
+        distribution (reference generate() wraps HF generate, which exposes
+        the same knobs)."""
         ids = jnp.asarray(input_ids, jnp.int32)
         B, T = ids.shape
         max_len = min(self.config.max_seq_len, T + max_new_tokens)
@@ -121,8 +144,16 @@ class InferenceEngine:
             last_logits, cache = self._prefill(self.params, ids, cache)
             import functools
 
-            decode = jax.jit(functools.partial(
-                self._decode_body, steps=max_new_tokens, temperature=temperature))
+            key = (max_new_tokens, float(temperature), int(top_k),
+                   float(top_p))
+            cache_map = getattr(self, "_decode_jits", None)
+            if cache_map is None:
+                cache_map = self._decode_jits = {}
+            decode = cache_map.get(key)
+            if decode is None:
+                decode = cache_map[key] = jax.jit(functools.partial(
+                    self._decode_body, steps=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p))
             tokens, _ = decode(self.params, last_logits, cache,
                                jnp.asarray(T, jnp.int32), jax.random.PRNGKey(seed))
         return jnp.concatenate([ids, tokens], axis=1)

@@ -89,3 +89,25 @@ def test_quantized_weights_still_generate():
     eng.module_quantize()
     out_q = eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=4)
     assert out_q.shape == out_ref.shape
+
+
+def test_generate_top_k_top_p_restrict_support():
+    """top-k=1 must equal greedy; top-p near 0 likewise; plain temperature
+    sampling may differ (it has full support)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine, InferenceConfig
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=64, attn_impl="xla")
+    eng = InferenceEngine(model, InferenceConfig(dtype="fp32", max_seq_len=64))
+    prompt = np.random.RandomState(0).randint(0, 256, (1, 7))
+    greedy = np.asarray(eng.generate(prompt, max_new_tokens=6))
+    k1 = np.asarray(eng.generate(prompt, max_new_tokens=6, temperature=0.8,
+                                 top_k=1, seed=3))
+    np.testing.assert_array_equal(greedy, k1)
+    p0 = np.asarray(eng.generate(prompt, max_new_tokens=6, temperature=0.8,
+                                 top_p=1e-9, seed=5))
+    np.testing.assert_array_equal(greedy, p0)
+    # sampled path still runs and differs in general
+    t = np.asarray(eng.generate(prompt, max_new_tokens=6, temperature=5.0,
+                                seed=7))
+    assert t.shape == greedy.shape
