@@ -1,0 +1,40 @@
+"""Checkpoint conversion CLI (reference ``ds_to_universal.py`` /
+``zero_to_fp32.py`` scripts).
+
+  python -m deepspeed_tpu.checkpoint to-universal CKPT_DIR TAG OUT_DIR
+  python -m deepspeed_tpu.checkpoint zero-to-fp32 CKPT_DIR TAG OUT.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .partitioned import to_universal, zero_to_fp32
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("deepspeed_tpu.checkpoint")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p1 = sub.add_parser("to-universal",
+                        help="merge a partitioned checkpoint into per-"
+                             "parameter atom files loadable on ANY mesh")
+    p1.add_argument("ckpt_dir")
+    p1.add_argument("tag")
+    p1.add_argument("out_dir")
+    p2 = sub.add_parser("zero-to-fp32",
+                        help="export consolidated fp32 model params")
+    p2.add_argument("ckpt_dir")
+    p2.add_argument("tag")
+    p2.add_argument("output_file")
+    args = ap.parse_args(argv)
+    if args.cmd == "to-universal":
+        out = to_universal(args.ckpt_dir, args.tag, args.out_dir)
+    else:
+        out = zero_to_fp32(args.ckpt_dir, args.tag, args.output_file)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

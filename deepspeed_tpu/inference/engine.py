@@ -101,7 +101,7 @@ class InferenceEngine:
             # the sorted keep-mask, identical for distinct logits
             if top_k and top_k > 0:
                 k = min(int(top_k), logits.shape[-1])  # HF clamps oversize k
-                kth = jnp.sort(logits, axis=-1)[..., -k]
+                kth = jax.lax.top_k(logits, k)[0][..., -1]  # O(V log k)
                 logits = jnp.where(logits < kth[..., None], -jnp.inf, logits)
             if top_p and 0.0 < top_p < 1.0:
                 sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
@@ -130,8 +130,8 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ public API
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
-                 seed: int = 0):
+                 temperature: float = 0.0, seed: int = 0, *,
+                 top_k: int = 0, top_p: float = 0.0):
         """input_ids: [B, T] prompt; returns [B, T + max_new_tokens].
         ``temperature=0`` is greedy; ``top_k``/``top_p`` filter the sampled
         distribution (reference generate() wraps HF generate, which exposes
@@ -148,12 +148,20 @@ class InferenceEngine:
                    float(top_p))
             cache_map = getattr(self, "_decode_jits", None)
             if cache_map is None:
-                cache_map = self._decode_jits = {}
+                from collections import OrderedDict
+
+                cache_map = self._decode_jits = OrderedDict()
             decode = cache_map.get(key)
             if decode is None:
                 decode = cache_map[key] = jax.jit(functools.partial(
                     self._decode_body, steps=max_new_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p))
+                # bounded: a long-lived server varying knobs must not pin
+                # compiled programs (and their buffers) forever
+                while len(cache_map) > 8:
+                    cache_map.popitem(last=False)
+            else:
+                cache_map.move_to_end(key)
             tokens, _ = decode(self.params, last_logits, cache,
                                jnp.asarray(T, jnp.int32), jax.random.PRNGKey(seed))
         return jnp.concatenate([ids, tokens], axis=1)
