@@ -130,7 +130,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ public API
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, seed: int = 0, *,
+                 temperature: float = 0.0, *, seed: int = 0,
                  top_k: int = 0, top_p: float = 0.0):
         """input_ids: [B, T] prompt; returns [B, T + max_new_tokens].
         ``temperature=0`` is greedy; ``top_k``/``top_p`` filter the sampled
