@@ -220,8 +220,17 @@ def main() -> None:
     # but far better than the final CPU fallback.  OOM checks run FIRST at
     # every rung: a RESOURCE_EXHAUSTED whose message mentions the pallas
     # kernel is memory pressure, not a lowering failure.
-    for attn in (None, "xla"):
-        bs_ladder = ladder if attn is None else [min(b, 8) for b in ladder]
+    env_attn = os.environ.get("DSTPU_BENCH_ATTN")
+    phases = (None,) if env_attn else (None, "xla")
+    bs_pinned = bool(os.environ.get("DSTPU_BENCH_BS"))
+    for attn in phases:
+        if attn is None:
+            bs_ladder = ladder
+        elif bs_pinned:
+            bs_ladder = ladder  # honor an explicit bs pin in phase 2 too
+        else:
+            # xla attention needs more HBM than flash; dedup after capping
+            bs_ladder = list(dict.fromkeys(min(b, 8) for b in ladder))
         mosaic_failure = False
         for i, bs in enumerate(bs_ladder):
             try:

@@ -1,0 +1,124 @@
+"""Direct tests for LR schedules, timers, flops profiler, env report
+(reference tests/unit/runtime/test_lr_schedulers.py, unit/profiling,
+unit/monitor; ours were only covered indirectly through the engine)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.lr_schedules import (LRSchedulerShim, get_schedule)
+from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+
+# ---------------------------------------------------------------- schedules
+def test_warmup_lr_ramps_then_holds():
+    s = get_schedule("WarmupLR", {"warmup_min_lr": 0.0, "warmup_max_lr": 0.1,
+                                  "warmup_num_steps": 10}, base_lr=0.1)
+    assert float(s(0)) == pytest.approx(0.0, abs=1e-6)
+    # monotone non-decreasing ramp reaching max at warmup end, then flat
+    ramp = [float(s(t)) for t in range(11)]
+    assert all(a <= b + 1e-9 for a, b in zip(ramp, ramp[1:]))
+    assert float(s(10)) == pytest.approx(0.1, rel=1e-5)
+    assert float(s(1000)) == pytest.approx(0.1, rel=1e-5)
+
+
+def test_warmup_decay_hits_zero_at_total():
+    s = get_schedule("WarmupDecayLR",
+                     {"total_num_steps": 100, "warmup_max_lr": 0.1,
+                      "warmup_num_steps": 10}, base_lr=0.1)
+    assert float(s(10)) == pytest.approx(0.1, rel=1e-6)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+    mid = float(s(55))
+    assert 0.0 < mid < 0.1
+
+
+def test_warmup_cosine_shape():
+    s = get_schedule("WarmupCosineLR",
+                     {"total_num_steps": 100, "warmup_num_steps": 10,
+                      "cos_min_ratio": 0.1, "warmup_max_lr": 1.0},
+                     base_lr=1.0)
+    assert float(s(10)) == pytest.approx(1.0, rel=1e-4)
+    assert float(s(100)) == pytest.approx(0.1, rel=1e-2)
+    # monotone decreasing after warmup
+    vals = [float(s(t)) for t in range(10, 101, 10)]
+    assert all(a >= b - 1e-6 for a, b in zip(vals, vals[1:]))
+
+
+def test_one_cycle_peaks_mid_cycle():
+    s = get_schedule("OneCycle", {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1,
+                                  "cycle_first_step_size": 50}, base_lr=0.1)
+    assert float(s(0)) == pytest.approx(0.01, rel=1e-4)
+    assert float(s(50)) == pytest.approx(0.1, rel=1e-4)
+    assert float(s(100)) == pytest.approx(0.01, rel=2e-2)
+
+
+def test_lr_range_test_grows():
+    s = get_schedule("LRRangeTest", {"lr_range_test_min_lr": 0.001,
+                                     "lr_range_test_step_size": 10,
+                                     "lr_range_test_step_rate": 1.0},
+                     base_lr=0.001)
+    assert float(s(0)) == pytest.approx(0.001, rel=1e-4)
+    assert float(s(100)) > float(s(0))
+
+
+def test_scheduler_shim_api():
+    s = get_schedule("WarmupLR", {"warmup_max_lr": 0.1,
+                                  "warmup_num_steps": 4}, base_lr=0.1)
+    shim = LRSchedulerShim(s)
+    for _ in range(4):
+        shim.step()
+    assert shim.get_last_lr()[0] == pytest.approx(0.1, rel=1e-6)
+    sd = shim.state_dict()
+    shim2 = LRSchedulerShim(s)
+    shim2.load_state_dict(sd)
+    assert shim2.get_last_lr() == shim.get_last_lr()
+
+
+# ------------------------------------------------------------------- timers
+def test_wallclock_timer_elapsed():
+    from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+    timers = SynchronizedWallClockTimer()
+    t = timers("unit")
+    t.start()
+    time.sleep(0.02)
+    t.stop()
+    elapsed = timers("unit").elapsed(reset=False)
+    assert elapsed >= 0.01  # seconds
+
+
+def test_throughput_timer_window_rate():
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+
+    tt = ThroughputTimer(batch_size=4, steps_per_output=10**9)
+    for _ in range(3):
+        tt.start()
+        time.sleep(0.005)
+        tt.stop()
+    assert tt.global_step_count == 3
+    assert tt.total_elapsed >= 0.015
+
+
+# ----------------------------------------------------------- flops profiler
+def test_flops_profiler_reports_through_engine():
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1}})
+    for i in range(3):
+        engine.train_batch(random_batch(batch_size=4, seed=i, gas=1))
+    prof = engine.flops_profiler
+    assert prof is not None and prof.duration > 0
+    assert prof.get_total_params() > 0
+    assert prof.get_total_flops() > 0  # XLA cost analysis of the micro step
+
+
+# --------------------------------------------------------------- env report
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import main
+
+    assert main() == 0
