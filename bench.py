@@ -60,7 +60,7 @@ def _backend_usable() -> bool:
         # inside a ~10-minute budget even when the chip never comes back
         tries = max(1, int(os.environ.get("DSTPU_BENCH_PROBE_RETRIES", "1")) + 1)
     except ValueError:
-        tries = 3
+        tries = 2
     # Both failure modes are worth one retry cycle: a hang is a wedged
     # chip lease that can clear, and a fast non-zero exit is usually "chip
     # busy / claim failed" from another process about to release it.  (A

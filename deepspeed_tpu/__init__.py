@@ -152,6 +152,15 @@ def tp_model_init(model: Any, tp_size: int = 1, dtype: Any = None,
                           example_batch=example_batch)
 
 
+def default_inference_config():
+    """Default inference config as a dict (reference
+    ``default_inference_config``, __init__.py:295) — edit and pass back to
+    ``init_inference``."""
+    from .inference.engine import InferenceConfig
+
+    return InferenceConfig().to_dict()
+
+
 def add_config_arguments(parser):
     """Augment an argparse parser with the standard flags (reference
     ``add_config_arguments``, __init__.py:279)."""

@@ -111,3 +111,19 @@ def test_generate_top_k_top_p_restrict_support():
     t = np.asarray(eng.generate(prompt, max_new_tokens=6, temperature=5.0,
                                 seed=7))
     assert t.shape == greedy.shape
+
+
+def test_default_inference_config_roundtrip():
+    """default_inference_config (reference __init__.py:295): editable dict
+    accepted back by init_inference."""
+    import deepspeed_tpu
+
+    cfg = deepspeed_tpu.default_inference_config()
+    assert isinstance(cfg, dict) and not any(k.startswith("_") for k in cfg)
+    cfg["dtype"] = "fp32"
+    cfg["max_seq_len"] = 64
+    eng = deepspeed_tpu.init_inference(llama_model("tiny", max_seq_len=64,
+                                                   attn_impl="xla"),
+                                       config=cfg)
+    out = eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=2)
+    assert out.shape == (1, 6)
