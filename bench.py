@@ -18,13 +18,17 @@ import time
 
 import numpy as np
 
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
 # How long to give the configured (possibly tunneled-TPU) backend to come up
 # before falling back to CPU.  Backend init through the axon relay can be
 # slow; a hung tunnel must not zero out the benchmark (round-1 BENCH rc=1).
-try:
-    _PROBE_TIMEOUT_S = int(os.environ.get("DSTPU_BENCH_PROBE_TIMEOUT", "240"))
-except ValueError:
-    _PROBE_TIMEOUT_S = 240
+_PROBE_TIMEOUT_S = _int_env("DSTPU_BENCH_PROBE_TIMEOUT", 240)
 
 
 def _pin_cpu() -> None:
@@ -38,45 +42,60 @@ def _pin_cpu() -> None:
         pass
 
 
-def _backend_usable() -> bool:
+def _backend_usable() -> tuple:
     """Probe the configured backend in a subprocess with a hard timeout.
 
     jax backend init happens inside a C call that cannot be interrupted
     in-process, so a hung TPU plugin would hang the benchmark itself; the
     subprocess is the only safe way to find out.
+
+    Returns ``(ok, reason)``: ``reason`` is "" when the backend is usable,
+    else a short description of why the bench is falling back to CPU — it
+    is recorded inside the JSON artifact so a CPU run can never masquerade
+    as a chip number.
     """
     # Probe unless explicitly pinned to cpu: a site PJRT plugin can select a
     # TPU backend via jax.config even when JAX_PLATFORMS is unset, and the
     # subprocess (same sitecustomize) reproduces whatever main() would see.
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return True
+        return True, ""
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((128, 128), jnp.bfloat16); "
             "x = (x @ x); "
             "print(float(x.sum()), jax.default_backend())")
-    try:
-        # default 1 retry: worst case (dead tunnel) is ~2 probe timeouts +
-        # one 60s wait before the CPU fallback — keeps the whole bench
-        # inside a ~10-minute budget even when the chip never comes back
-        tries = max(1, int(os.environ.get("DSTPU_BENCH_PROBE_RETRIES", "1")) + 1)
-    except ValueError:
-        tries = 2
-    # Both failure modes are worth one retry cycle: a hang is a wedged
-    # chip lease that can clear, and a fast non-zero exit is usually "chip
-    # busy / claim failed" from another process about to release it.  (A
-    # machine with no TPU at all does not reach here: jax falls back to
+    # Retry budget is ADAPTIVE to the failure mode (VERDICT r3 weak #1):
+    #   - fast non-zero exit: usually "chip busy / claim failed" from a
+    #     process about to release it — cheap to retry, default 1 retry.
+    #   - probe TIMEOUT: a backend was trying to init (CPU init is
+    #     instant), i.e. a TPU is EXPECTED but its lease is wedged; wedges
+    #     observed in round 3 cleared on minutes timescale, so spend a
+    #     larger budget (default 3 retries, 90s apart) before giving up
+    #     the only hardware number of the round.
+    # (A machine with no TPU at all never reaches here: jax falls back to
     # cpu and the probe SUCCEEDS, reporting backend=cpu.)
+    fast_retries = max(0, _int_env("DSTPU_BENCH_PROBE_RETRIES", 1))
+    # An explicit base knob is a fast-fail contract: it caps the timeout
+    # budget too unless the TPU knob is ALSO explicit.
+    if "DSTPU_BENCH_PROBE_RETRIES_TPU" in os.environ:
+        timeout_retries = max(0, _int_env("DSTPU_BENCH_PROBE_RETRIES_TPU", 3))
+    elif "DSTPU_BENCH_PROBE_RETRIES" in os.environ:
+        timeout_retries = fast_retries
+    else:
+        timeout_retries = 3
     err = ""
-    for attempt in range(tries):
+    timeouts = 0
+    attempt = 0
+    while True:
         try:
             proc = subprocess.run([sys.executable, "-c", code],
                                   capture_output=True, text=True,
                                   timeout=_PROBE_TIMEOUT_S)
             if proc.returncode == 0:
-                return True
+                return True, ""
             err = proc.stderr[-2000:]
         except subprocess.TimeoutExpired:
-            err = "probe timed out"
+            timeouts += 1
+            err = f"probe timed out after {_PROBE_TIMEOUT_S}s"
         # permanent failures (no plugin/backend at all) never clear —
         # don't pay the retry sleeps for them
         permanent = any(s in err for s in
@@ -84,14 +103,21 @@ def _backend_usable() -> bool:
                          "ImportError", "not in the list of known backends"))
         if permanent:
             break
-        if attempt + 1 < tries:
-            print(f"bench: backend probe failed ({err[-200:]}); retrying in "
-                  f"60s ({attempt + 1}/{tries - 1} retries used)",
-                  file=sys.stderr)
-            time.sleep(60)
+        budget = timeout_retries if timeouts else fast_retries
+        if attempt >= budget:
+            break
+        wait = 90 if timeouts else 60
+        print(f"bench: backend probe failed ({err[-200:]}); retrying in "
+              f"{wait}s ({attempt + 1}/{budget} retries used)",
+              file=sys.stderr)
+        time.sleep(wait)
+        attempt += 1
+    reason = (f"TPU expected but unreachable: {err} "
+              f"({timeouts} timeouts, {attempt + 1} probes)"
+              if timeouts else f"backend probe failed: {err[-300:]}")
     print(f"bench: backend probe failed; falling back to cpu\n{err}",
           file=sys.stderr)
-    return False
+    return False, reason
 
 PEAK_BF16_FLOPS = {
     # per-chip peak bf16 FLOP/s
@@ -146,12 +172,19 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     if attn_impl:
         over["attn_impl"] = attn_impl
     model = llama_model(size, max_seq_len=seq, **over)
+    # stage/offload rungs are env-selectable (VERDICT r3 next #2): stage-3
+    # and the offload boundary must be measurable on the same model/chip,
+    # not hardcoded out of the artifact
+    stage = _int_env("DSTPU_BENCH_STAGE", 1)
+    zero_cfg = {"stage": stage}
+    if os.environ.get("DSTPU_BENCH_OFFLOAD") == "1":
+        zero_cfg["offload_optimizer"] = {"device": "cpu"}
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
+        "zero_optimization": zero_cfg,
         "gradient_clipping": 1.0,
         "data_types": {"grad_accum_dtype": acc},
     }
@@ -193,15 +226,31 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     tokens = steps * micro_bs * dp * seq
     tok_per_sec_chip = tokens / dt / n_chips
     model_flops = flops_per_token(model.config, seq) * tokens
-    mfu = model_flops / dt / (n_chips * _peak_for(jax.devices()[0]))
+    dev = jax.devices()[0]
+    mfu = model_flops / dt / (n_chips * _peak_for(dev))
 
-    return {
-        "metric": f"llama-{size} bf16 zero1 tokens/sec/chip "
+    tag = f"zero{stage}" + ("-offload" if "offload_optimizer" in zero_cfg else "")
+    result = {
+        "metric": f"llama-{size} bf16 {tag} tokens/sec/chip "
                   f"(seq={seq}, bs={micro_bs}, mfu={mfu:.3f})",
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.54, 3),
+        # provenance: a CPU fallback must be self-describing, never able to
+        # masquerade as a chip number (VERDICT r3 next #1)
+        "backend": jax.default_backend(),
+        "device_kind": str(getattr(dev, "device_kind", "unknown")),
+        "mfu": round(mfu, 4),
     }
+    if stage != 1 or "offload_optimizer" in zero_cfg:
+        # the 0.54 comparator was measured under the zero1-style dense
+        # regime; flag it so non-default rungs aren't read as regressions
+        result["comparator_note"] = "vs_baseline divides by the 0.54 zero1 comparator"
+    reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
+    if reason and jax.default_backend() == "cpu":
+        # gate on backend: a leaked env var must not mislabel a real TPU run
+        result["fallback_reason"] = reason
+    return result
 
 
 def main() -> None:
@@ -265,18 +314,24 @@ if __name__ == "__main__":
         _pin_cpu()
         main()
     else:
-        if not _backend_usable():
+        usable, reason = _backend_usable()
+        if not usable:
+            os.environ["DSTPU_BENCH_FALLBACK_REASON"] = reason
             _pin_cpu()
             main()
         else:
             try:
                 main()
-            except Exception:  # mid-run TPU failure: rerun on cpu
+            except Exception as e:  # mid-run TPU failure: rerun on cpu
                 import traceback
                 traceback.print_exc()
                 print("bench: run failed on configured backend; retrying on "
                       "cpu", file=sys.stderr)
-                env = dict(os.environ, JAX_PLATFORMS="cpu")
+                env = dict(
+                    os.environ, JAX_PLATFORMS="cpu",
+                    DSTPU_BENCH_FALLBACK_REASON=(
+                        f"mid-run failure on configured backend: "
+                        f"{type(e).__name__}: {str(e)[:300]}"))
                 ret = subprocess.run([sys.executable, __file__, "--cpu"],
                                      env=env)
                 sys.exit(ret.returncode)

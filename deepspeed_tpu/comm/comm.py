@@ -285,6 +285,9 @@ def isend(tensor, src: int, dst: int, axis: AxisName = "pipe"):
 
 
 _MB_ROUNDS: dict = {}
+# How many rounds of barrier stamps stay live in the coordination service
+# before entry-time retirement reclaims them; see monitored_barrier.
+_MB_RETIRE_LAG = 8
 
 
 def monitored_barrier(name: str = "monitored_barrier",
@@ -322,6 +325,23 @@ def monitored_barrier(name: str = "monitored_barrier",
                     f"not arrive within {timeout_s}s") from e
             raise  # transport/coordination failure: not a peer's fault
     me = jax.process_index()
+    # Deferred stamp retirement: deleting this round's stamp at exit (even
+    # success-only) races with a slower peer still inside its own deadline —
+    # it would block on the deleted key and misreport THIS process as the
+    # missing one.  Instead each process deletes its own stamp from round
+    # rnd-_MB_RETIRE_LAG at ENTRY.  On the success path this is race-free
+    # (completing round rnd-1 implies every peer finished reading older
+    # rounds' stamps); on timeout/retry paths a straggler more than
+    # _MB_RETIRE_LAG rounds behind the fastest retrier could still find
+    # punctual peers' stamps retired and misreport them — the lag trades
+    # that pathological window against coordinator memory, which stays
+    # bounded at <=_MB_RETIRE_LAG rounds per name regardless of
+    # timeout/retry loops.
+    if rnd >= _MB_RETIRE_LAG and hasattr(client, "key_value_delete"):
+        try:
+            client.key_value_delete(f"dstpu_mb/{name}/{rnd - _MB_RETIRE_LAG}/{me}")
+        except Exception:
+            pass
     client.key_value_set(f"dstpu_mb/{name}/{rnd}/{me}", str(_time.time()))
     deadline = _time.time() + timeout_s
     missing = []
@@ -336,11 +356,6 @@ def monitored_barrier(name: str = "monitored_barrier",
                 missing.append(p)
             else:
                 raise
-    if not missing and hasattr(client, "key_value_delete"):
-        try:  # bound coordinator memory: retire this round's stamps
-            client.key_value_delete(f"dstpu_mb/{name}/{rnd}/{me}")
-        except Exception:
-            pass
     if missing:
         raise TimeoutError(
             f"monitored_barrier '{name}' round {rnd}: processes {missing} "

@@ -187,6 +187,11 @@ class DeepSpeedTPUEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self._cached_loss = None
+        # True while the incremental API (forward/backward) has written the
+        # grad-accumulation buffer without reaching a step() boundary; lets
+        # train_batch reset a stale buffer exactly when needed instead of
+        # memsetting it every fused step.
+        self._acc_dirty = False
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
 
         self.state = self._init_state()
@@ -486,7 +491,13 @@ class DeepSpeedTPUEngine:
                 (fetched_params, state.opt_state, grads))
             new_scale = state.loss_scale
 
-        # fused path: the acc buffer was never written, it is still zeros
+        # Fused gas=1 path: the acc buffer was never written this step and is
+        # still zeros, so pass it through (free under donation).  Stale
+        # accumulation from an ABANDONED incremental micro-step is reset at
+        # the API boundary instead (train_batch checks _acc_dirty) — an
+        # unconditional zeros_like here would be a model-sized HBM memset on
+        # the hot path, since the donated output buffer must really be
+        # written for the next step to read.
         zero_acc = (state.grad_acc if grads_src is not None
                     else jax.tree_util.tree_map(jnp.zeros_like, state.grad_acc))
         return dataclasses.replace(
@@ -711,6 +722,13 @@ class DeepSpeedTPUEngine:
         self._rng, out = jax.random.split(self._rng)
         return out
 
+    @staticmethod
+    def _zero_like_tree(tree):
+        """Zeros preserving each leaf's existing sharding."""
+        return jax.tree_util.tree_map(
+            lambda x: jnp.zeros_like(x, device=getattr(x, "sharding", None)),
+            tree)
+
     def train_batch(self, batch=None, data_iter: Optional[Iterator] = None):
         """One full optimizer step (the native fused path).
 
@@ -733,6 +751,22 @@ class DeepSpeedTPUEngine:
         if self.flops_profiler is not None:
             self.flops_profiler.start_profile_maybe(self.global_steps, batch)
         self.tput_timer.start()
+        if self._acc_dirty:
+            # abandoned incremental micro-step(s): reset the stale
+            # accumulation so the fused path's still-zeros invariant holds
+            # (gas>1 scans accumulate ON TOP of this buffer, gas=1 passes it
+            # through untouched)
+            with self.topology.mesh:
+                self.state = dataclasses.replace(
+                    self.state,
+                    grad_acc=self._zero_like_tree(self.state.grad_acc),
+                    micro_step=jnp.asarray(0, jnp.int32))
+            # void the abandoned micro-steps in the host counter too, or
+            # is_gradient_accumulation_boundary() stays phase-shifted for
+            # any later incremental-API use
+            gas_ = self.config.gradient_accumulation_steps or 1
+            self.micro_steps -= self.micro_steps % gas_
+            self._acc_dirty = False
         with self.topology.mesh:
             self.state, loss = self._train_batch(self.state, batch, self._next_rng())
         self._repin_opt_state()
@@ -760,6 +794,7 @@ class DeepSpeedTPUEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         with self.topology.mesh:
             self.state, loss = self._micro_step(self.state, batch, self._next_rng())
+        self._acc_dirty = True
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._cached_loss = loss
         return loss
@@ -790,6 +825,7 @@ class DeepSpeedTPUEngine:
                 with self.topology.mesh:
                     self.state = self._apply_step(self.state)
                 self._repin_opt_state()
+            self._acc_dirty = False  # buffer consumed and re-zeroed
             self.global_steps += 1
             self.lr_scheduler.step()
             if self.config.wall_clock_breakdown:

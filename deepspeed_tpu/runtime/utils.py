@@ -100,10 +100,16 @@ def set_random_seed(seed: int):
     engine), so this covers the HOST side — dataloader shuffling, samplers,
     numpy-based augmentation — and returns a fresh PRNGKey for device use."""
     import random as _random
-    import sys as _sys
 
     _random.seed(seed)
     np.random.seed(seed)
-    if "torch" in _sys.modules:  # torch datasets (CPU) are supported
-        _sys.modules["torch"].manual_seed(seed)
+    try:  # torch datasets (CPU) are supported; seed even before first import
+        import torch as _torch
+
+        _torch.manual_seed(seed)
+    except Exception:
+        # absent torch (ImportError) and broken installs (OSError on a
+        # missing shared lib, RuntimeError) alike must not break jax-only
+        # seeding
+        pass
     return jax.random.PRNGKey(seed)

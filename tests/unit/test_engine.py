@@ -68,6 +68,47 @@ def test_forward_backward_step_compat():
     assert losses[12] < losses[0]
 
 
+def test_abandoned_microstep_then_train_batch():
+    """An incremental forward/backward without step() leaves a nonzero
+    grad-accumulation buffer; train_batch must reset it (advisor r3) so the
+    fused step matches a clean engine that never saw the abandoned step."""
+    engine = _make_engine()
+    control = _make_engine()
+    # abandoned micro-step: forward+backward, never step()
+    engine.backward(engine(random_batch(batch_size=16, seed=9, gas=0)))
+    acc = jax.tree_util.tree_leaves(engine.state.grad_acc)
+    assert any(float(jnp.abs(a).max()) > 0 for a in acc), "no stale acc to test"
+    batch = random_batch(batch_size=16, seed=1, gas=1)
+    l1 = float(engine.train_batch(batch))
+    l2 = float(control.train_batch(batch))
+    assert l1 == pytest.approx(l2)
+    for a, b in zip(jax.tree_util.tree_leaves(engine.state.params),
+                    jax.tree_util.tree_leaves(control.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # buffer was reset, not consumed
+    assert all(float(jnp.abs(a).max()) == 0
+               for a in jax.tree_util.tree_leaves(engine.state.grad_acc))
+
+
+def test_abandoned_microstep_gas2_boundary_realigned():
+    """With gas>1 the reset must also void the abandoned micro-steps in the
+    host counter, or the incremental API's accumulation boundary stays
+    phase-shifted forever after."""
+    engine = _make_engine({"gradient_accumulation_steps": 2})
+    engine.backward(engine(random_batch(batch_size=16, seed=9, gas=0)))
+    engine.train_batch(random_batch(batch_size=16, seed=1, gas=2))
+    steps_before = int(engine.state.step)
+    # resume the incremental loop: boundary must need TWO micro-steps again
+    engine.backward(engine(random_batch(batch_size=16, seed=2, gas=0)))
+    assert not engine.is_gradient_accumulation_boundary()
+    engine.step()  # not a boundary: must NOT apply
+    assert int(engine.state.step) == steps_before
+    engine.backward(engine(random_batch(batch_size=16, seed=3, gas=0)))
+    assert engine.is_gradient_accumulation_boundary()
+    engine.step()
+    assert int(engine.state.step) == steps_before + 1
+
+
 def test_gradient_clipping():
     engine = _make_engine({"gradient_clipping": 0.01})
     engine.train_batch(random_batch(batch_size=16, gas=1))
