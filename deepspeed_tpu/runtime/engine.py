@@ -433,8 +433,15 @@ class DeepSpeedTPUEngine:
             lambda g, s: jax.lax.with_sharding_constraint(
                 g, jax.sharding.NamedSharding(self.topology.mesh, s)),
             grads_c, chunk_specs)
+        # target = the accumulation buffer's sharding: data-sharded leaves
+        # come back as the SCATTERED partition (one all_to_all, no hop-2
+        # gather — reference all_to_all_quant_reduce returns the partition)
+        target_specs = jax.tree_util.tree_map_with_path(
+            lambda path, g: self.zero_plan.grad_spec(_path_str(path),
+                                                     g.shape[1:]), grads_c)
         grads = quantized_grad_reduce(grads_c, chunk_specs,
-                                      self.topology.mesh)
+                                      self.topology.mesh,
+                                      target_specs=target_specs)
         return grads, jnp.mean(losses)
 
     def _apply_step_body(self, state: TrainState, grads_src=None) -> TrainState:

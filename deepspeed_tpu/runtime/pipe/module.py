@@ -20,11 +20,23 @@ TPU-native design (one SPMD program, not per-stage processes):
 * Per-stage parameter placement: when the per-stage groups are structurally
   identical (the common repeated-block case), layer params are stacked on a
   leading [num_stages, ...] dim sharded over 'pipe' — each stage holds only
-  its own weights.  Heterogeneous groups fall back to replicated params
-  (compute is still pipelined; documented trade-off of the SPMD design).
+  its own weights.  Structurally HETEROGENEOUS groups (distinct
+  embed/middle/head stages — the reference always stage-locals these,
+  pipe/module.py:393) are flat-packed: each stage's leaves are raveled and
+  concatenated into one per-dtype vector, padded to the longest stage, and
+  stacked [num_stages, maxlen] sharded over 'pipe'.  Every device holds only
+  max-stage-size params; each ``lax.switch`` branch unflattens its own
+  stage's layout statically.
 * Tied layers (``TiedLayerSpec``): one shared param subtree, replicated
   over 'pipe'; ``shard_map``'s transpose psums the per-stage cotangents —
   the tied-weight gradient allreduce of the reference, for free.
+* Memory is bounded like the reference's 1F1B ``TrainSchedule``
+  (pipe/schedule.py:189): the scheduling scan's tick body is wrapped in
+  ``jax.checkpoint`` (``checkpoint_ticks``), so autodiff saves only the
+  O(ring-buffer) carry per tick and recomputes one tick's layer internals
+  at a time in the backward wave — live residuals do NOT scale with
+  ``num_microbatches`` (more micro-batches still means less bubble, not
+  more memory).
 
 Constraints of the SPMD formulation (differences from the reference):
   - stage-boundary activations must share one shape/dtype (the ring
@@ -124,11 +136,13 @@ class PipelineModule:
                  num_stages: Optional[int] = None,
                  num_microbatches: int = 4,
                  partition_method: str = "parameters",
-                 seed_layers: bool = False):
+                 seed_layers: bool = False,
+                 checkpoint_ticks: bool = True):
         self.layers = list(layers)
         self.user_loss_fn = loss_fn
         self.num_microbatches = num_microbatches
         self.partition_method = partition_method
+        self.checkpoint_ticks = checkpoint_ticks
         topo = get_topology()
         self.num_stages = num_stages or topo.pipe_parallel_size
         if topo.pipe_parallel_size not in (1, self.num_stages):
@@ -199,11 +213,78 @@ class PipelineModule:
                 for s in structs[1:])
             self._stackable = ok
             if not ok:
-                logger.warning(
+                logger.info(
                     "PipelineModule: per-stage layer groups are not "
-                    "structurally identical; parameters will be REPLICATED "
-                    "across pipeline stages (compute still pipelined)")
+                    "structurally identical; flat-packing each stage's "
+                    "params into pipe-sharded per-dtype vectors")
         return self._stackable
+
+    # -- heterogeneous stage-local placement (flat-pack) ---------------------
+    @functools.cached_property
+    def _flat_meta(self):
+        """Static per-stage layout for the flat-packed representation:
+        for each stage, the non-tied group treedef plus, per dtype, the
+        (offset, shape) of every leaf inside that dtype's packed vector."""
+        rng = jax.random.PRNGKey(0)
+        metas = []
+        maxlen: dict = {}
+        for group in self.groups:
+            struct = self._group_tree_struct(group, rng)
+            leaves, treedef = jax.tree_util.tree_flatten(struct)
+            offsets = {}
+            layout = []
+            for leaf in leaves:
+                dt = str(jnp.dtype(leaf.dtype))
+                off = offsets.get(dt, 0)
+                size = int(np.prod(leaf.shape)) if leaf.shape else 1
+                layout.append((dt, off, leaf.shape, jnp.dtype(leaf.dtype)))
+                offsets[dt] = off + size
+            metas.append({"treedef": treedef, "layout": layout})
+            for dt, ln in offsets.items():
+                maxlen[dt] = max(maxlen.get(dt, 0), ln)
+        return metas, maxlen
+
+    def _flat_pack(self, group_trees):
+        """[per-stage param tuples] -> {dtype: [num_stages, maxlen]}."""
+        metas, maxlen = self._flat_meta
+        stacked = {}
+        for dt, ln in maxlen.items():
+            rows = []
+            for g, tree in enumerate(group_trees):
+                leaves = jax.tree_util.tree_leaves(tree)
+                segs = [jnp.ravel(l) for l, (d, _, _, _) in
+                        zip(leaves, metas[g]["layout"]) if d == dt]
+                vec = (jnp.concatenate(segs) if segs
+                       else jnp.zeros((0,), jnp.dtype(dt)))
+                pad = ln - vec.shape[0]
+                if pad:
+                    vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+                rows.append(vec)
+            stacked[dt] = jnp.stack(rows)
+        return stacked
+
+    def _flat_unpack(self, g: int, flat_row):
+        """One stage's {dtype: [maxlen]} view -> that stage's param tuple.
+        All offsets/shapes are static, so this is free slicing under jit."""
+        metas, _ = self._flat_meta
+        meta = metas[g]
+        leaves = []
+        for dt, off, shape, dtype in meta["layout"]:
+            size = int(np.prod(shape)) if shape else 1
+            leaves.append(jax.lax.slice(flat_row[dt], (off,),
+                                        (off + size,)).reshape(shape))
+        return jax.tree_util.tree_unflatten(meta["treedef"], leaves)
+
+    def _stage_group_params(self, params, g: int, local: bool = False):
+        """Stage ``g``'s non-tied layer params from either representation.
+        ``local``: params are a shard_map per-device view (leading pipe dim
+        is 1, holding exactly this device's stage)."""
+        if self.stackable:
+            return jax.tree_util.tree_map(
+                lambda a: a[0 if local else g], params["stages"])
+        flat = params["stages_flat"]
+        row = {dt: v[0 if local else g] for dt, v in flat.items()}
+        return self._flat_unpack(g, row)
 
     def init_params(self, rng) -> Any:
         tied_inits = self._split_tied()
@@ -224,14 +305,13 @@ class PipelineModule:
         if self.stackable:
             stages = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *group_trees)
-        else:
-            stages = tuple(group_trees)
-        return {"stages": stages, "tied": tied}
+            return {"stages": stages, "tied": tied}
+        return {"stages_flat": self._flat_pack(group_trees), "tied": tied}
 
     def partition_rules(self) -> List[Tuple[str, P]]:
         if self.stackable:
             return [(r"^stages/", P(PIPE_AXIS))]
-        return []
+        return [(r"^stages_flat/", P(PIPE_AXIS))]
 
     # -- forward -------------------------------------------------------------
     def _apply_group(self, g: int, group_params, tied, x):
@@ -246,9 +326,8 @@ class PipelineModule:
     def _dense_loss(self, params, xs, ys):
         x = xs
         for g in range(self.num_stages):
-            gp = (jax.tree_util.tree_map(lambda a: a[g], params["stages"])
-                  if self.stackable else params["stages"][g])
-            x = self._apply_group(g, gp, params["tied"], x)
+            x = self._apply_group(g, self._stage_group_params(params, g),
+                                  params["tied"], x)
         return self.user_loss_fn(x, ys)
 
     def _ring_struct(self, params, xs_micro, local: bool = False):
@@ -257,10 +336,9 @@ class PipelineModule:
         are a shard_map view (stacked leading dim is 1, not num_stages)."""
         def run_to(g_end, x):
             for g in range(g_end + 1):
-                gp = (jax.tree_util.tree_map(
-                    lambda a: a[0 if local else g], params["stages"])
-                      if self.stackable else params["stages"][g])
-                x = self._apply_group(g, gp, params["tied"], x)
+                x = self._apply_group(
+                    g, self._stage_group_params(params, g, local=local),
+                    params["tied"], x)
             return x
 
         shapes = [jax.eval_shape(functools.partial(run_to, g), xs_micro)
@@ -286,10 +364,9 @@ class PipelineModule:
         ring_shape, ring_dtype = ring.shape, ring.dtype
 
         def local_group_params(g: int):
-            if self.stackable:
-                # the local pipe shard [1, ...] IS this stage's group
-                return jax.tree_util.tree_map(lambda a: a[0], params["stages"])
-            return params["stages"][g]
+            # the local pipe shard [1, ...] IS this stage's group; branch g
+            # interprets it with stage g's (static) layout
+            return self._stage_group_params(params, g, local=True)
 
         # every switch branch returns one pytree: (ring buffer, last-stage
         # output).  Only the executed branch pays its group's compute: embed
@@ -328,8 +405,15 @@ class PipelineModule:
             return (buf, loss_acc + loss_t), None
 
         buf0 = jnp.zeros(ring_shape, ring_dtype)
+        # 1F1B-equivalent memory bound: remat the tick so the scan's
+        # backward saves only the O(ring) carry per tick and recomputes one
+        # tick's layer internals at a time — residuals don't scale with M
+        # (reference TrainSchedule, pipe/schedule.py:189).  prevent_cse is
+        # unnecessary inside scan and would only block fusion.
+        tick_fn = (jax.checkpoint(tick, prevent_cse=False)
+                   if self.checkpoint_ticks else tick)
         (_, loss), _ = jax.lax.scan(
-            tick, (buf0, jnp.asarray(0.0, jnp.float32)), jnp.arange(T))
+            tick_fn, (buf0, jnp.asarray(0.0, jnp.float32)), jnp.arange(T))
         loss = jax.lax.psum(loss, PIPE_AXIS) / M
         for ax in BATCH_AXES:
             loss = jax.lax.pmean(loss, ax)

