@@ -35,10 +35,16 @@ class AsyncIOHandle:
             raise OSError(f"aio: backend {backend!r} unavailable")
         self._bufs = {}  # op id -> buffer keep-alive
 
+    def close(self) -> None:
+        """Release the native engine (IO threads / uring) explicitly instead
+        of waiting for GC."""
+        if getattr(self, "_h", None):
+            self._lib.dstpu_aio_destroy(self._h)
+            self._h = None
+
     def __del__(self):
         try:
-            if getattr(self, "_h", None):
-                self._lib.dstpu_aio_destroy(self._h)
+            self.close()
         except Exception:
             pass
 

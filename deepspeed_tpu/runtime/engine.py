@@ -566,9 +566,35 @@ class DeepSpeedTPUEngine:
         self._micro_step = jax.jit(self._micro_step_body, **donate)
         self._eval_fn = None
         if self.offload_optimizer is not None:
-            # the boundary update runs on host (C++ SIMD Adam); the device
-            # program is micro-steps only
-            self._train_batch = jax.jit(self._micro_scan_body, **donate)
+            # The boundary update runs on host (C++ SIMD Adam); the device
+            # program is micro-steps only.  Opt-in on TPU: pin the
+            # grad-accumulation OUTPUTS to pinned host memory so XLA streams
+            # grads D2H inside the program, overlapped with the backward
+            # wave (reference overlaps grad copies with backward via swap
+            # streams, zero/stage3.py).  OPT-IN because the grad_acc is the
+            # micro-step scan's carry: XLA's memory-space propagation could
+            # instead host-place the buffer for the whole scan and turn
+            # every accumulate into a host round-trip — until measured on a
+            # real chip (gas>1), the default stays the post-program D2H with
+            # parallel copy_to_host_async.  The input zeros stay
+            # device-resident (_apply_step_offload re-zeros with memory
+            # kind "device").
+            import os as _os
+
+            if (jax.default_backend() == "tpu"
+                    and _os.environ.get("DSTPU_OFFLOAD_HOST_GRADS") == "1"):
+                state_sh = jax.tree_util.tree_map(
+                    lambda x: x.sharding if hasattr(x, "sharding") else None,
+                    self.state)
+                host_acc = jax.tree_util.tree_map(
+                    lambda s: s.with_memory_kind("pinned_host"),
+                    state_sh.grad_acc)
+                state_sh = dataclasses.replace(state_sh, grad_acc=host_acc)
+                self._train_batch = jax.jit(self._micro_scan_body,
+                                            out_shardings=(state_sh, None),
+                                            **donate)
+            else:
+                self._train_batch = jax.jit(self._micro_scan_body, **donate)
             self._apply_step = None
             return
         if opt_state_memory_kind is not None or param_memory_kind is not None:
@@ -700,13 +726,30 @@ class DeepSpeedTPUEngine:
         master, norm = self.offload_optimizer.apply_step(grads_flat, lr, gas)
 
         leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        # Bucketed batched device_put: transfers within a bucket are issued
+        # together (not leaf-serial) and async — the next forward's
+        # host-side work overlaps the push, the double-buffering the
+        # reference gets from its swap streams.  Bucketing (not one giant
+        # batch) bounds the transient host copy of converted compute-dtype
+        # params: offload hosts are RAM-budgeted for masters+moments, and a
+        # full extra model copy at the boundary could tip them over.
+        bucket_bytes = 64 << 20
         new_leaves = []
-        for m, old in zip(master, leaves):
-            arr = jnp.asarray(m.reshape(old.shape), old.dtype)
-            new_leaves.append(jax.device_put(arr, old.sharding))
+        i = 0
+        while i < len(leaves):
+            j, acc_bytes = i, 0
+            while j < len(leaves) and (j == i or acc_bytes < bucket_bytes):
+                acc_bytes += leaves[j].size * leaves[j].dtype.itemsize
+                j += 1
+            host_arrs = [np.asarray(master[k]).reshape(leaves[k].shape)
+                         .astype(leaves[k].dtype) for k in range(i, j)]
+            new_leaves.extend(jax.device_put(
+                host_arrs, [leaves[k].sharding for k in range(i, j)]))
+            i = j
         new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        zero_acc = jax.tree_util.tree_map(
-            lambda g: jnp.zeros_like(g), state.grad_acc)
+        # zeros go to DEVICE memory even when the grad outputs stream to
+        # pinned host (TPU): the next step's accumulation reads them there
+        zero_acc = self._zero_like_tree(state.grad_acc, force_device=True)
         self.state = _dc.replace(
             state, params=new_params, grad_acc=zero_acc,
             step=state.step + 1, micro_step=jnp.asarray(0, jnp.int32),
@@ -730,10 +773,22 @@ class DeepSpeedTPUEngine:
         return out
 
     @staticmethod
-    def _zero_like_tree(tree):
-        """Zeros preserving each leaf's existing sharding."""
+    def _zero_like_tree(tree, force_device: bool = False):
+        """Zeros preserving each leaf's sharding.  ``force_device``: place in
+        device memory even when the source buffer is pinned-host-resident
+        (grad buffers must be re-zeroed on device for the next step)."""
+
+        def sharding_of(x):
+            sh = getattr(x, "sharding", None)
+            if force_device and sh is not None:
+                try:
+                    return sh.with_memory_kind("device")
+                except Exception:
+                    return sh
+            return sh
+
         return jax.tree_util.tree_map(
-            lambda x: jnp.zeros_like(x, device=getattr(x, "sharding", None)),
+            lambda x: jnp.zeros(x.shape, x.dtype, device=sharding_of(x)),
             tree)
 
     def train_batch(self, batch=None, data_iter: Optional[Iterator] = None):

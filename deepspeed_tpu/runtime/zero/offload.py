@@ -53,7 +53,7 @@ class HostOffloadedOptimizer:
 
     def __init__(self, abstract_params: Any, optimizer_config: Dict[str, Any],
                  grad_clip: float = 0.0, nvme_path: Optional[str] = None,
-                 aio_threads: int = 4):
+                 aio_threads: int = 4, shared_handles: bool = True):
         params = dict(optimizer_config.get("params") or {})
         otype = str(optimizer_config.get("type", "adamw")).lower()
         wd = float(params.get("weight_decay", 0.0))
@@ -84,6 +84,7 @@ class HostOffloadedOptimizer:
         self.leaves, self.treedef = jax.tree_util.tree_flatten(abstract_params)
         self.master: List[np.ndarray] = []
         self.nvme_path = nvme_path
+        self._nvme = bool(nvme_path)
         self._aio = None
         #: spill-drain cadence: bounds host RAM to ~window live moment sets
         #: while keeping writes off the critical path
@@ -91,9 +92,12 @@ class HostOffloadedOptimizer:
         if nvme_path:
             import os
 
+            os.makedirs(nvme_path, exist_ok=True)
+        # shared_handles=False: a subclass brings its own per-worker handles
+        # (SuperOffload); don't spawn idle shared IO threads
+        if nvme_path and shared_handles:
             from ...ops.cpu.aio import AsyncIOHandle
 
-            os.makedirs(nvme_path, exist_ok=True)
             self._aio = AsyncIOHandle(thread_count=aio_threads)
             # ping-pong read handles: drain(one) waits only that handle's
             # in-flight prefetch, so fetch(i+1) rides through step(i)
@@ -126,9 +130,56 @@ class HostOffloadedOptimizer:
         self._flush_spills()
 
     def _fetch(self, key: int, n: int) -> None:
-        """Synchronous fetch (SuperOffload's locked worker path)."""
+        """Synchronous fetch on the shared ping-pong handles."""
         self._issue_fetch(key, n, 0)
         self._commit_fetch(0)
+
+    # shared submit/install/free primitives: ONE copy of the on-disk layout
+    # and guard logic, parameterized by handle, used by both the pipelined
+    # boundary path (shared ping-pong handles) and SuperOffload's workers
+    # (one private handle each — thread-safe because handles share no
+    # in-flight state and the moment dicts are only written per-key).
+    def _submit_fetch(self, aio, key: int, n: int):
+        entries = []
+        for name, d in self._moment_dicts():
+            buf = np.empty(n, np.float32)
+            aio.async_pread(buf, f"{self.nvme_path}/{name}_{key}.bin")
+            entries.append((d, buf))
+        return entries
+
+    @staticmethod
+    def _install_fetch(entries, key: int) -> None:
+        for d, buf in entries:
+            d[key] = buf
+
+    def _submit_spill(self, aio, key: int) -> bool:
+        dicts = self._moment_dicts()
+        # key absent or already spilled (None) -> nothing to write
+        if not dicts or any(d.get(key) is None for _, d in dicts):
+            return False
+        for name, d in dicts:
+            aio.async_pwrite(d[key], f"{self.nvme_path}/{name}_{key}.bin")
+        return True
+
+    def _free_moments(self, key: int) -> None:
+        for _, d in self._moment_dicts():
+            d[key] = None  # type: ignore[assignment]  (spilled)
+
+    def _fetch_with(self, aio, key: int, n: int) -> None:
+        """Synchronous fetch on a private handle (SuperOffload workers)."""
+        if not self._nvme or not self._needs_fetch(key):
+            return
+        entries = self._submit_fetch(aio, key, n)
+        aio.drain()
+        self._install_fetch(entries, key)
+
+    def _spill_with(self, aio, key: int) -> None:
+        """Spill leaf ``key``'s moments on a private handle and free them."""
+        if not self._nvme:
+            return
+        if self._submit_spill(aio, key):
+            aio.drain()
+            self._free_moments(key)
 
     # -- pipelined NVMe swap (reference PipelinedOptimizerSwapper,
     # runtime/swap_tensor/pipelined_optimizer_swapper.py:52) ----------------
@@ -143,12 +194,7 @@ class HostOffloadedOptimizer:
         without waiting (the prefetch of the pipelined swapper)."""
         if self._aio is None or not self._needs_fetch(key):
             return
-        entries = []
-        for name, d in self._moment_dicts():
-            buf = np.empty(n, np.float32)
-            self._fetch_aio[slot].async_pread(
-                buf, f"{self.nvme_path}/{name}_{key}.bin")
-            entries.append((d, buf))
+        entries = self._submit_fetch(self._fetch_aio[slot], key, n)
         self._inflight_fetch[slot].append((key, entries))
 
     def _commit_fetch(self, slot: int) -> None:
@@ -157,31 +203,22 @@ class HostOffloadedOptimizer:
             return
         self._fetch_aio[slot].drain()
         for key, entries in self._inflight_fetch[slot]:
-            for d, buf in entries:
-                d[key] = buf
+            self._install_fetch(entries, key)
         self._inflight_fetch[slot] = []
 
     def _issue_spill(self, key: int) -> None:
         if self._aio is None:
             return
-        dicts = self._moment_dicts()
-        if any(d.get(key) is None for _, d in dicts):
-            return
-        if not any(key in d for _, d in dicts):
-            return
-        for name, d in dicts:
-            self._aio.async_pwrite(d[key], f"{self.nvme_path}/{name}_{key}.bin")
-        self._spill_pending.append(key)
+        if self._submit_spill(self._aio, key):
+            self._spill_pending.append(key)
 
     def _flush_spills(self) -> None:
         """Wait for in-flight writes, then free the spilled moments."""
         if self._aio is None or not self._spill_pending:
             return
         self._aio.drain()
-        dicts = self._moment_dicts()
         for key in self._spill_pending:
-            for _, d in dicts:
-                d[key] = None  # type: ignore[assignment]  (spilled)
+            self._free_moments(key)
         self._spill_pending = []
 
     def apply_step(self, grads_flat: List[np.ndarray], lr: float,
