@@ -131,7 +131,14 @@ def init_distributed(dist_backend: str = "xla", **kwargs) -> None:
 
 def init_inference(model: Any = None, config: Any = None, **kwargs):
     """Create an inference engine (reference ``init_inference``,
-    __init__.py:302)."""
+    __init__.py:302).
+
+    ``model`` may also be a Hugging Face checkpoint DIRECTORY (reference
+    inference loads published checkpoints via its model implementations):
+    the config.json picks the family, weights are imported into the native
+    tree, and the engine serves them."""
+    import os as _os
+
     from .inference.engine import InferenceEngine, InferenceConfig
 
     cfg = config if isinstance(config, InferenceConfig) else InferenceConfig.from_dict(
@@ -139,7 +146,14 @@ def init_inference(model: Any = None, config: Any = None, **kwargs):
     for k, v in kwargs.items():
         if hasattr(cfg, k):
             setattr(cfg, k, v)
-    return InferenceEngine(model, cfg)
+    params = kwargs.get("params")
+    if isinstance(model, str) and _os.path.isdir(model):
+        from .checkpoint.hf_import import load_hf_model
+        from .models.llama import llama_model
+
+        mcfg, params = load_hf_model(model, dtype=cfg.jnp_dtype)
+        model = llama_model(config=mcfg)
+    return InferenceEngine(model, cfg, params=params)
 
 
 def tp_model_init(model: Any, tp_size: int = 1, dtype: Any = None,

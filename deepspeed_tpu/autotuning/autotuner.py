@@ -154,15 +154,10 @@ class Autotuner:
             logger.warning(f"autotuning trial {cand} failed: {e}")
             return None
 
-    def tune(self) -> Dict[str, Any]:
-        """Returns the best candidate and records all results (reference
-        Autotuner.tune, autotuner.py:404).
-
-        mode="model": after ``model_seed_trials`` seed runs, a cost model
-        fit on the observed throughputs proposes each next candidate
-        (reference ModelBasedTuner); grid/random run the pool in order.
-        Candidates whose analytical memory floor exceeds device HBM are
-        skipped without compiling (reference fast-mode estimators)."""
+    def _pruned_pool(self) -> List[Dict[str, Any]]:
+        """Candidates minus those whose analytical memory floor exceeds
+        device HBM (reference fast-mode estimators) — shared by the
+        sequential and parallel drivers."""
         pool = self._candidates()
         hbm = self._device_memory()
         if hbm:
@@ -177,6 +172,53 @@ class Autotuner:
                 else:
                     kept.append(cand)
             pool = kept
+        return pool
+
+    def tune_parallel(self, runner, nodes=None, slots_per_exp: int = 1,
+                      max_parallel: Optional[int] = None,
+                      early_stop_patience: Optional[int] = None) -> Dict[str, Any]:
+        """Dispatch grid/random candidates CONCURRENTLY over host slots
+        (reference ResourceManager + experiment scheduler,
+        autotuning/scheduler.py:32).  ``runner(exp, reservation)`` executes
+        one trial — use ``SubprocessTrialRunner`` for real out-of-process
+        experiments.  mode="model" proposes each candidate from the
+        previous results, which is inherently sequential — use tune()."""
+        from .scheduler import Node, ResourceManager
+
+        if self.mode == "model":
+            raise ValueError("model-based tuning is sequential; use tune()")
+        pool = self._pruned_pool()[:self.max_trials]
+        rm = ResourceManager(nodes or [Node("localhost", 1)], runner,
+                             slots_per_exp=slots_per_exp,
+                             max_parallel=max_parallel)
+        rm.schedule_experiments([
+            {"name": f"trial_{i}", "config": self._trial_config(c), "cand": c}
+            for i, c in enumerate(pool)])
+        finished = rm.run(early_stop_patience=early_stop_patience)
+        best, best_tput = None, -1.0
+        by_name = {f"trial_{i}": c for i, c in enumerate(pool)}
+        for rec in finished:
+            cand = by_name.get(rec["name"])
+            self.results.append({"config": cand, "throughput": rec["throughput"],
+                                 "host": rec.get("host"),
+                                 "error": rec.get("error")})
+            if rec["throughput"] is not None and rec["throughput"] > best_tput:
+                best, best_tput = cand, rec["throughput"]
+        if best is None:
+            raise RuntimeError("all autotuning trials failed")
+        return {"best": best, "throughput": best_tput,
+                "config": self._trial_config(best), "trials": self.results}
+
+    def tune(self) -> Dict[str, Any]:
+        """Returns the best candidate and records all results (reference
+        Autotuner.tune, autotuner.py:404).
+
+        mode="model": after ``model_seed_trials`` seed runs, a cost model
+        fit on the observed throughputs proposes each next candidate
+        (reference ModelBasedTuner); grid/random run the pool in order.
+        Candidates whose analytical memory floor exceeds device HBM are
+        skipped without compiling (reference fast-mode estimators)."""
+        pool = self._pruned_pool()
 
         best, best_tput = None, -1.0
         tried: List[Tuple[Dict[str, Any], float]] = []

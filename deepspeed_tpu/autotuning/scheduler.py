@@ -1,0 +1,226 @@
+"""Parallel experiment scheduler for autotuning.
+
+Reference parity: ``ResourceManager`` / experiment scheduling
+(``/root/reference/deepspeed/autotuning/scheduler.py:32``) — experiments are
+queued, device slots on hosts are reserved, trials run concurrently up to
+the resource limit, results land in per-experiment records, and stragglers
+are joined before the tuner picks a winner.
+
+TPU translation: an "experiment" is a ds_config candidate; a "node" is a
+host with N chip-slots (a v5e host exposes 4/8 chips).  The runner callable
+actually executes the trial — in production a subprocess per experiment
+(`SubprocessTrialRunner`, which passes the candidate config via a JSON file
+and reads one metrics JSON line back, the reference's user_script contract);
+in tests a mock.  Scheduling itself is pure threading: reserve -> run ->
+release under one condition variable, so max-parallelism and slot limits
+hold exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+@dataclasses.dataclass
+class Node:
+    """A host with ``slots`` schedulable chip-slots (reference Node,
+    scheduler.py:23)."""
+
+    host: str
+    slots: int
+
+    def __post_init__(self):
+        self.free = self.slots
+
+
+@dataclasses.dataclass
+class Reservation:
+    """Slots held on one node for a running experiment (reference
+    Reservation, scheduler.py:274)."""
+
+    node: Node
+    n_slots: int
+
+    def restore(self) -> None:
+        self.node.free += self.n_slots
+
+
+class ResourceManager:
+    """Queue experiments, run them concurrently within slot limits.
+
+    ``runner(exp, reservation) -> float | None``: execute one experiment on
+    the reserved slots and return its throughput metric (None = failed).
+    ``slots_per_exp``: chips each trial needs; an experiment never spans
+    nodes (the reference's GPU-per-node reservation).  ``max_parallel``
+    caps concurrently running experiments below the raw slot capacity.
+    """
+
+    def __init__(self, nodes: List[Node],
+                 runner: Callable[[Dict[str, Any], Reservation], Optional[float]],
+                 slots_per_exp: int = 1,
+                 max_parallel: Optional[int] = None):
+        if not nodes:
+            raise ValueError("ResourceManager needs at least one node")
+        if all(n.slots < slots_per_exp for n in nodes):
+            raise ValueError(
+                f"no node has {slots_per_exp} slots "
+                f"(max {max(n.slots for n in nodes)})")
+        self.nodes = nodes
+        self.runner = runner
+        self.slots_per_exp = slots_per_exp
+        self.max_parallel = max_parallel
+        self._cv = threading.Condition()
+        self._queue: List[Dict[str, Any]] = []
+        self._names = set()
+        self._running: Dict[int, threading.Thread] = {}
+        self.finished: List[Dict[str, Any]] = []
+        self._count = 0
+        self._stop = False
+
+    # -- reference schedule_experiments (scheduler.py:58) -------------------
+    def schedule_experiments(self, exps: List[Dict[str, Any]]) -> None:
+        with self._cv:
+            for exp in exps:
+                name = exp.get("name") or json.dumps(
+                    exp.get("config", exp), sort_keys=True)
+                if name in self._names:
+                    continue  # already scheduled (reference exp_paths dedup)
+                self._names.add(name)
+                exp = dict(exp)
+                exp["exp_id"] = self._count
+                exp["name"] = name
+                self._count += 1
+                self._queue.append(exp)
+            self._cv.notify_all()
+
+    def _reserve(self) -> Optional[Reservation]:
+        for node in self.nodes:
+            if node.free >= self.slots_per_exp:
+                node.free -= self.slots_per_exp
+                return Reservation(node, self.slots_per_exp)
+        return None
+
+    def _worker(self, exp: Dict[str, Any], res: Reservation) -> None:
+        t0 = time.time()
+        try:
+            tput = self.runner(exp, res)
+            err = None
+        except Exception as e:  # a crashed trial must not kill the scheduler
+            tput, err = None, f"{type(e).__name__}: {e}"
+        with self._cv:
+            res.restore()
+            self.finished.append({
+                "exp_id": exp["exp_id"], "name": exp["name"],
+                "config": exp.get("config"), "throughput": tput,
+                "error": err, "host": res.node.host,
+                "elapsed": time.time() - t0,
+            })
+            del self._running[exp["exp_id"]]
+            self._cv.notify_all()
+        if err:
+            logger.warning(f"autotuning exp {exp['name']} failed: {err}")
+
+    def run(self, early_stop_patience: Optional[int] = None,
+            metric_larger_is_better: bool = True) -> List[Dict[str, Any]]:
+        """Drain the queue.  ``early_stop_patience``: after this many
+        consecutive finished experiments without a new best metric, the
+        remaining queue is dropped (running ones still join) — the
+        reference's fast-mode early termination."""
+        best = None
+        since_best = 0
+        with self._cv:
+            while True:
+                # dispatch as much as capacity allows
+                while (self._queue and not self._stop
+                       and (self.max_parallel is None
+                            or len(self._running) < self.max_parallel)):
+                    res = self._reserve()
+                    if res is None:
+                        break
+                    exp = self._queue.pop(0)
+                    th = threading.Thread(target=self._worker,
+                                          args=(exp, res), daemon=True)
+                    self._running[exp["exp_id"]] = th
+                    th.start()
+                if not self._queue and not self._running:
+                    break
+                n_before = len(self.finished)
+                self._cv.wait(timeout=1.0)
+                for rec in self.finished[n_before:]:
+                    m = rec["throughput"]
+                    if m is None:
+                        since_best += 1
+                        continue
+                    better = (best is None
+                              or (m > best if metric_larger_is_better
+                                  else m < best))
+                    if better:
+                        best, since_best = m, 0
+                    else:
+                        since_best += 1
+                if (early_stop_patience is not None
+                        and since_best >= early_stop_patience
+                        and self._queue):
+                    logger.info(
+                        f"autotuning: early stop — no improvement in "
+                        f"{since_best} trials, dropping "
+                        f"{len(self._queue)} queued experiments")
+                    self._queue.clear()
+        return list(self.finished)
+
+    def parallel_peak(self) -> int:
+        """Max experiments that can run at once under current limits."""
+        cap = sum(n.slots // self.slots_per_exp for n in self.nodes)
+        return cap if self.max_parallel is None else min(cap, self.max_parallel)
+
+
+class SubprocessTrialRunner:
+    """Run one experiment as a subprocess of ``user_script`` (the reference
+    run_experiment contract, scheduler.py:410): the candidate config is
+    written to ``<results_dir>/<name>/exp.json``, the script is invoked with
+    ``--exp_config <path>`` plus ``user_args``, chip slots are passed via
+    env, and the LAST line of stdout that parses as JSON must carry
+    ``{"throughput": <float>}``.  stderr is saved next to the config."""
+
+    def __init__(self, user_script: str, user_args: Optional[List[str]] = None,
+                 results_dir: str = "autotuning_results",
+                 timeout_s: float = 600.0):
+        self.user_script = user_script
+        self.user_args = list(user_args or [])
+        self.results_dir = results_dir
+        self.timeout_s = timeout_s
+
+    def __call__(self, exp: Dict[str, Any], res: Reservation) -> Optional[float]:
+        exp_dir = os.path.join(self.results_dir, str(exp["name"]).replace("/", "_"))
+        os.makedirs(exp_dir, exist_ok=True)
+        cfg_path = os.path.join(exp_dir, "exp.json")
+        with open(cfg_path, "w") as f:
+            json.dump(exp.get("config", {}), f)
+        env = dict(os.environ)
+        env["DSTPU_TRIAL_SLOTS"] = str(res.n_slots)
+        env["DSTPU_TRIAL_HOST"] = res.node.host
+        proc = subprocess.run(
+            [sys.executable, self.user_script, "--exp_config", cfg_path,
+             *self.user_args],
+            capture_output=True, text=True, timeout=self.timeout_s, env=env)
+        with open(os.path.join(exp_dir, "stderr.log"), "w") as f:
+            f.write(proc.stderr)
+        if proc.returncode != 0:
+            return None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except (ValueError, TypeError):
+                continue
+            if isinstance(rec, dict) and "throughput" in rec:
+                return float(rec["throughput"])
+        return None

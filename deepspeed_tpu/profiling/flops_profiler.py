@@ -37,6 +37,123 @@ def cost_analysis_of(fn, *args) -> Dict[str, float]:
         return {}
 
 
+def per_module_breakdown(cfg, params, batch_size: int = 1,
+                         seq_len: Optional[int] = None,
+                         measure: bool = False) -> list:
+    """Per-module cost table for a transformer-family model (reference
+    per-module MACs/params/latency table,
+    ``profiling/flops_profiler/profiler.py`` — there via nn.Module hooks; on
+    TPU each component is lowered separately and XLA's cost analysis prices
+    it exactly).
+
+    Returns rows ``{module, params, flops, macs, bytes, pct}`` for embed,
+    each layer's attention and MLP, the final norm, and the LM head;
+    ``measure=True`` adds per-module wall latency from timing the jitted
+    component on the current backend."""
+    import jax.numpy as jnp
+
+    from ..models import transformer as T
+
+    seq = int(seq_len or cfg.max_seq_len)
+    cdtype = jax.tree_util.tree_leaves(params["embed"])[0].dtype
+    ids_s = jax.ShapeDtypeStruct((batch_size, seq), jnp.int32)
+    x_s = jax.ShapeDtypeStruct((batch_size, seq, cfg.hidden_size), cdtype)
+    positions = np.broadcast_to(np.arange(seq), (batch_size, seq))
+    attn_fn = T._pick_attn(cfg)
+
+    def embed_fn(p, ids):
+        x = p["embed"]["tok"][ids]
+        if cfg.position == "learned":
+            x = x + p["embed"]["pos"][:seq][None]
+        return x
+
+    def attn_part(layer, x):
+        q, k, v = T.attn_qkv(cfg, layer, x, positions)
+        if not getattr(attn_fn, "handles_gqa", False):
+            q_rep = cfg.n_heads // cfg.kv_heads
+            k, v = T._repeat_kv(k, q_rep), T._repeat_kv(v, q_rep)
+        attn = attn_fn(q, k, v, cfg.causal, None)
+        attn = attn.reshape(batch_size, seq, cfg.n_heads * cfg.head_dim)
+        out = attn @ layer["attn"]["wo"]
+        return out + (layer["attn"]["bo"] if cfg.use_bias else 0)
+
+    def mlp_part(layer, x):
+        return T.mlp_block(cfg, layer, x)[0]
+
+    def norm_fn(p, x):
+        return T._norm(x, p["final_norm"]["scale"],
+                       p["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+
+    def head_fn(p, x):
+        return T.logits_fn(cfg, p, x)
+
+    layer0 = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    layer_params = count_params(params["layers"]) // max(cfg.n_layers, 1)
+    attn_params = count_params(layer0["attn"])
+
+    # every layer is shape-identical (cost analysis ignores weight VALUES),
+    # so attn/mlp are lowered+compiled ONCE and their row is reused per
+    # layer — 5 compiles total instead of 2L+3, which matters when
+    # print_profile fires this inside a training step on a deep model
+    components = [
+        ("embed", embed_fn, (params, ids_s), count_params(params["embed"]),
+         None),
+        ("__attn", attn_part, (layer0, x_s), attn_params, None),
+        ("__mlp", mlp_part, (layer0, x_s), layer_params - attn_params, None),
+        ("final_norm", norm_fn, (params, x_s),
+         count_params(params["final_norm"]), None),
+        ("lm_head", head_fn, (params, x_s),
+         0 if cfg.tie_embeddings else count_params(params.get("lm_head", {})),
+         None),
+    ]
+
+    def cost_row(name, fn, args, n_params):
+        jf = jax.jit(fn)
+        costs = cost_analysis_of(jf, *args)
+        row = {"module": name, "params": int(n_params),
+               "flops": float(costs.get("flops", 0.0)),
+               "macs": float(costs.get("flops", 0.0)) / 2.0,
+               "bytes": float(costs.get("bytes accessed", 0.0))}
+        if measure:
+            concrete = [np.zeros(a.shape, a.dtype) if isinstance(
+                a, jax.ShapeDtypeStruct) else a for a in args]
+            out = jf(*concrete)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                out = jf(*concrete)
+            jax.block_until_ready(out)
+            row["latency_ms"] = (time.perf_counter() - t0) / 3 * 1e3
+        return row
+
+    base = {name: cost_row(name, fn, args, n)
+            for name, fn, args, n, _ in components}
+    rows = [base["embed"]]
+    for i in range(cfg.n_layers):
+        rows.append(dict(base["__attn"], module=f"layers.{i}.attn"))
+        rows.append(dict(base["__mlp"], module=f"layers.{i}.mlp"))
+    rows.append(base["final_norm"])
+    rows.append(base["lm_head"])
+    total = sum(r["flops"] for r in rows) or 1.0
+    for r in rows:
+        r["pct"] = 100.0 * r["flops"] / total
+    return rows
+
+
+def format_module_table(rows: list) -> str:
+    """Render the breakdown the way the reference prints its per-module
+    table: name, params, MACs, share of total."""
+    hdr = (f"{'module':<20} {'params':>12} {'MACs':>14} {'bytes':>12} "
+           f"{'%flops':>7}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        lines.append(
+            f"{r['module']:<20} {r['params']:>12,} {r['macs']:>14,.0f} "
+            f"{r['bytes']:>12,.0f} {r['pct']:>6.1f}%"
+            + (f" {r['latency_ms']:.2f}ms" if "latency_ms" in r else ""))
+    return "\n".join(lines)
+
+
 class FlopsProfiler:
     """Engine plugin (reference FlopsProfiler API: start/stop/print)."""
 
@@ -84,3 +201,22 @@ class FlopsProfiler:
             f"flops/micro-step={flops / 1e9:.2f}G "
             f"step_time={self.duration * 1e3:.1f}ms "
             f"achieved={tput / 1e12:.2f} TFLOPS")
+        if getattr(self.config, "module_depth", -1) != 0:
+            self.print_model_profile()
+
+    def print_model_profile(self) -> None:
+        """Per-module breakdown (reference print_model_profile) when the
+        engine's model exposes a TransformerConfig."""
+        cfg = getattr(self.engine.model, "config", None)
+        if cfg is None or not hasattr(cfg, "n_layers"):
+            return
+        try:
+            seq = None
+            if self._last_batch is not None:
+                leaf = jax.tree_util.tree_leaves(self._last_batch)[0]
+                seq = int(np.shape(leaf)[-1])
+            rows = per_module_breakdown(cfg, self.engine.state.params,
+                                        seq_len=seq)
+            logger.info("per-module profile:\n" + format_module_table(rows))
+        except Exception as e:
+            logger.warning(f"per-module profile failed: {e}")

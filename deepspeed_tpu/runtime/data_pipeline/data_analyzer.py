@@ -42,6 +42,19 @@ def metric_total_vocab_freq(vocab_freq: np.ndarray) -> MetricFn:
     return fn
 
 
+def metric_vocab_histogram(vocab_size: int) -> MetricFn:
+    """ACCUMULATE-type metric: per-sample token histogram, summed over the
+    corpus by map-reduce (reference vocab_rarity two-pass: accumulate the
+    corpus frequency first, then score samples against it)."""
+
+    def fn(sample: Any) -> np.ndarray:
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                         else sample).ravel()
+        return np.bincount(ids, minlength=vocab_size).astype(np.float64)
+
+    return fn
+
+
 class DataAnalyzer:
     """Run metrics over a dataset and persist curriculum index files
     (reference DataAnalyzer.run_map / run_reduce)."""
@@ -49,6 +62,7 @@ class DataAnalyzer:
     def __init__(self, dataset: Sequence[Any],
                  metric_names: Optional[List[str]] = None,
                  metric_functions: Optional[List[MetricFn]] = None,
+                 metric_types: Optional[List[str]] = None,
                  save_path: str = "./data_analysis",
                  num_workers: int = 1, worker_id: int = 0):
         self.dataset = dataset
@@ -56,6 +70,15 @@ class DataAnalyzer:
         self.metric_functions = metric_functions or [metric_seqlen]
         if len(self.metric_names) != len(self.metric_functions):
             raise ValueError("metric_names and metric_functions must pair up")
+        # reference metric types (data_analyzer.py:22): per-sample values
+        # feed the curriculum index; accumulate-type metrics sum an array
+        # over the whole corpus (e.g. vocab frequency) for a later pass
+        self.metric_types = (metric_types
+                             or ["single_value_per_sample"] * len(self.metric_names))
+        for t in self.metric_types:
+            if t not in ("single_value_per_sample",
+                         "accumulate_value_over_samples"):
+                raise ValueError(f"unknown metric_type {t}")
         self.save_path = save_path
         self.num_workers = max(1, num_workers)
         self.worker_id = worker_id
@@ -69,29 +92,50 @@ class DataAnalyzer:
         os.makedirs(self.save_path, exist_ok=True)
         idx = self._my_indices()
         out: Dict[str, np.ndarray] = {}
-        for name, fn in zip(self.metric_names, self.metric_functions):
-            vals = np.asarray([fn(self.dataset[int(i)]) for i in idx],
-                              np.float64)
-            np.save(self._shard_file(name, self.worker_id),
-                    np.stack([idx.astype(np.float64), vals]))
-            out[name] = vals
+        for name, fn, mtype in zip(self.metric_names, self.metric_functions,
+                                   self.metric_types):
+            if mtype == "accumulate_value_over_samples":
+                acc = None
+                for i in idx:
+                    v = np.asarray(fn(self.dataset[int(i)]), np.float64)
+                    acc = v if acc is None else acc + v
+                if acc is None:
+                    acc = np.zeros(0, np.float64)
+                np.save(self._shard_file(name, self.worker_id), acc)
+                out[name] = acc
+            else:
+                vals = np.asarray([fn(self.dataset[int(i)]) for i in idx],
+                                  np.float64)
+                np.save(self._shard_file(name, self.worker_id),
+                        np.stack([idx.astype(np.float64), vals]))
+                out[name] = vals
         logger.info(f"DataAnalyzer: worker {self.worker_id} mapped "
                     f"{idx.size} samples x {len(self.metric_names)} metrics")
         return out
 
     def run_reduce(self) -> Dict[str, Dict[str, np.ndarray]]:
         """Merge all worker shards; write index_to_metric /
-        index_to_sample_percentile_merged files (reference naming)."""
+        index_to_sample_percentile_merged files (reference naming).
+        Accumulate-type metrics reduce by summation instead."""
         result: Dict[str, Dict[str, np.ndarray]] = {}
-        for name in self.metric_names:
-            pairs = []
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            shards = []
             for w in range(self.num_workers):
                 f = self._shard_file(name, w)
                 if not os.path.exists(f):
                     raise FileNotFoundError(
                         f"missing shard {f}: did worker {w} run run_map()?")
-                pairs.append(np.load(f))
-            merged = np.concatenate(pairs, axis=1)
+                shards.append(np.load(f))
+            if mtype == "accumulate_value_over_samples":
+                width = max(s.size for s in shards)
+                total = np.zeros(width, np.float64)
+                for s in shards:
+                    total[:s.size] += s
+                result[name] = {"accumulated": total}
+                np.save(os.path.join(self.save_path,
+                                     f"{name}_accumulated.npy"), total)
+                continue
+            merged = np.concatenate(shards, axis=1)
             order = np.argsort(merged[0])
             sample_idx = merged[0][order].astype(np.int64)
             values = merged[1][order]
@@ -111,6 +155,27 @@ class DataAnalyzer:
 
     def _shard_file(self, metric: str, worker: int) -> str:
         return os.path.join(self.save_path, f"{metric}_worker{worker}.npy")
+
+    @classmethod
+    def run_map_reduce(cls, dataset: Sequence[Any], save_path: str,
+                       num_workers: int = 1,
+                       max_parallel: Optional[int] = None,
+                       **kw) -> Dict[str, Dict[str, np.ndarray]]:
+        """Concurrent map-reduce driver (reference run_map_reduce,
+        data_analyzer.py:22 — there over torch.distributed workers; here a
+        thread pool runs the per-worker maps concurrently, then one reduce
+        merges the shards).  Metric fns are numpy-bound, so threads give
+        real parallelism for IO-heavy corpora; each worker touches only its
+        own shard files."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = [cls(dataset, save_path=save_path, num_workers=num_workers,
+                       worker_id=w, **kw) for w in range(num_workers)]
+        with ThreadPoolExecutor(max_workers=max_parallel or num_workers) as pool:
+            futures = [pool.submit(w.run_map) for w in workers]
+            for f in futures:
+                f.result()
+        return workers[0].run_reduce()
 
 
 def load_difficulties(save_path: str, metric: str) -> np.ndarray:

@@ -742,7 +742,8 @@ class DeepSpeedTPUEngine:
                 acc_bytes += leaves[j].size * leaves[j].dtype.itemsize
                 j += 1
             host_arrs = [np.asarray(master[k]).reshape(leaves[k].shape)
-                         .astype(leaves[k].dtype) for k in range(i, j)]
+                         .astype(leaves[k].dtype, copy=False)
+                         for k in range(i, j)]
             new_leaves.extend(jax.device_put(
                 host_arrs, [leaves[k].sharding for k in range(i, j)]))
             i = j

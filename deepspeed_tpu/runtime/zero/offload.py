@@ -123,17 +123,6 @@ class HostOffloadedOptimizer:
                 out.append((attr.strip("_"), d))
         return out
 
-    def _spill(self, key: int) -> None:
-        """Synchronous spill (SuperOffload's locked worker path); the
-        pipelined apply_step uses _issue_spill/_flush_spills directly."""
-        self._issue_spill(key)
-        self._flush_spills()
-
-    def _fetch(self, key: int, n: int) -> None:
-        """Synchronous fetch on the shared ping-pong handles."""
-        self._issue_fetch(key, n, 0)
-        self._commit_fetch(0)
-
     # shared submit/install/free primitives: ONE copy of the on-disk layout
     # and guard logic, parameterized by handle, used by both the pipelined
     # boundary path (shared ping-pong handles) and SuperOffload's workers

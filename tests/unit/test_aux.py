@@ -238,3 +238,144 @@ def test_set_random_seed():
     b = (random.random(), np.random.rand())
     assert a == b
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+
+
+# -- parallel experiment scheduler (reference autotuning/scheduler.py:32) ---
+def _tracking_runner(delay=0.05, tputs=None):
+    """Mock runner that records concurrency and returns canned metrics."""
+    import threading as _th
+    import time as _t
+
+    lock = _th.Lock()
+    state = {"cur": 0, "peak": 0, "calls": []}
+
+    def runner(exp, res):
+        with lock:
+            state["cur"] += 1
+            state["peak"] = max(state["peak"], state["cur"])
+            state["calls"].append(exp["name"])
+        _t.sleep(delay)
+        with lock:
+            state["cur"] -= 1
+        if tputs is None:
+            return 100.0
+        v = tputs.get(exp["name"], None)
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+    return runner, state
+
+
+def test_scheduler_respects_slots_and_max_parallel():
+    """Concurrent trials over mock hosts: concurrency reaches the cap but
+    never exceeds min(slot capacity, max_parallel)."""
+    from deepspeed_tpu.autotuning.scheduler import Node, ResourceManager
+
+    runner, state = _tracking_runner()
+    rm = ResourceManager([Node("h0", 2), Node("h1", 2)], runner,
+                         slots_per_exp=1, max_parallel=3)
+    assert rm.parallel_peak() == 3
+    rm.schedule_experiments([{"name": f"e{i}", "config": {"i": i}}
+                             for i in range(10)])
+    finished = rm.run()
+    assert len(finished) == 10
+    assert state["peak"] <= 3, state
+    assert state["peak"] >= 2, f"never ran concurrently: {state}"
+    # all slots restored
+    assert all(n.free == n.slots for n in rm.nodes)
+
+
+def test_scheduler_multi_slot_experiments_fit_per_node():
+    """An experiment never spans nodes: 2-slot trials on 2-slot nodes run
+    one per node."""
+    from deepspeed_tpu.autotuning.scheduler import Node, ResourceManager
+
+    runner, state = _tracking_runner()
+    rm = ResourceManager([Node("h0", 2), Node("h1", 2)], runner,
+                         slots_per_exp=2)
+    rm.schedule_experiments([{"name": f"e{i}"} for i in range(6)])
+    rm.run()
+    assert state["peak"] <= 2
+    assert all(n.free == n.slots for n in rm.nodes)
+
+
+def test_scheduler_dedup_failures_and_early_stop():
+    from deepspeed_tpu.autotuning.scheduler import Node, ResourceManager
+
+    # dedup: the same experiment name scheduled twice runs once
+    runner, state = _tracking_runner(delay=0.0)
+    rm = ResourceManager([Node("h0", 1)], runner)
+    rm.schedule_experiments([{"name": "same"}, {"name": "same"}])
+    assert len(rm.run()) == 1
+
+    # failures recorded, scheduler survives
+    runner, _ = _tracking_runner(
+        delay=0.0, tputs={"ok": 5.0, "bad": RuntimeError("boom")})
+    rm = ResourceManager([Node("h0", 1)], runner)
+    rm.schedule_experiments([{"name": "bad"}, {"name": "ok"}])
+    recs = {r["name"]: r for r in rm.run()}
+    assert recs["bad"]["throughput"] is None and "boom" in recs["bad"]["error"]
+    assert recs["ok"]["throughput"] == 5.0
+
+    # early stop: monotonically worse results drop the queued tail
+    tputs = {f"e{i}": float(100 - i) for i in range(12)}
+    runner, _ = _tracking_runner(delay=0.0, tputs=tputs)
+    rm = ResourceManager([Node("h0", 1)], runner)
+    rm.schedule_experiments([{"name": f"e{i}"} for i in range(12)])
+    finished = rm.run(early_stop_patience=3)
+    assert len(finished) < 12, "early stop never dropped the queue"
+
+
+def test_autotuner_tune_parallel_picks_best(devices8):
+    """tune_parallel over mock hosts: grid candidates dispatched through
+    the ResourceManager; best survives; model mode refuses (sequential)."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.autotuning.scheduler import Node
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    def make(mode="grid"):
+        return Autotuner(
+            model_factory=simple_mlp_spec,
+            base_config={"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+            batch_factory=lambda bs: random_batch(batch_size=bs * 8, gas=1),
+            tuning_space={"zero_stage": [0, 1], "micro_batch": [1, 2, 4]},
+            mode=mode)
+
+    def runner(exp, res):
+        c = exp["cand"]
+        return 100.0 * c["micro_batch"] - 10.0 * c["zero_stage"]
+
+    out = make().tune_parallel(runner, nodes=[Node("h0", 2), Node("h1", 2)],
+                               max_parallel=4)
+    assert out["best"] == {"zero_stage": 0, "micro_batch": 4}
+    assert out["config"]["train_micro_batch_size_per_gpu"] == 4
+
+    with pytest.raises(ValueError, match="sequential"):
+        make("model").tune_parallel(runner)
+
+
+def test_subprocess_trial_runner(tmp_path):
+    """Real out-of-process trial: config handed via JSON file, metrics read
+    from the last JSON stdout line (reference user_script contract)."""
+    from deepspeed_tpu.autotuning.scheduler import (Node, Reservation,
+                                                    SubprocessTrialRunner)
+
+    script = tmp_path / "user_script.py"
+    script.write_text(
+        "import argparse, json, os\n"
+        "p = argparse.ArgumentParser(); p.add_argument('--exp_config')\n"
+        "a = p.parse_args()\n"
+        "cfg = json.load(open(a.exp_config))\n"
+        "print('noise line')\n"
+        "print(json.dumps({'throughput': 7.0 * cfg['train_micro_batch_size_per_gpu'],\n"
+        "                  'slots': os.environ['DSTPU_TRIAL_SLOTS']}))\n")
+    runner = SubprocessTrialRunner(str(script),
+                                   results_dir=str(tmp_path / "results"))
+    node = Node("localhost", 2)
+    node.free -= 1
+    tput = runner({"name": "t0",
+                   "config": {"train_micro_batch_size_per_gpu": 3}},
+                  Reservation(node, 1))
+    assert tput == 21.0
+    assert (tmp_path / "results" / "t0" / "exp.json").exists()

@@ -135,3 +135,45 @@ def test_analyzer_missing_shard_raises(tmp_path):
     an.run_map()  # worker 1 never runs
     with pytest.raises(FileNotFoundError):
         an.run_reduce()
+
+
+def test_analyzer_accumulate_metric_two_pass(tmp_path):
+    """Accumulate-type metric (reference accumulate_value_over_samples):
+    corpus vocab histogram summed by map-reduce over 3 workers equals the
+    direct count, then feeds the rarity metric — the reference's canonical
+    two-pass curriculum."""
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+        metric_vocab_histogram)
+
+    ds = _dataset(25)
+    for w in range(3):
+        DataAnalyzer(ds, metric_names=["vocab"], metric_types=
+                     ["accumulate_value_over_samples"],
+                     metric_functions=[metric_vocab_histogram(50)],
+                     save_path=str(tmp_path), num_workers=3,
+                     worker_id=w).run_map()
+    out = DataAnalyzer(ds, metric_names=["vocab"], metric_types=
+                       ["accumulate_value_over_samples"],
+                       metric_functions=[metric_vocab_histogram(50)],
+                       save_path=str(tmp_path), num_workers=3).run_reduce()
+    freq = out["vocab"]["accumulated"]
+    direct = np.zeros(50)
+    for s in ds:
+        direct += np.bincount(s["input_ids"], minlength=50)
+    np.testing.assert_allclose(freq, direct)
+    # pass 2: rarity from the accumulated frequency
+    rarity = metric_total_vocab_freq(freq)
+    assert np.isfinite(rarity(ds[0]))
+
+
+def test_analyzer_concurrent_driver_matches_single(tmp_path):
+    """run_map_reduce runs the per-worker maps concurrently and reduces
+    once; output identical to the sequential single-worker path."""
+    ds = _dataset(31, seed=4)
+    single = DataAnalyzer(ds, save_path=str(tmp_path / "s"))
+    single.run_map()
+    want = single.run_reduce()["seqlen"]["index_to_metric"]
+    got = DataAnalyzer.run_map_reduce(
+        ds, save_path=str(tmp_path / "p"), num_workers=4)["seqlen"][
+            "index_to_metric"]
+    np.testing.assert_allclose(got, want)

@@ -1,0 +1,182 @@
+"""HF checkpoint import parity (VERDICT r3 missing #6).
+
+Real end-to-end: a Hugging Face model is created with ``transformers``,
+saved with ``save_pretrained`` (safetensors AND torch-bin flavors), imported
+by ``checkpoint/hf_import.py``, and the runtime's jax forward must
+reproduce the HF torch logits — catching name-mapping, transpose, RoPE
+convention, and norm-eps drift in one assert.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+jnp = pytest.importorskip("jax.numpy")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+
+
+def _logits_ours(cfg, params, ids):
+    from deepspeed_tpu.models.transformer import logits_fn, transformer_forward
+
+    hidden, _ = transformer_forward(cfg, params, jnp.asarray(ids))
+    return np.asarray(logits_fn(cfg, params, hidden), np.float32)
+
+
+def test_llama_safetensors_parity(tmp_path):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False)
+    torch.manual_seed(0)
+    m = LlamaForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)  # safetensors by default
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.n_kv_heads == 2 and cfg.n_layers == 2
+    cfg.attn_impl = "xla"
+
+    ids = np.random.RandomState(0).randint(0, 96, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_gpt2_torch_bin_parity(tmp_path):
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(vocab_size=80, n_positions=64, n_embd=32, n_layer=2,
+                        n_head=4)
+    torch.manual_seed(1)
+    m = GPT2LMHeadModel(hf_cfg).eval()
+    m.save_pretrained(tmp_path, safe_serialization=False)  # pytorch_model.bin
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.position == "learned" and cfg.norm == "layernorm"
+    cfg.attn_impl = "xla"
+
+    ids = np.random.RandomState(1).randint(0, 80, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_mixtral_shape_mapping(tmp_path):
+    """Mixtral MoE mapping: expert weights land [L, E, ...]-stacked (the
+    full transformers MixtralForCausalLM is too heavy for the unit tier;
+    shapes + a synthetic state dict cover the name map)."""
+    from deepspeed_tpu.checkpoint.hf_import import (config_from_hf,
+                                                    import_hf_params)
+
+    c = {"model_type": "mixtral", "vocab_size": 64, "hidden_size": 16,
+         "num_hidden_layers": 2, "num_attention_heads": 4,
+         "num_key_value_heads": 2, "intermediate_size": 32,
+         "num_local_experts": 4, "num_experts_per_tok": 2,
+         "max_position_embeddings": 64}
+    cfg = config_from_hf(c)
+    assert cfg.moe_experts == 4 and cfg.moe_top_k == 2
+    r = np.random.RandomState(0)
+    state = {"model.embed_tokens.weight": r.randn(64, 16).astype(np.float32),
+             "model.norm.weight": np.ones(16, np.float32),
+             "lm_head.weight": r.randn(64, 16).astype(np.float32)}
+    for i in range(2):
+        pre = f"model.layers.{i}"
+        state[f"{pre}.self_attn.q_proj.weight"] = r.randn(16, 16).astype(np.float32)
+        state[f"{pre}.self_attn.k_proj.weight"] = r.randn(8, 16).astype(np.float32)
+        state[f"{pre}.self_attn.v_proj.weight"] = r.randn(8, 16).astype(np.float32)
+        state[f"{pre}.self_attn.o_proj.weight"] = r.randn(16, 16).astype(np.float32)
+        state[f"{pre}.input_layernorm.weight"] = np.ones(16, np.float32)
+        state[f"{pre}.post_attention_layernorm.weight"] = np.ones(16, np.float32)
+        state[f"{pre}.block_sparse_moe.gate.weight"] = r.randn(4, 16).astype(np.float32)
+        for e in range(4):
+            state[f"{pre}.block_sparse_moe.experts.{e}.w1.weight"] = \
+                r.randn(32, 16).astype(np.float32)
+            state[f"{pre}.block_sparse_moe.experts.{e}.w2.weight"] = \
+                r.randn(16, 32).astype(np.float32)
+            state[f"{pre}.block_sparse_moe.experts.{e}.w3.weight"] = \
+                r.randn(32, 16).astype(np.float32)
+    p = import_hf_params(cfg, state, "mixtral")
+    assert p["layers"]["mlp"]["w_gate"].shape == (2, 4, 16, 32)
+    assert p["layers"]["mlp"]["w_down"].shape == (2, 4, 32, 16)
+    assert p["layers"]["mlp"]["router"].shape == (2, 16, 4)
+    # importable by the engine's init contract: same treedef as native init
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.models.transformer import init_transformer_params
+
+    native_cfg = mixtral_config(
+        "tiny", max_seq_len=64, vocab_size=64, hidden_size=16, n_layers=2,
+        n_heads=4, n_kv_heads=2, intermediate_size=32, moe_experts=4,
+        moe_use_residual=False, tie_embeddings=False)
+    native = init_transformer_params(native_cfg, jax.random.PRNGKey(0))
+    assert (jax.tree_util.tree_structure(jax.tree_util.tree_map(np.asarray, p))
+            == jax.tree_util.tree_structure(
+                jax.tree_util.tree_map(np.asarray, native)))
+
+
+def test_safetensors_reader_roundtrip(tmp_path):
+    """The native safetensors reader handles fp32/bf16/int dtypes."""
+    import ml_dtypes
+    import struct
+
+    from deepspeed_tpu.checkpoint.hf_import import read_safetensors
+
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.arange(6, dtype=np.int64)
+    c = (np.arange(4) / 3.0).astype(ml_dtypes.bfloat16)
+    tensors = {"a": ("F32", a), "b": ("I64", b), "c": ("BF16", c)}
+    header = {}
+    off = 0
+    payload = b""
+    for name, (dt, arr) in tensors.items():
+        raw = arr.tobytes()
+        header[name] = {"dtype": dt, "shape": list(arr.shape),
+                        "data_offsets": [off, off + len(raw)]}
+        off += len(raw)
+        payload += raw
+    hjson = json.dumps(header).encode()
+    path = tmp_path / "t.safetensors"
+    path.write_bytes(struct.pack("<Q", len(hjson)) + hjson + payload)
+    out = read_safetensors(str(path))
+    np.testing.assert_array_equal(out["a"], a)
+    np.testing.assert_array_equal(out["b"], b)
+    np.testing.assert_array_equal(np.asarray(out["c"], np.float32),
+                                  np.asarray(c, np.float32))
+
+
+def test_init_inference_from_hf_directory(tmp_path):
+    """The reference's end-user flow: point init_inference at a published
+    checkpoint directory and generate."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(2)
+    LlamaForCausalLM(hf_cfg).save_pretrained(tmp_path)
+
+    import deepspeed_tpu
+
+    engine = deepspeed_tpu.init_inference(str(tmp_path),
+                                          {"dtype": "fp32",
+                                           "attn_impl": "xla"})
+    ids = np.random.RandomState(3).randint(0, 96, (1, 8)).astype(np.int32)
+    out = engine.generate(jnp.asarray(ids), max_new_tokens=4)
+    assert out.shape == (1, 12)
+    assert int(np.asarray(out).max()) < 96

@@ -122,3 +122,66 @@ def test_env_report_runs():
     from deepspeed_tpu.env_report import main
 
     assert main() == 0
+
+
+def test_per_module_flops_breakdown():
+    """Per-module cost table (reference per-module MACs/params/latency,
+    profiling/flops_profiler/profiler.py): rows for embed / per-layer
+    attn+mlp / head, component flops summing near the whole forward."""
+    import jax
+
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.models.transformer import (causal_lm_loss,
+                                                  init_transformer_params)
+    from deepspeed_tpu.profiling.flops_profiler import (
+        cost_analysis_of, format_module_table, per_module_breakdown)
+
+    cfg = llama_config("tiny", max_seq_len=32, attn_impl="xla")
+    params = init_transformer_params(cfg, jax.random.PRNGKey(0))
+    rows = per_module_breakdown(cfg, params, batch_size=2, seq_len=32)
+    names = [r["module"] for r in rows]
+    assert "embed" in names and "lm_head" in names
+    assert f"layers.{cfg.n_layers - 1}.attn" in names
+    assert f"layers.{cfg.n_layers - 1}.mlp" in names
+    # params accounted: per-layer + embed == total (tied head)
+    import numpy as np
+
+    from deepspeed_tpu.profiling.flops_profiler import count_params
+    assert sum(r["params"] for r in rows) == count_params(params)
+    # component flops roughly cover the full forward (loss excluded)
+    import jax.numpy as jnp
+    ids = jnp.zeros((2, 32), jnp.int32)
+    full = cost_analysis_of(jax.jit(
+        lambda p, i: causal_lm_loss(cfg, p, i, None)), params, ids)
+    covered = sum(r["flops"] for r in rows)
+    assert covered > 0.5 * float(full.get("flops", 0.0))
+    table = format_module_table(rows)
+    assert "module" in table and "layers.0.attn" in table
+
+
+def test_flops_profiler_prints_module_table(monkeypatch):
+    """The engine profiler prints the per-module table at the profile
+    step when the model exposes a TransformerConfig."""
+    import numpy as np
+
+    import deepspeed_tpu
+    import deepspeed_tpu.profiling.flops_profiler as fp
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=16, vocab_size=64, n_layers=2,
+                        attn_impl="xla")
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1}})
+    lines = []
+    monkeypatch.setattr(fp.logger, "info", lambda msg: lines.append(str(msg)))
+    import jax.numpy as jnp
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (1, 2, 16)),
+                      dtype=jnp.int32)
+    for _ in range(3):
+        engine.train_batch({"input_ids": ids})
+    text = "\n".join(lines)
+    assert "per-module profile" in text
+    assert "layers.0.attn" in text
