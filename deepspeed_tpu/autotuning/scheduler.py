@@ -84,7 +84,6 @@ class ResourceManager:
         self._running: Dict[int, threading.Thread] = {}
         self.finished: List[Dict[str, Any]] = []
         self._count = 0
-        self._stop = False
 
     # -- reference schedule_experiments (scheduler.py:58) -------------------
     def schedule_experiments(self, exps: List[Dict[str, Any]]) -> None:
@@ -140,7 +139,7 @@ class ResourceManager:
         with self._cv:
             while True:
                 # dispatch as much as capacity allows
-                while (self._queue and not self._stop
+                while (self._queue
                        and (self.max_parallel is None
                             or len(self._running) < self.max_parallel)):
                     res = self._reserve()

@@ -275,33 +275,27 @@ def load_hf_model(model_dir: str, dtype=None) -> Tuple[Any, Dict[str, Any]]:
         cfg, params = load_hf_model("/path/to/llama-2-7b")
         engine = InferenceEngine(llama_model(config=cfg), params=params)
     """
-    import jax.numpy as jnp
+    import jax
 
     with open(os.path.join(model_dir, "config.json")) as f:
         raw = json.load(f)
     cfg = config_from_hf(raw)
     state = load_state_dict(model_dir)
     params = import_hf_params(cfg, state, raw.get("model_type", "llama"))
-    dt = dtype or cfg.dtype
-    params = _tree_map_np(lambda a: jnp.asarray(
-        a, dt if np.issubdtype(np.asarray(a).dtype, np.floating)
-        or str(np.asarray(a).dtype) == "bfloat16" else None), params)
-    n = sum(int(np.prod(np.shape(a))) for a in _tree_leaves_np(params))
+    dt = np.dtype(dtype) if dtype is not None else np.dtype(cfg.dtype)
+
+    def to_host(a):
+        # stay NUMPY (host): the engine's sharded device_put must be the
+        # only transfer, or a 13B import OOMs one chip before TP/ZeRO ever
+        # gets to shard it
+        a = np.asarray(a)
+        floating = (np.issubdtype(a.dtype, np.floating)
+                    or str(a.dtype) == "bfloat16")
+        return a.astype(dt) if floating else a
+
+    params = jax.tree_util.tree_map(to_host, params)
+    n = sum(int(np.prod(np.shape(a)))
+            for a in jax.tree_util.tree_leaves(params))
     logger.info(f"hf_import: loaded {n / 1e6:.1f}M params "
                 f"({raw.get('model_type', 'llama')}) from {model_dir}")
     return cfg, params
-
-
-def _tree_map_np(fn, tree):
-    if isinstance(tree, dict):
-        return {k: _tree_map_np(fn, v) for k, v in tree.items()}
-    return fn(tree)
-
-
-def _tree_leaves_np(tree) -> List[Any]:
-    if isinstance(tree, dict):
-        out = []
-        for v in tree.values():
-            out.extend(_tree_leaves_np(v))
-        return out
-    return [tree]

@@ -50,7 +50,7 @@ def llama_model(size: str = "7b", max_seq_len: int = 2048,
         apply_fn=lambda params, batch: logits_fn(
             cfg, params, transformer_forward(
                 cfg, params, batch["input_ids"] if isinstance(batch, dict) else batch)[0]),
-        flops_per_sample=flops_per_token(cfg, max_seq_len) * max_seq_len,
+        flops_per_sample=flops_per_token(cfg, cfg.max_seq_len) * cfg.max_seq_len,
     )
     spec.config = cfg
     return spec

@@ -741,9 +741,13 @@ class DeepSpeedTPUEngine:
             while j < len(leaves) and (j == i or acc_bytes < bucket_bytes):
                 acc_bytes += leaves[j].size * leaves[j].dtype.itemsize
                 j += 1
-            host_arrs = [np.asarray(master[k]).reshape(leaves[k].shape)
-                         .astype(leaves[k].dtype, copy=False)
-                         for k in range(i, j)]
+            # the copy is REQUIRED even when dtypes match: on CPU backends
+            # device_put zero-copies aligned numpy buffers, and cpu_adam
+            # mutates self.master in place next step — a view would change
+            # the live params behind XLA's back.  Bucketing bounds the
+            # transient to bucket_bytes.
+            host_arrs = [np.array(master[k], dtype=leaves[k].dtype)
+                         .reshape(leaves[k].shape) for k in range(i, j)]
             new_leaves.extend(jax.device_put(
                 host_arrs, [leaves[k].sharding for k in range(i, j)]))
             i = j

@@ -1,0 +1,81 @@
+"""Run the benchmark rung ladder and collect one JSON record per rung.
+
+Usage (on a machine with the TPU reachable):
+
+    python tools/bench_sweep.py            # all rungs
+    python tools/bench_sweep.py flagship   # just the headline rung
+
+Writes ``docs/BENCH_SWEEP.json`` (list of {rung, env, result|error}) and
+prints a compact table.  Each rung is a bench.py invocation with the
+env-selectable knobs (size/seq/bs/stage/offload), so the sweep measures
+exactly what the driver's bench measures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RUNGS = {
+    # headline: the round-3 PERF_NOTES configuration
+    "flagship": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
+                 "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20"},
+    # the shape PERF_NOTES predicts feeds the MXU better (hidden 2048)
+    "1b": {"DSTPU_BENCH_SIZE": "1b", "DSTPU_BENCH_SEQ": "1024",
+           "DSTPU_BENCH_STEPS": "10"},
+    # ZeRO-3 on the same model/chip: settles the stage-3 XLA-prefetch bet
+    "160m-zero3": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
+                   "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
+                   "DSTPU_BENCH_STAGE": "3"},
+    # optimizer offload boundary cost on hardware
+    "160m-offload": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
+                     "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "10",
+                     "DSTPU_BENCH_OFFLOAD": "1"},
+}
+
+
+def main() -> int:
+    names = sys.argv[1:] or list(RUNGS)
+    # test hook: JSON dict merged over every rung (e.g. shrink sizes on CPU)
+    overrides = json.loads(os.environ.get("DSTPU_SWEEP_OVERRIDES", "{}"))
+    out = []
+    # DSTPU_SWEEP_CPU=1 forces bench.py's --cpu pin (the site TPU plugin
+    # pins the platform via jax.config, so the env var alone can't)
+    args = ["--cpu"] if os.environ.get("DSTPU_SWEEP_CPU") == "1" else []
+    for name in names:
+        env = {**os.environ, **RUNGS[name], **overrides}
+        print(f"=== rung {name}: {RUNGS[name]}", file=sys.stderr, flush=True)
+        rec = {"rung": name, "env": RUNGS[name]}
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(ROOT, "bench.py"), *args],
+                capture_output=True, text=True, env=env, timeout=3600)
+            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            try:
+                rec["result"] = json.loads(line)
+            except ValueError:
+                rec["error"] = (proc.stderr[-500:] or "no output")
+        except subprocess.TimeoutExpired:
+            # one hung rung must not discard the completed rungs' results
+            rec["error"] = "rung timed out after 3600s"
+        out.append(rec)
+        print(json.dumps(rec), file=sys.stderr)
+        # write incrementally: hardware sweeps are long and interruptible
+        path = os.path.join(ROOT, "docs", "BENCH_SWEEP.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    for rec in out:
+        r = rec.get("result", {})
+        print(f"{rec['rung']:>14}: "
+              + (f"{r.get('value')} {r.get('unit')} mfu={r.get('mfu')} "
+                 f"backend={r.get('backend')}" if r else
+                 f"ERROR {rec.get('error', '')[:120]}"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
