@@ -265,7 +265,7 @@ def main() -> None:
     else:
         # larger micro-batch feeds the MXU better (M = bs*seq rows); fall
         # back on OOM so a too-ambitious first rung can't zero the bench
-        ladder = [16, 8] if on_tpu else [2]
+        ladder = [32, 16, 8] if on_tpu else [2]
     result = None
     # phase 1: default kernels; phase 2 (entered only on a Pallas/Mosaic
     # lowering failure): XLA attention, still on the accelerator — slower,

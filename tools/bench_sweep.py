@@ -21,9 +21,10 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RUNGS = {
-    # headline: the round-3 PERF_NOTES configuration
+    # headline: the round-3 PERF_NOTES configuration; bs unpinned so the
+    # ladder can probe 32 first (OOM falls back to 16/8)
     "flagship": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
-                 "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20"},
+                 "DSTPU_BENCH_STEPS": "20"},
     # the shape PERF_NOTES predicts feeds the MXU better (hidden 2048)
     "1b": {"DSTPU_BENCH_SIZE": "1b", "DSTPU_BENCH_SEQ": "1024",
            "DSTPU_BENCH_STEPS": "10"},
@@ -47,7 +48,11 @@ def main() -> int:
     # pins the platform via jax.config, so the env var alone can't)
     args = ["--cpu"] if os.environ.get("DSTPU_SWEEP_CPU") == "1" else []
     for name in names:
-        env = {**os.environ, **RUNGS[name], **overrides}
+        # ambient DSTPU_BENCH_* exports must not silently reshape a rung:
+        # the rung definition + DSTPU_SWEEP_OVERRIDES are the only knobs
+        ambient = {k: v for k, v in os.environ.items()
+                   if not k.startswith("DSTPU_BENCH_")}
+        env = {**ambient, **RUNGS[name], **overrides}
         print(f"=== rung {name}: {RUNGS[name]}", file=sys.stderr, flush=True)
         rec = {"rung": name, "env": RUNGS[name]}
         try:
