@@ -1,0 +1,201 @@
+"""Export trained parameters to a Hugging Face checkpoint directory.
+
+Reference parity: the consolidation/export story —
+``utils/zero_to_fp32.py`` (offline fp32 state-dict consolidation),
+``engine.save_16bit_model`` / ``_zero3_consolidated_16bit_state_dict``
+(gathered 16-bit export for downstream serving).  Here the engine's param
+tree is already reassembled by ``jax.device_get`` (XLA gathers shards), so
+export reduces to the inverse name map of ``hf_import`` plus a native
+safetensors writer — the result loads in ``transformers.from_pretrained``.
+
+Families: llama / mistral / qwen2 (rotate-half RoPE, same layout), mixtral
+(expert-stacked MoE), gpt2 (Conv1D, no transposes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict
+
+import numpy as np
+
+from ..utils.logging import logger
+
+_NP_TO_ST = {"float64": "F64", "float32": "F32", "float16": "F16",
+             "int64": "I64", "int32": "I32", "int16": "I16", "int8": "I8",
+             "uint8": "U8", "bool": "BOOL", "bfloat16": "BF16"}
+
+
+def write_safetensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
+    """Native safetensors writer (inverse of hf_import.read_safetensors)."""
+    header: Dict[str, Any] = {}
+    off = 0
+    for name, arr in tensors.items():
+        raw_len = arr.nbytes
+        header[name] = {"dtype": _NP_TO_ST[str(arr.dtype)],
+                        "shape": list(arr.shape),
+                        "data_offsets": [off, off + raw_len]}
+        off += raw_len
+    hjson = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for arr in tensors.values():
+            f.write(np.ascontiguousarray(arr).tobytes())
+
+
+def _unstack(stacked, transpose: bool = True):
+    for i in range(stacked.shape[0]):
+        w = np.asarray(stacked[i])
+        yield i, (w.T if transpose else w)
+
+
+def export_hf_state(cfg, params: Dict[str, Any],
+                    model_type: str = "llama") -> Dict[str, np.ndarray]:
+    """Param tree -> HF state dict (numpy)."""
+    host = {}
+
+    def get(tree):
+        import jax
+
+        return np.asarray(jax.device_get(tree))
+
+    if model_type == "gpt2":
+        return _export_gpt2(cfg, params, get)
+    host["model.embed_tokens.weight"] = get(params["embed"]["tok"])
+    host["model.norm.weight"] = get(params["final_norm"]["scale"])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["lm_head.weight"] = get(params["lm_head"]["w"]).T
+    layers = params["layers"]
+    names = {"wq": "q_proj", "wk": "k_proj", "wv": "v_proj", "wo": "o_proj"}
+    for ours, theirs in names.items():
+        for i, w in _unstack(get(layers["attn"][ours])):
+            host[f"model.layers.{i}.self_attn.{theirs}.weight"] = w
+    if getattr(cfg, "qkv_bias", False):
+        for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"),
+                             ("bv", "v_proj")):
+            for i, b in _unstack(get(layers["attn"][ours]), transpose=False):
+                host[f"model.layers.{i}.self_attn.{theirs}.bias"] = b
+    for i, s in _unstack(get(layers["norm1"]["scale"]), transpose=False):
+        host[f"model.layers.{i}.input_layernorm.weight"] = s
+    for i, s in _unstack(get(layers["norm2"]["scale"]), transpose=False):
+        host[f"model.layers.{i}.post_attention_layernorm.weight"] = s
+    mlp = layers["mlp"]
+    if cfg.moe_experts > 0:  # mixtral
+        if getattr(cfg, "moe_use_residual", False):
+            # PR-MoE residual weights (res_w_up/res_w_down/coef) have no HF
+            # mixtral counterpart — refuse rather than silently drop them
+            raise ValueError(
+                "hf_export: PR-MoE (moe_use_residual) has no mixtral "
+                "checkpoint representation; export without residual experts")
+        for i, g in _unstack(get(mlp["router"])):
+            host[f"model.layers.{i}.block_sparse_moe.gate.weight"] = g
+        wmap = {"w_gate": "w1", "w_down": "w2", "w_up": "w3"}
+        for ours, theirs in wmap.items():
+            full = get(mlp[ours])  # [L, E, in, out]
+            for i in range(full.shape[0]):
+                for e in range(full.shape[1]):
+                    host[f"model.layers.{i}.block_sparse_moe.experts.{e}."
+                         f"{theirs}.weight"] = np.asarray(full[i, e]).T
+    else:
+        wmap = {"w_gate": "gate_proj", "w_up": "up_proj", "w_down": "down_proj"}
+        for ours, theirs in wmap.items():
+            for i, w in _unstack(get(mlp[ours])):
+                host[f"model.layers.{i}.mlp.{theirs}.weight"] = w
+    return host
+
+
+def _export_gpt2(cfg, params, get) -> Dict[str, np.ndarray]:
+    L = cfg.n_layers
+    host = {"transformer.wte.weight": get(params["embed"]["tok"]),
+            "transformer.wpe.weight": get(params["embed"]["pos"]),
+            "transformer.ln_f.weight": get(params["final_norm"]["scale"]),
+            "transformer.ln_f.bias": get(params["final_norm"]["bias"])}
+    a, m = params["layers"]["attn"], params["layers"]["mlp"]
+    # one device_get per stacked tensor, OUTSIDE the per-layer loop
+    wq, wk, wv = get(a["wq"]), get(a["wk"]), get(a["wv"])
+    bq, bk, bv = get(a["bq"]), get(a["bk"]), get(a["bv"])
+    wo, bo = get(a["wo"]), get(a["bo"])
+    w_up, b_up = get(m["w_up"]), get(m["b_up"])
+    w_down, b_down = get(m["w_down"]), get(m["b_down"])
+    norms = {ln: (get(params["layers"][ln]["scale"]),
+                  get(params["layers"][ln]["bias"]))
+             for ln in ("norm1", "norm2")}
+    for i in range(L):
+        pre = f"transformer.h.{i}"
+        host[f"{pre}.attn.c_attn.weight"] = np.concatenate(
+            [wq[i], wk[i], wv[i]], axis=1)
+        host[f"{pre}.attn.c_attn.bias"] = np.concatenate([bq[i], bk[i], bv[i]])
+        host[f"{pre}.attn.c_proj.weight"] = wo[i]
+        host[f"{pre}.attn.c_proj.bias"] = bo[i]
+        host[f"{pre}.mlp.c_fc.weight"] = w_up[i]
+        host[f"{pre}.mlp.c_fc.bias"] = b_up[i]
+        host[f"{pre}.mlp.c_proj.weight"] = w_down[i]
+        host[f"{pre}.mlp.c_proj.bias"] = b_down[i]
+        for ln, theirs in (("norm1", "ln_1"), ("norm2", "ln_2")):
+            host[f"{pre}.{theirs}.weight"] = norms[ln][0][i]
+            host[f"{pre}.{theirs}.bias"] = norms[ln][1][i]
+    return host
+
+
+def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
+    if model_type == "gpt2":
+        return {"model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
+                "vocab_size": cfg.vocab_size, "n_embd": cfg.hidden_size,
+                "n_layer": cfg.n_layers, "n_head": cfg.n_heads,
+                "n_positions": cfg.max_seq_len,
+                "n_inner": cfg.ffn_size,
+                "layer_norm_epsilon": cfg.norm_eps}
+    arch = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
+            "qwen2": "Qwen2ForCausalLM",
+            "mixtral": "MixtralForCausalLM"}.get(model_type,
+                                                 "LlamaForCausalLM")
+    out = {"model_type": model_type, "architectures": [arch],
+           "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+           "num_hidden_layers": cfg.n_layers,
+           "num_attention_heads": cfg.n_heads,
+           "num_key_value_heads": cfg.kv_heads,
+           "intermediate_size": cfg.intermediate_size or cfg.ffn_size,
+           "max_position_embeddings": cfg.max_seq_len,
+           "rms_norm_eps": cfg.norm_eps, "rope_theta": cfg.rope_theta,
+           "tie_word_embeddings": bool(cfg.tie_embeddings)}
+    if model_type == "mixtral":
+        out["num_local_experts"] = cfg.moe_experts
+        out["num_experts_per_tok"] = cfg.moe_top_k
+    return out
+
+
+def save_hf_checkpoint(model_dir: str, cfg, params: Dict[str, Any],
+                       model_type: str = "llama", dtype=None) -> None:
+    """Write a transformers-loadable checkpoint directory:
+    ``config.json`` + ``model.safetensors``.
+
+        engine.save_checkpoint(...)                  # native resume format
+        save_hf_checkpoint("out/", cfg, engine.state.params)  # HF export
+    """
+    os.makedirs(model_dir, exist_ok=True)
+    state = export_hf_state(cfg, params, model_type)
+    if dtype is not None:
+        dt = np.dtype(dtype)
+        state = {k: (v.astype(dt)
+                     if np.issubdtype(v.dtype, np.floating)
+                     or str(v.dtype) == "bfloat16" else v)
+                 for k, v in state.items()}
+    write_safetensors(os.path.join(model_dir, "model.safetensors"), state)
+    hf_cfg = hf_config_dict(cfg, model_type)
+    # torch_dtype must describe what was actually WRITTEN, or
+    # from_pretrained(torch_dtype='auto') materializes the wrong precision
+    widest = max((str(v.dtype) for v in state.values()
+                  if np.issubdtype(v.dtype, np.floating)
+                  or str(v.dtype) == "bfloat16"),
+                 key=lambda s: {"float16": 2, "bfloat16": 2,
+                                "float32": 4, "float64": 8}.get(s, 4),
+                 default="float32")
+    hf_cfg["torch_dtype"] = widest
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=1)
+    n = sum(v.size for v in state.values())
+    logger.info(f"hf_export: wrote {n / 1e6:.1f}M params "
+                f"({model_type}) to {model_dir}")

@@ -180,3 +180,85 @@ def test_init_inference_from_hf_directory(tmp_path):
     out = engine.generate(jnp.asarray(ids), max_new_tokens=4)
     assert out.shape == (1, 12)
     assert int(np.asarray(out).max()) < 96
+
+
+# -- export (reference zero_to_fp32 / save_16bit_model story) ---------------
+def test_export_roundtrip_and_transformers_load(tmp_path):
+    """Native params -> save_hf_checkpoint -> transformers.from_pretrained
+    reproduces our logits; and re-importing returns the identical tree."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.models.transformer import init_transformer_params
+
+    cfg = llama_config("tiny", max_seq_len=64, vocab_size=96,
+                       n_layers=2, n_heads=4, n_kv_heads=2,
+                       attn_impl="xla", tie_embeddings=False,
+                       dtype=jnp.float32)
+    params = init_transformer_params(cfg, jax.random.PRNGKey(7))
+    out = tmp_path / "export"
+    save_hf_checkpoint(str(out), cfg, params, "llama")
+
+    ids = np.random.RandomState(2).randint(0, 96, (2, 10)).astype(np.int32)
+    ours = _logits_ours(cfg, params, ids)
+
+    hf = AutoModelForCausalLM.from_pretrained(str(out)).eval()
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+    cfg2, params2 = load_hf_model(str(out), dtype=jnp.float32)
+    flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat2 = jax.tree_util.tree_flatten_with_path(params2)[0]
+    assert len(flat1) == len(flat2), (len(flat1), len(flat2))
+    for (kp, a), (_, b) in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(kp))
+
+
+def test_export_gpt2_transformers_load(tmp_path):
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.models.gpt2 import gpt2_config
+    from deepspeed_tpu.models.transformer import init_transformer_params
+
+    cfg = gpt2_config("tiny", vocab_size=80, max_seq_len=64,
+                      attn_impl="xla", dtype=jnp.float32)
+    params = init_transformer_params(cfg, jax.random.PRNGKey(8))
+    out = tmp_path / "export"
+    save_hf_checkpoint(str(out), cfg, params, "gpt2")
+
+    ids = np.random.RandomState(3).randint(0, 80, (2, 9)).astype(np.int32)
+    ours = _logits_ours(cfg, params, ids)
+    hf = AutoModelForCausalLM.from_pretrained(str(out)).eval()
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=5e-3)
+
+
+def test_export_import_mixtral_roundtrip(tmp_path):
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.models.transformer import init_transformer_params
+
+    cfg = mixtral_config("tiny", max_seq_len=64, vocab_size=64,
+                         moe_use_residual=False, tie_embeddings=False,
+                         dtype=jnp.float32)
+    params = init_transformer_params(cfg, jax.random.PRNGKey(9))
+    save_hf_checkpoint(str(tmp_path), cfg, params, "mixtral")
+    cfg2, params2 = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg2.moe_experts == cfg.moe_experts
+    flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat2 = jax.tree_util.tree_flatten_with_path(params2)[0]
+    assert len(flat1) == len(flat2), (len(flat1), len(flat2))
+    for (kp, a), (_, b) in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(kp))
