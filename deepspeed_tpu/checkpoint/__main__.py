@@ -27,11 +27,56 @@ def main(argv=None) -> int:
     p2.add_argument("ckpt_dir")
     p2.add_argument("tag")
     p2.add_argument("output_file")
+    p3 = sub.add_parser(
+        "to-hf", help="export a partitioned checkpoint as a transformers-"
+                      "loadable directory (config.json + model.safetensors)")
+    p3.add_argument("ckpt_dir")
+    p3.add_argument("tag")
+    p3.add_argument("out_dir")
+    p3.add_argument("--model", required=True,
+                    help="family:size of the trained model, e.g. llama:7b")
+    p3.add_argument("--model-type", default=None,
+                    help="HF model_type for the export map (default: family)")
+    p3.add_argument("--dtype", default=None,
+                    help="cast floating weights, e.g. bfloat16")
+    p3.add_argument("--override", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="config field override (repeatable), e.g. "
+                         "--override vocab_size=32000 --override "
+                         "max_seq_len=4096 — must match the trained model")
     args = ap.parse_args(argv)
     if args.cmd == "to-universal":
         out = to_universal(args.ckpt_dir, args.tag, args.out_dir)
-    else:
+    elif args.cmd == "zero-to-fp32":
         out = zero_to_fp32(args.ckpt_dir, args.tag, args.output_file)
+    else:
+        import json
+
+        from .hf_export import checkpoint_to_hf
+        from .. import models
+
+        family, _, size = args.model.partition(":")
+        # config factories live on the models package (mistral/qwen come
+        # from families.py, not their own modules); HF calls qwen "qwen2"
+        factory_name = {"qwen2": "qwen_config"}.get(family,
+                                                    f"{family}_config")
+        factory = getattr(models, factory_name, None)
+        if factory is None:
+            raise SystemExit(
+                f"unknown model family '{family}' (no "
+                f"deepspeed_tpu.models.{factory_name})")
+        over = {}
+        for item in args.override:
+            k, _, v = item.partition("=")
+            try:  # JSON covers ints, floats, and true/false properly
+                over[k] = json.loads(v)
+            except ValueError:
+                over[k] = v
+        # each family has its own default size — only pass one if given
+        cfg = factory(size, **over) if size else factory(**over)
+        out = checkpoint_to_hf(args.ckpt_dir, args.tag, args.out_dir, cfg,
+                               model_type=args.model_type or family,
+                               dtype=args.dtype)
     print(out)
     return 0
 

@@ -167,6 +167,68 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
     return out
 
 
+def checkpoint_to_hf(ckpt_dir: str, tag: str, out_dir: str, cfg,
+                     model_type: str = "llama", dtype=None) -> str:
+    """Native checkpoint -> transformers-loadable directory (the
+    reference's offline ``zero_to_fp32.py`` + HF-export flow, without
+    loading an engine).  Handles BOTH layouts: the partitioned per-rank
+    shard files (assembled from the exact index metadata) and the simple
+    consolidated ``model_states.npz``.  Keys are ``jax.tree_util.keystr``
+    paths under ``.params``."""
+    import re
+
+    from .partitioned import META_FILE as PART_META, _assemble
+
+    path = os.path.join(ckpt_dir, tag)
+    if os.path.exists(os.path.join(path, PART_META)):
+        # only materialize .params — optimizer moments are 2-3x the bytes
+        full = _assemble(path, prefix=".params")
+    else:
+        from .saving import META_FILE, MODEL_FILE
+
+        with np.load(os.path.join(path, MODEL_FILE)) as z:
+            full = {k: z[k] for k in z.files if k.startswith(".params")}
+        with open(os.path.join(path, META_FILE)) as f:
+            bf16 = json.load(f).get("bfloat16_keys", {})
+        for k in bf16:
+            if k not in full:
+                continue
+            import ml_dtypes
+
+            full[k] = full[k].view(np.dtype(ml_dtypes.bfloat16))
+    params: Dict[str, Any] = {}
+    for key, arr in full.items():
+        if not key.startswith(".params"):
+            continue
+        if arr.dtype == np.uint16:  # stored bf16
+            import ml_dtypes
+
+            arr = arr.view(np.dtype(ml_dtypes.bfloat16))
+        node = params
+        parts = re.findall(r"\['([^']+)'\]", key)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    # the config is supplied by the caller (family:size), not stored in the
+    # checkpoint — validate it against the actual tensors before mapping,
+    # or a dims mismatch surfaces as a confusing transformers load error
+    tok = params.get("embed", {}).get("tok")
+    if tok is not None and tuple(tok.shape) != (cfg.vocab_size,
+                                                cfg.hidden_size):
+        raise ValueError(
+            f"checkpoint embed table is {tuple(tok.shape)} but the supplied "
+            f"config says (vocab={cfg.vocab_size}, hidden={cfg.hidden_size})"
+            f" — pass the config the model was trained with (CLI: "
+            f"--override vocab_size=... hidden_size=...)")
+    wq = params.get("layers", {}).get("attn", {}).get("wq")
+    if wq is not None and wq.shape[0] != cfg.n_layers:
+        raise ValueError(
+            f"checkpoint has {wq.shape[0]} layers but the supplied config "
+            f"says n_layers={cfg.n_layers}")
+    save_hf_checkpoint(out_dir, cfg, params, model_type, dtype=dtype)
+    return out_dir
+
+
 def save_hf_checkpoint(model_dir: str, cfg, params: Dict[str, Any],
                        model_type: str = "llama", dtype=None) -> None:
     """Write a transformers-loadable checkpoint directory:

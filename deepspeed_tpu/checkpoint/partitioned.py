@@ -105,8 +105,11 @@ def save_partitioned(engine, save_dir: str, tag: str,
     return path
 
 
-def _assemble(path: str, keys: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
-    """Merge all ranks' shards into full arrays keyed by pytree path."""
+def _assemble(path: str, keys: Optional[List[str]] = None,
+              prefix: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Merge all ranks' shards into full arrays keyed by pytree path.
+    ``prefix`` filters keys at load time — an export that only needs
+    ``.params`` must not materialize optimizer moments (2-3x the bytes)."""
     import glob
 
     from ..runtime.checkpoint_engine.engines import NumpyCheckpointEngine
@@ -120,6 +123,8 @@ def _assemble(path: str, keys: Optional[List[str]] = None) -> Dict[str, np.ndarr
         arrays = ce.load(os.path.join(path, SHARD_FILE.format(rank=rank).replace(".npz", "")))
         for key, info in index.items():
             if keys is not None and key not in keys:
+                continue
+            if prefix is not None and not key.startswith(prefix):
                 continue
             if key not in full:
                 dtype = info["dtype"]

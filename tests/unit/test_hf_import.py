@@ -262,3 +262,49 @@ def test_export_import_mixtral_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=1e-6,
                                    err_msg=jax.tree_util.keystr(kp))
+
+
+def test_checkpoint_cli_to_hf(tmp_path):
+    """Partitioned native checkpoint -> `python -m deepspeed_tpu.checkpoint
+    to-hf` -> transformers loads it (the offline zero_to_fp32-style flow)."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    import deepspeed_tpu
+    from deepspeed_tpu.checkpoint.__main__ import main as ckpt_cli
+    from deepspeed_tpu.checkpoint.hf_export import checkpoint_to_hf
+    from deepspeed_tpu.models.llama import llama_config, llama_model
+
+    cfg = llama_config("tiny", max_seq_len=32, vocab_size=64, n_layers=2,
+                       attn_impl="xla", tie_embeddings=False,
+                       dtype=jnp.float32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=llama_model(config=cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}, "mesh": {"data": 8}})
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (1, 16, 16)),
+                      jnp.int32)
+    engine.train_batch({"input_ids": ids})
+    engine.save_checkpoint(str(tmp_path / "native"), "t1")
+
+    engine.save_checkpoint(str(tmp_path / "part"), "t1", partitioned=True)
+
+    out = checkpoint_to_hf(str(tmp_path / "native"), "t1",
+                           str(tmp_path / "hf"), cfg, "llama")
+    out_p = checkpoint_to_hf(str(tmp_path / "part"), "t1",
+                             str(tmp_path / "hf_p"), cfg, "llama")
+    hf = AutoModelForCausalLM.from_pretrained(out).eval()
+    probe = np.random.RandomState(4).randint(0, 64, (1, 8))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(probe)).logits.float().numpy()
+    ours = _logits_ours(cfg, jax.device_get(engine.state.params),
+                        probe.astype(np.int32))
+    np.testing.assert_allclose(ours, theirs, atol=3e-4, rtol=3e-3)
+    # partitioned (per-rank shard) layout converts to the same weights
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+    _, p1 = load_hf_model(out, dtype=jnp.float32)
+    _, p2 = load_hf_model(out_p, dtype=jnp.float32)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
