@@ -56,18 +56,32 @@ def main(argv=None) -> int:
         from .. import models
 
         family, _, size = args.model.partition(":")
+        # only families the exporter has a name map for — anything else
+        # would write a llama-layout checkpoint with the wrong model_type
+        supported = ("llama", "mistral", "qwen2", "mixtral", "gpt2")
+        if family not in supported:
+            raise SystemExit(
+                f"to-hf supports families {supported}; got '{family}'")
         # config factories live on the models package (mistral/qwen come
         # from families.py, not their own modules); HF calls qwen "qwen2"
         factory_name = {"qwen2": "qwen_config"}.get(family,
                                                     f"{family}_config")
-        factory = getattr(models, factory_name, None)
-        if factory is None:
-            raise SystemExit(
-                f"unknown model family '{family}' (no "
-                f"deepspeed_tpu.models.{factory_name})")
+        factory = getattr(models, factory_name)
+        import dataclasses as _dc
+
+        from ..models.transformer import TransformerConfig
+
+        valid_fields = {f.name for f in _dc.fields(TransformerConfig)}
         over = {}
         for item in args.override:
-            k, _, v = item.partition("=")
+            k, sep, v = item.partition("=")
+            if not sep:
+                raise SystemExit(f"--override needs KEY=VALUE, got '{item}'")
+            if k not in valid_fields:
+                raise SystemExit(
+                    f"--override '{k}' is not a TransformerConfig field "
+                    f"(did you use the HF name? e.g. max_position_embeddings"
+                    f" -> max_seq_len)")
             try:  # JSON covers ints, floats, and true/false properly
                 over[k] = json.loads(v)
             except ValueError:

@@ -225,6 +225,14 @@ def checkpoint_to_hf(ckpt_dir: str, tag: str, out_dir: str, cfg,
         raise ValueError(
             f"checkpoint has {wq.shape[0]} layers but the supplied config "
             f"says n_layers={cfg.n_layers}")
+    if ("lm_head" in params) != (not cfg.tie_embeddings):
+        # a tied checkpoint exported as untied would make transformers
+        # random-init lm_head — garbage logits with only a warning
+        raise ValueError(
+            f"checkpoint {'has' if 'lm_head' in params else 'lacks'} an "
+            f"lm_head but the supplied config says tie_embeddings="
+            f"{cfg.tie_embeddings} — pass --override tie_embeddings="
+            f"{str('lm_head' not in params).lower()}")
     save_hf_checkpoint(out_dir, cfg, params, model_type, dtype=dtype)
     return out_dir
 
