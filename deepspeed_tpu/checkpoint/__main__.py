@@ -87,7 +87,16 @@ def main(argv=None) -> int:
             except ValueError:
                 over[k] = v
         # each family has its own default size — only pass one if given
-        cfg = factory(size, **over) if size else factory(**over)
+        try:
+            cfg = factory(size, **over) if size else factory(**over)
+        except KeyError:
+            mod = __import__(f"deepspeed_tpu.models.{family}",
+                             fromlist=["SIZES"]) \
+                if family in ("llama", "mixtral", "gpt2") else None
+            sizes = sorted(getattr(mod, "SIZES", {})) if mod else []
+            raise SystemExit(
+                f"unknown size '{size}' for family '{family}'"
+                + (f"; available: {sizes}" if sizes else "")) from None
         out = checkpoint_to_hf(args.ckpt_dir, args.tag, args.out_dir, cfg,
                                model_type=args.model_type or family,
                                dtype=args.dtype)

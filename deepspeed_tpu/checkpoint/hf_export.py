@@ -63,6 +63,14 @@ def export_hf_state(cfg, params: Dict[str, Any],
         return np.asarray(jax.device_get(tree))
 
     if model_type == "gpt2":
+        if not cfg.tie_embeddings and "lm_head" in params:
+            # GPT2LMHeadModel always ties lm_head to wte on load — an
+            # untied head has no representation; refuse rather than let
+            # transformers silently re-tie to different weights
+            raise ValueError(
+                "hf_export: gpt2 checkpoints are always tied in HF; an "
+                "untied lm_head cannot be represented — retrain with "
+                "tie_embeddings=True or export another family")
         return _export_gpt2(cfg, params, get)
     host["model.embed_tokens.weight"] = get(params["embed"]["tok"])
     host["model.norm.weight"] = get(params["final_norm"]["scale"])

@@ -70,6 +70,20 @@ class RaggedRequest:
 class InferenceEngineV2:
     """Paged continuous batching over a models/* transformer."""
 
+    @classmethod
+    def from_pretrained(cls, model_dir: str,
+                        config: Optional["RaggedInferenceConfig"] = None,
+                        **kw) -> "InferenceEngineV2":
+        """Serve a published Hugging Face checkpoint directory with paged
+        continuous batching (the reference's inference-v2 checkpoint
+        loading, model_implementations/*)."""
+        from ...checkpoint.hf_import import load_hf_model
+        from ...models.llama import llama_model
+
+        cfg = config or RaggedInferenceConfig()
+        mcfg, params = load_hf_model(model_dir, dtype=cfg.jnp_dtype)
+        return cls(llama_model(config=mcfg), config=cfg, params=params, **kw)
+
     def __init__(self, model: Any, config: Optional[RaggedInferenceConfig] = None,
                  params: Any = None, seed: int = 0):
         self.config = config or RaggedInferenceConfig()
