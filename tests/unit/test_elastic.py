@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 import deepspeed_tpu
 from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
 from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 import deepspeed_tpu
 from deepspeed_tpu.models import (bert_model, gpt2_model, llama_model,
                                   mixtral_model)

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 import deepspeed_tpu
 from deepspeed_tpu.moe.sharded_moe import (MoEConfig, _gate_and_aux, moe_ffn,
                                            moe_ffn_dropless)

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 import deepspeed_tpu
 from deepspeed_tpu.models.llama import llama_config
 from deepspeed_tpu.models.transformer import causal_lm_loss

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute integration tier
+
 import deepspeed_tpu
 from deepspeed_tpu.models.transformer import xla_attention
 from deepspeed_tpu.parallel.mesh import MeshTopology, initialize_topology
