@@ -13,6 +13,10 @@ header + raw little-endian buffer; no external dependency) and
 ``pytorch_model*.bin`` (via torch, CPU map).  Multi-shard index files of
 both flavors are followed.
 
+Families: llama / mistral / qwen2 / mixtral / gpt2 / opt / phi import with
+logit parity against ``transformers`` (bert is post-norm and intentionally
+unsupported — this runtime's transformer is pre-norm).
+
 Conventions handled:
   * torch ``nn.Linear`` stores [out, in]; this runtime right-multiplies
     ([in, out]) — mapped weights are transposed.  GPT-2 uses Conv1D
@@ -130,6 +134,51 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             activation="gelu", position="learned", causal=True,
             use_bias=True, tie_embeddings=True,
             norm_eps=c.get("layer_norm_epsilon", 1e-5))
+    if mtype == "opt":
+        # OPT: pre-norm decoder (do_layer_norm_before), learned positions
+        # with the +2 padding offset handled at weight import, relu FFN
+        if not c.get("do_layer_norm_before", True):
+            raise ValueError("hf_import: post-layernorm OPT variants "
+                             "(do_layer_norm_before=false, 350m) are not "
+                             "supported by the pre-norm runtime")
+        if c.get("word_embed_proj_dim", c["hidden_size"]) != c["hidden_size"]:
+            raise ValueError(
+                "hf_import: OPT variants with an embedding projection "
+                "(word_embed_proj_dim != hidden_size) are not supported — "
+                "project_in/project_out have no runtime counterpart")
+        act = c.get("activation_function", "relu")  # galactica ships gelu
+        if act not in ("relu", "gelu", "gelu_new"):
+            raise ValueError(f"hf_import: OPT activation_function '{act}' "
+                             f"not supported (relu/gelu)")
+        return TransformerConfig(
+            vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
+            n_layers=c["num_hidden_layers"],
+            n_heads=c["num_attention_heads"],
+            intermediate_size=c["ffn_dim"],
+            max_seq_len=c.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu" if act.startswith("gelu")
+            else "relu", position="learned",
+            causal=True, use_bias=True,
+            tie_embeddings=bool(c.get("tie_word_embeddings", True)))
+    if mtype == "phi":
+        if c.get("qk_layernorm"):
+            raise ValueError("hf_import: phi variants with qk_layernorm "
+                             "are not supported — the q/k layernorm "
+                             "weights have no runtime counterpart")
+        return TransformerConfig(
+            vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
+            n_layers=c["num_hidden_layers"],
+            n_heads=c["num_attention_heads"],
+            n_kv_heads=c.get("num_key_value_heads")
+            or c["num_attention_heads"],
+            intermediate_size=c["intermediate_size"],
+            max_seq_len=c.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", position="rope",
+            causal=True, use_bias=True, parallel_block=True,
+            rotary_pct=float(c.get("partial_rotary_factor", 0.5)),
+            norm_eps=c.get("layer_norm_eps", 1e-5),
+            rope_theta=float(c.get("rope_theta", 10000.0)),
+            tie_embeddings=bool(c.get("tie_word_embeddings", False)))
     kv = c.get("num_key_value_heads", c["num_attention_heads"])
     cfg = TransformerConfig(
         vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
@@ -163,6 +212,10 @@ def import_hf_params(cfg, state: Dict[str, np.ndarray],
     L = cfg.n_layers
     if model_type == "gpt2":
         return _import_gpt2(cfg, state)
+    if model_type == "opt":
+        return _import_opt(cfg, state)
+    if model_type == "phi":
+        return _import_phi(cfg, state)
     p: Dict[str, Any] = {
         "embed": {"tok": np.asarray(state["model.embed_tokens.weight"])},
         "final_norm": {"scale": np.asarray(state["model.norm.weight"])},
@@ -265,6 +318,104 @@ def _import_gpt2(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
                                         for i in range(L)])},
         },
     }
+    return p
+
+
+def _import_opt(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """OPTForCausalLM: pre-norm decoder; ``embed_positions`` carries a +2
+    padding offset — rows 0-1 are dropped so our ``positions = arange(S)``
+    indexes the table the way OPT's ``position + 2`` does."""
+    L = cfg.n_layers
+    pre = "model.decoder"
+
+    def g(k):
+        return np.asarray(state[f"{pre}.{k}"])
+
+    p: Dict[str, Any] = {
+        "embed": {"tok": g("embed_tokens.weight"),
+                  "pos": g("embed_positions.weight")[2:]},
+        "final_norm": {"scale": g("final_layer_norm.weight"),
+                       "bias": g("final_layer_norm.bias")},
+    }
+    attn = {k: _stack(state, f"{pre}.layers.{{i}}.self_attn.{hf}.weight", L)
+            for k, hf in (("wq", "q_proj"), ("wk", "k_proj"),
+                          ("wv", "v_proj"), ("wo", "out_proj"))}
+    for k, hf in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj"),
+                  ("bo", "out_proj")):
+        attn[k] = _stack(state, f"{pre}.layers.{{i}}.self_attn.{hf}.bias", L,
+                         transpose=False)
+    p["layers"] = {
+        "attn": attn,
+        "mlp": {
+            "w_up": _stack(state, f"{pre}.layers.{{i}}.fc1.weight", L),
+            "b_up": _stack(state, f"{pre}.layers.{{i}}.fc1.bias", L,
+                           transpose=False),
+            "w_down": _stack(state, f"{pre}.layers.{{i}}.fc2.weight", L),
+            "b_down": _stack(state, f"{pre}.layers.{{i}}.fc2.bias", L,
+                             transpose=False),
+        },
+        "norm1": {"scale": _stack(
+            state, f"{pre}.layers.{{i}}.self_attn_layer_norm.weight", L,
+            transpose=False),
+            "bias": _stack(
+            state, f"{pre}.layers.{{i}}.self_attn_layer_norm.bias", L,
+            transpose=False)},
+        "norm2": {"scale": _stack(
+            state, f"{pre}.layers.{{i}}.final_layer_norm.weight", L,
+            transpose=False),
+            "bias": _stack(
+            state, f"{pre}.layers.{{i}}.final_layer_norm.bias", L,
+            transpose=False)},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in state:
+        p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T}
+    return p
+
+
+def _import_phi(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """PhiForCausalLM: parallel attention+MLP sharing one input layernorm,
+    partial rotary, biased projections AND a biased lm_head."""
+    L = cfg.n_layers
+    attn = {
+        "wq": _stack(state, "model.layers.{i}.self_attn.q_proj.weight", L),
+        "wk": _stack(state, "model.layers.{i}.self_attn.k_proj.weight", L),
+        "wv": _stack(state, "model.layers.{i}.self_attn.v_proj.weight", L),
+        "wo": _stack(state, "model.layers.{i}.self_attn.dense.weight", L),
+        "bq": _stack(state, "model.layers.{i}.self_attn.q_proj.bias", L,
+                     transpose=False),
+        "bk": _stack(state, "model.layers.{i}.self_attn.k_proj.bias", L,
+                     transpose=False),
+        "bv": _stack(state, "model.layers.{i}.self_attn.v_proj.bias", L,
+                     transpose=False),
+        "bo": _stack(state, "model.layers.{i}.self_attn.dense.bias", L,
+                     transpose=False),
+    }
+    p: Dict[str, Any] = {
+        "embed": {"tok": np.asarray(state["model.embed_tokens.weight"])},
+        "final_norm": {
+            "scale": np.asarray(state["model.final_layernorm.weight"]),
+            "bias": np.asarray(state["model.final_layernorm.bias"])},
+        "layers": {
+            "attn": attn,
+            "mlp": {
+                "w_up": _stack(state, "model.layers.{i}.mlp.fc1.weight", L),
+                "b_up": _stack(state, "model.layers.{i}.mlp.fc1.bias", L,
+                               transpose=False),
+                "w_down": _stack(state, "model.layers.{i}.mlp.fc2.weight", L),
+                "b_down": _stack(state, "model.layers.{i}.mlp.fc2.bias", L,
+                                 transpose=False),
+            },
+            "norm1": {"scale": _stack(
+                state, "model.layers.{i}.input_layernorm.weight", L,
+                transpose=False),
+                "bias": _stack(
+                state, "model.layers.{i}.input_layernorm.bias", L,
+                transpose=False)},
+        },
+    }
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T,
+                        "b": np.asarray(state["lm_head.bias"])}
     return p
 
 

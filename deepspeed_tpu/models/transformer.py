@@ -445,8 +445,11 @@ def logits_fn(cfg: TransformerConfig, params, hidden):
         return hidden @ params["embed"]["tok"].T
     w = params["lm_head"]["w"]
     if isinstance(w, dict):  # weight-only quantized head
-        return _mm(cfg, hidden, w)
-    return hidden @ w
+        out = _mm(cfg, hidden, w)
+    else:
+        out = hidden @ w
+    b = params["lm_head"].get("b")  # phi-style biased head
+    return out if b is None else out + b
 
 
 def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):

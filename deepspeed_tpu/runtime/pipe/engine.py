@@ -92,13 +92,13 @@ def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
         return x
 
     def head_loss(x, tok_labels):
+        from ...models.transformer import logits_fn
+
         h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
                   cfg.norm, cfg.norm_eps)
-        if cfg.tie_embeddings:
-            logits = h @ params["embed"]["tok"].T
-        else:
-            logits = h @ params["lm_head"]["w"]
-        logits = logits[:, :-1]
+        # logits_fn handles tied heads, phi-style head bias, and the
+        # dict-valued weight-quantized head uniformly
+        logits = logits_fn(cfg, params, h)[:, :-1]
         targets = tok_labels[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -384,3 +384,53 @@ def test_v2_engine_from_pretrained(tmp_path):
             nxt = m(ids).logits[0, -1].argmax().item()
             ids = torch.cat([ids, torch.tensor([[nxt]])], dim=1)
     assert out == [int(t) for t in ids[0, 6:].tolist()], (out, ids[0, 6:])
+
+
+def test_opt_parity(tmp_path):
+    """OPT: pre-norm decoder, +2 position offset, relu FFN, tied head."""
+    import torch
+    from transformers import OPTConfig, OPTForCausalLM
+
+    hf_cfg = OPTConfig(vocab_size=90, hidden_size=32, ffn_dim=64,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       max_position_embeddings=64, do_layer_norm_before=True,
+                       word_embed_proj_dim=32)
+    torch.manual_seed(3)
+    m = OPTForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.position == "learned" and cfg.activation == "relu"
+    cfg.attn_impl = "xla"
+    ids = np.random.RandomState(8).randint(0, 90, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_phi_parity(tmp_path):
+    """Phi: parallel attn+MLP block, partial rotary, biased lm_head."""
+    import torch
+    from transformers import PhiConfig, PhiForCausalLM
+
+    hf_cfg = PhiConfig(vocab_size=88, hidden_size=32, intermediate_size=64,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       max_position_embeddings=64,
+                       partial_rotary_factor=0.5)
+    torch.manual_seed(4)
+    m = PhiForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.parallel_block and cfg.rotary_pct == 0.5
+    cfg.attn_impl = "xla"
+    ids = np.random.RandomState(9).randint(0, 88, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
