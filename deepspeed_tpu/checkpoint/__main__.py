@@ -58,7 +58,8 @@ def main(argv=None) -> int:
         family, _, size = args.model.partition(":")
         # only families the exporter has a name map for — anything else
         # would write a llama-layout checkpoint with the wrong model_type
-        supported = ("llama", "mistral", "qwen2", "mixtral", "gpt2")
+        supported = ("llama", "mistral", "qwen2", "mixtral", "gpt2",
+                     "opt", "phi", "falcon")
         if family not in supported:
             raise SystemExit(
                 f"to-hf supports families {supported}; got '{family}'")

@@ -9,7 +9,10 @@ export reduces to the inverse name map of ``hf_import`` plus a native
 safetensors writer — the result loads in ``transformers.from_pretrained``.
 
 Families: llama / mistral / qwen2 (rotate-half RoPE, same layout), mixtral
-(expert-stacked MoE), gpt2 (Conv1D, no transposes).
+(expert-stacked MoE), gpt2 (Conv1D, no transposes), opt (position offset
+re-added), phi (biased head), falcon (7b-style re-fused multi-query QKV).
+Unrepresentable states (PR-MoE residuals, untied gpt2 head, biased or
+grouped-KV falcon) are refused rather than silently dropped.
 """
 
 from __future__ import annotations
@@ -62,6 +65,12 @@ def export_hf_state(cfg, params: Dict[str, Any],
 
         return np.asarray(jax.device_get(tree))
 
+    if model_type == "opt":
+        return _export_opt(cfg, params, get)
+    if model_type == "phi":
+        return _export_phi(cfg, params, get)
+    if model_type == "falcon":
+        return _export_falcon(cfg, params, get)
     if model_type == "gpt2":
         if not cfg.tie_embeddings and "lm_head" in params:
             # GPT2LMHeadModel always ties lm_head to wte on load — an
@@ -148,6 +157,123 @@ def _export_gpt2(cfg, params, get) -> Dict[str, np.ndarray]:
     return host
 
 
+def _emit_stacked(host, get, tree, spec, fmt):
+    """Write stacked [L, ...] tensors to per-layer HF names: ``spec`` is
+    (hf_suffix, our_key, transpose) triples, ``fmt`` the name template."""
+    for hf, ours, transpose in spec:
+        for i, w in _unstack(get(tree[ours]), transpose=transpose):
+            host[fmt.format(i=i, hf=hf)] = w
+
+
+def _export_opt(cfg, params, get) -> Dict[str, np.ndarray]:
+    pre = "model.decoder"
+    pos = get(params["embed"]["pos"])
+    host = {
+        f"{pre}.embed_tokens.weight": get(params["embed"]["tok"]),
+        # re-add OPT's two padding-offset rows (dropped at import; zeros —
+        # they are only read for pad positions)
+        f"{pre}.embed_positions.weight": np.concatenate(
+            [np.zeros((2, pos.shape[1]), pos.dtype), pos]),
+        f"{pre}.final_layer_norm.weight": get(params["final_norm"]["scale"]),
+        f"{pre}.final_layer_norm.bias": get(params["final_norm"]["bias"]),
+    }
+    a, m = params["layers"]["attn"], params["layers"]["mlp"]
+    fmt = pre + ".layers.{i}.{hf}"
+    _emit_stacked(host, get, a, [
+        ("self_attn.q_proj.weight", "wq", True),
+        ("self_attn.k_proj.weight", "wk", True),
+        ("self_attn.v_proj.weight", "wv", True),
+        ("self_attn.out_proj.weight", "wo", True),
+        ("self_attn.q_proj.bias", "bq", False),
+        ("self_attn.k_proj.bias", "bk", False),
+        ("self_attn.v_proj.bias", "bv", False),
+        ("self_attn.out_proj.bias", "bo", False)], fmt)
+    _emit_stacked(host, get, m, [
+        ("fc1.weight", "w_up", True), ("fc1.bias", "b_up", False),
+        ("fc2.weight", "w_down", True), ("fc2.bias", "b_down", False)], fmt)
+    for ln, hf in (("norm1", "self_attn_layer_norm"),
+                   ("norm2", "final_layer_norm")):
+        _emit_stacked(host, get, params["layers"][ln], [
+            (f"{hf}.weight", "scale", False), (f"{hf}.bias", "bias", False)],
+            fmt)
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["lm_head.weight"] = get(params["lm_head"]["w"]).T
+    return host
+
+
+def _export_phi(cfg, params, get) -> Dict[str, np.ndarray]:
+    host = {
+        "model.embed_tokens.weight": get(params["embed"]["tok"]),
+        "model.final_layernorm.weight": get(params["final_norm"]["scale"]),
+        "model.final_layernorm.bias": get(params["final_norm"]["bias"]),
+    }
+    a, m = params["layers"]["attn"], params["layers"]["mlp"]
+    fmt = "model.layers.{i}.{hf}"
+    _emit_stacked(host, get, a, [
+        ("self_attn.q_proj.weight", "wq", True),
+        ("self_attn.k_proj.weight", "wk", True),
+        ("self_attn.v_proj.weight", "wv", True),
+        ("self_attn.dense.weight", "wo", True),
+        ("self_attn.q_proj.bias", "bq", False),
+        ("self_attn.k_proj.bias", "bk", False),
+        ("self_attn.v_proj.bias", "bv", False),
+        ("self_attn.dense.bias", "bo", False)], fmt)
+    _emit_stacked(host, get, m, [
+        ("mlp.fc1.weight", "w_up", True), ("mlp.fc1.bias", "b_up", False),
+        ("mlp.fc2.weight", "w_down", True),
+        ("mlp.fc2.bias", "b_down", False)], fmt)
+    _emit_stacked(host, get, params["layers"]["norm1"], [
+        ("input_layernorm.weight", "scale", False),
+        ("input_layernorm.bias", "bias", False)], fmt)
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["lm_head.weight"] = get(params["lm_head"]["w"]).T
+        b = params["lm_head"].get("b")
+        # natively-trained phi-family models init only 'w'; PhiForCausalLM
+        # always has the bias parameter, so write zeros when absent
+        host["lm_head.bias"] = (get(b) if b is not None else
+                                np.zeros(cfg.vocab_size,
+                                         host["lm_head.weight"].dtype))
+    return host
+
+
+def _export_falcon(cfg, params, get) -> Dict[str, np.ndarray]:
+    if getattr(cfg, "use_bias", False):
+        raise ValueError(
+            "hf_export: biased falcon-family models have no 7b-style "
+            "checkpoint representation (falcon bias=false) — retrain "
+            "without use_bias or export another family")
+    if cfg.kv_heads != 1:
+        raise ValueError(
+            "hf_export: only multi-query (kv_heads=1) falcon models map "
+            "onto the 7b-style fused QKV layout; grouped-KV falcon "
+            "(new_decoder_architecture) is not supported")
+    L = cfg.n_layers
+    host = {
+        "transformer.word_embeddings.weight": get(params["embed"]["tok"]),
+        "transformer.ln_f.weight": get(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": get(params["final_norm"]["bias"]),
+    }
+    a, m = params["layers"]["attn"], params["layers"]["mlp"]
+    wq, wk, wv = get(a["wq"]), get(a["wk"]), get(a["wv"])
+    wo = get(a["wo"])
+    w_up, w_down = get(m["w_up"]), get(m["w_down"])
+    sc, bi = get(params["layers"]["norm1"]["scale"]), get(params["layers"]["norm1"]["bias"])
+    for i in range(L):
+        pre = f"transformer.h.{i}"
+        # re-fuse q|k|v rows ([out, in] orientation)
+        host[f"{pre}.self_attention.query_key_value.weight"] = \
+            np.concatenate([np.asarray(wq[i]).T, np.asarray(wk[i]).T,
+                            np.asarray(wv[i]).T])
+        host[f"{pre}.self_attention.dense.weight"] = np.asarray(wo[i]).T
+        host[f"{pre}.mlp.dense_h_to_4h.weight"] = np.asarray(w_up[i]).T
+        host[f"{pre}.mlp.dense_4h_to_h.weight"] = np.asarray(w_down[i]).T
+        host[f"{pre}.input_layernorm.weight"] = np.asarray(sc[i])
+        host[f"{pre}.input_layernorm.bias"] = np.asarray(bi[i])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["lm_head.weight"] = get(params["lm_head"]["w"]).T
+    return host
+
+
 def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
     if model_type == "gpt2":
         return {"model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
@@ -156,6 +282,48 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
                 "n_positions": cfg.max_seq_len,
                 "n_inner": cfg.ffn_size,
                 "layer_norm_epsilon": cfg.norm_eps}
+    if model_type == "opt":
+        return {"model_type": "opt", "architectures": ["OPTForCausalLM"],
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.n_layers,
+                "num_attention_heads": cfg.n_heads,
+                "ffn_dim": cfg.ffn_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "do_layer_norm_before": True,
+                "word_embed_proj_dim": cfg.hidden_size,
+                "activation_function": ("relu" if cfg.activation == "relu"
+                                        else "gelu_new" if cfg.activation == "gelu"
+                                        else "gelu"),
+                "tie_word_embeddings": bool(cfg.tie_embeddings)}
+    if model_type == "phi":
+        return {"model_type": "phi", "architectures": ["PhiForCausalLM"],
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.n_layers,
+                "num_attention_heads": cfg.n_heads,
+                "num_key_value_heads": cfg.kv_heads,
+                "intermediate_size": cfg.ffn_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "partial_rotary_factor": cfg.rotary_pct,
+                "layer_norm_eps": cfg.norm_eps,
+                "rope_theta": cfg.rope_theta,
+                "tie_word_embeddings": bool(cfg.tie_embeddings)}
+    if model_type == "falcon":
+        return {"model_type": "falcon",
+                "architectures": ["FalconForCausalLM"],
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.n_layers,
+                "num_attention_heads": cfg.n_heads,
+                "multi_query": True,
+                "num_kv_heads": 1,
+                "new_decoder_architecture": False,
+                "parallel_attn": True, "bias": False,
+                "max_position_embeddings": cfg.max_seq_len,
+                "layer_norm_epsilon": cfg.norm_eps,
+                "rope_theta": cfg.rope_theta,
+                "tie_word_embeddings": bool(cfg.tie_embeddings)}
     arch = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
             "qwen2": "Qwen2ForCausalLM",
             "mixtral": "MixtralForCausalLM"}.get(model_type,

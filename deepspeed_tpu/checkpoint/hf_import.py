@@ -156,8 +156,12 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             n_heads=c["num_attention_heads"],
             intermediate_size=c["ffn_dim"],
             max_seq_len=c.get("max_position_embeddings", 2048),
-            norm="layernorm", activation="gelu" if act.startswith("gelu")
-            else "relu", position="learned",
+            # HF OPT's 'gelu' is the exact erf form; only gpt2/phi use the
+            # tanh approximation ('gelu_new')
+            norm="layernorm",
+            activation=("relu" if act == "relu"
+                        else "gelu" if act == "gelu_new" else "gelu_exact"),
+            position="learned",
             causal=True, use_bias=True,
             tie_embeddings=bool(c.get("tie_word_embeddings", True)))
     if mtype == "phi":
@@ -179,6 +183,40 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             norm_eps=c.get("layer_norm_eps", 1e-5),
             rope_theta=float(c.get("rope_theta", 10000.0)),
             tie_embeddings=bool(c.get("tie_word_embeddings", False)))
+    if mtype == "falcon":
+        if c.get("new_decoder_architecture"):
+            raise ValueError(
+                "hf_import: falcon new_decoder_architecture (40b/180b "
+                "grouped-QKV, dual layernorm) is not supported yet — "
+                "7b-style checkpoints (multi_query, parallel_attn) are")
+        if not c.get("parallel_attn", True):
+            raise ValueError("hf_import: sequential-attention falcon "
+                             "variants are not supported by the "
+                             "parallel-block runtime")
+        if not c.get("multi_query", True):
+            # old-arch multi_query=false interleaves q/k/v PER HEAD inside
+            # the fused weight; the block split below would silently
+            # misread it
+            raise ValueError("hf_import: falcon multi_query=false "
+                             "(per-head-interleaved fused QKV) is not "
+                             "supported — 7b-style multi-query is")
+        if c.get("alibi"):
+            raise ValueError("hf_import: alibi-position falcon variants "
+                             "are not supported (runtime is rotary)")
+        if c.get("bias"):
+            raise ValueError("hf_import: biased falcon variants are not "
+                             "supported (7b-style bias=false is)")
+        nh = c["num_attention_heads"]
+        return TransformerConfig(
+            vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
+            n_layers=c["num_hidden_layers"], n_heads=nh, n_kv_heads=1,
+            intermediate_size=4 * c["hidden_size"],
+            max_seq_len=c.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu_exact", position="rope",
+            causal=True, parallel_block=True,
+            norm_eps=c.get("layer_norm_epsilon", 1e-5),
+            rope_theta=float(c.get("rope_theta", 10000.0)),
+            tie_embeddings=bool(c.get("tie_word_embeddings", True)))
     kv = c.get("num_key_value_heads", c["num_attention_heads"])
     cfg = TransformerConfig(
         vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
@@ -216,6 +254,8 @@ def import_hf_params(cfg, state: Dict[str, np.ndarray],
         return _import_opt(cfg, state)
     if model_type == "phi":
         return _import_phi(cfg, state)
+    if model_type == "falcon":
+        return _import_falcon(cfg, state)
     p: Dict[str, Any] = {
         "embed": {"tok": np.asarray(state["model.embed_tokens.weight"])},
         "final_norm": {"scale": np.asarray(state["model.norm.weight"])},
@@ -416,6 +456,48 @@ def _import_phi(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
     if not cfg.tie_embeddings:
         p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T,
                         "b": np.asarray(state["lm_head.bias"])}
+    return p
+
+
+def _import_falcon(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """FalconForCausalLM (7b-style): fused ``query_key_value`` rows are all
+    query heads, then the shared k head(s), then v — split into wq/wk/wv;
+    parallel attention+MLP shares the single input_layernorm."""
+    L, NH, KVH, D = cfg.n_layers, cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    wq, wk, wv = [], [], []
+    for i in range(L):
+        w = np.asarray(
+            state[f"transformer.h.{i}.self_attention.query_key_value.weight"])
+        q, k, v = np.split(w, [NH * D, NH * D + KVH * D])
+        wq.append(q.T)
+        wk.append(k.T)
+        wv.append(v.T)
+    p: Dict[str, Any] = {
+        "embed": {"tok": np.asarray(state["transformer.word_embeddings.weight"])},
+        "final_norm": {"scale": np.asarray(state["transformer.ln_f.weight"]),
+                       "bias": np.asarray(state["transformer.ln_f.bias"])},
+        "layers": {
+            "attn": {
+                "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+                "wo": _stack(
+                    state, "transformer.h.{i}.self_attention.dense.weight", L),
+            },
+            "mlp": {
+                "w_up": _stack(
+                    state, "transformer.h.{i}.mlp.dense_h_to_4h.weight", L),
+                "w_down": _stack(
+                    state, "transformer.h.{i}.mlp.dense_4h_to_h.weight", L),
+            },
+            "norm1": {"scale": _stack(
+                state, "transformer.h.{i}.input_layernorm.weight", L,
+                transpose=False),
+                "bias": _stack(
+                state, "transformer.h.{i}.input_layernorm.bias", L,
+                transpose=False)},
+        },
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in state:
+        p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T}
     return p
 
 

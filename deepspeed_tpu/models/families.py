@@ -158,7 +158,7 @@ def falcon_config(size: str = "7b", max_seq_len: int = 2048,
     return _apply(TransformerConfig(
         vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh,
         n_kv_heads=kvh, intermediate_size=ffn, max_seq_len=max_seq_len,
-        norm="layernorm", activation="gelu", position="rope",
+        norm="layernorm", activation="gelu_exact", position="rope",
         parallel_block=True), overrides)
 
 

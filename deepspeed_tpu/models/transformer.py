@@ -23,6 +23,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -375,7 +376,15 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
                 * _mm(cfg, h, m["w_up"], None, MODEL_AXIS),
                 m["w_down"], MODEL_AXIS, None)
     else:
-        act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+        # "gelu" = tanh approximation (HF gelu_new: gpt2/phi); "gelu_exact"
+        # = erf form (HF gelu: opt/falcon) — importing one as the other is
+        # a systematic ~3e-3 per-activation drift
+        if cfg.activation == "relu":
+            act = jax.nn.relu
+        elif cfg.activation == "gelu_exact":
+            act = functools.partial(jax.nn.gelu, approximate=False)
+        else:
+            act = jax.nn.gelu
         h = _mm(cfg, act(_mm(cfg, h, m["w_up"], None, MODEL_AXIS)
                          + (m["b_up"] if cfg.use_bias else 0)),
                 m["w_down"], MODEL_AXIS, None)

@@ -434,3 +434,74 @@ def test_phi_parity(tmp_path):
         want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
     got = _logits_ours(cfg, params, ids)
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_falcon_parity(tmp_path):
+    """Falcon 7b-style: fused multi-query QKV split, parallel attn+MLP on
+    one layernorm, tied head."""
+    import torch
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(vocab_size=80, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          multi_query=True, new_decoder_architecture=False,
+                          parallel_attn=True, bias=False,
+                          max_position_embeddings=64)
+    torch.manual_seed(5)
+    m = FalconForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.parallel_block and cfg.n_kv_heads == 1
+    cfg.attn_impl = "xla"
+    ids = np.random.RandomState(10).randint(0, 80, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+@pytest.mark.parametrize("family", ["opt", "phi", "falcon"])
+def test_export_new_families_transformers_load(tmp_path, family):
+    """Export->transformers.from_pretrained logit parity for opt/phi/falcon
+    (import the HF model, re-export ours, reload with transformers)."""
+    import torch
+    from transformers import (AutoModelForCausalLM, FalconConfig,
+                              FalconForCausalLM, OPTConfig, OPTForCausalLM,
+                              PhiConfig, PhiForCausalLM)
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    torch.manual_seed(12)
+    if family == "opt":
+        m = OPTForCausalLM(OPTConfig(
+            vocab_size=90, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=64,
+            do_layer_norm_before=True, word_embed_proj_dim=32))
+    elif family == "phi":
+        m = PhiForCausalLM(PhiConfig(
+            vocab_size=88, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, partial_rotary_factor=0.5))
+    else:
+        m = FalconForCausalLM(FalconConfig(
+            vocab_size=80, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True,
+            new_decoder_architecture=False, parallel_attn=True, bias=False,
+            max_position_embeddings=64))
+    m = m.eval()
+    src = tmp_path / "src"
+    m.save_pretrained(src)
+    cfg, params = load_hf_model(str(src), dtype=jnp.float32)
+    out = tmp_path / "exported"
+    save_hf_checkpoint(str(out), cfg, params, family)
+    hf2 = AutoModelForCausalLM.from_pretrained(str(out)).eval()
+    vocab = cfg.vocab_size
+    ids = np.random.RandomState(13).randint(0, vocab, (2, 9))
+    with torch.no_grad():
+        want = m(torch.tensor(ids)).logits.float().numpy()
+        got = hf2(torch.tensor(ids)).logits.float().numpy()
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
