@@ -202,6 +202,10 @@ def _export_opt(cfg, params, get) -> Dict[str, np.ndarray]:
 
 
 def _export_phi(cfg, params, get) -> Dict[str, np.ndarray]:
+    if not getattr(cfg, "parallel_block", False):
+        raise ValueError(
+            "hf_export: phi checkpoints are parallel-attention; a "
+            "sequential-block model's norm2 weights have no representation")
     host = {
         "model.embed_tokens.weight": get(params["embed"]["tok"]),
         "model.final_layernorm.weight": get(params["final_norm"]["scale"]),
@@ -237,6 +241,10 @@ def _export_phi(cfg, params, get) -> Dict[str, np.ndarray]:
 
 
 def _export_falcon(cfg, params, get) -> Dict[str, np.ndarray]:
+    if not getattr(cfg, "parallel_block", False):
+        raise ValueError(
+            "hf_export: falcon checkpoints are parallel-attention; a "
+            "sequential-block model's norm2 weights have no representation")
     if getattr(cfg, "use_bias", False):
         raise ValueError(
             "hf_export: biased falcon-family models have no 7b-style "

@@ -310,6 +310,7 @@ class DeepSpeedConfig:
     seed: int
     wall_clock_breakdown: bool
     memory_breakdown: bool
+    sanity_checks: bool
     dump_state: bool
     fp16: FP16Config
     bf16: BF16Config
@@ -353,6 +354,9 @@ class DeepSpeedConfig:
         self.seed = int(g("seed", 1234))
         self.wall_clock_breakdown = bool(g("wall_clock_breakdown", False))
         self.memory_breakdown = bool(g("memory_breakdown", False))
+        # reference is_sanity_checks_enabled (engine.py:1119): opt-in NaN
+        # guard — costs a host sync per step, so off by default
+        self.sanity_checks = bool(g("sanity_checks", False))
         self.dump_state = bool(g("dump_state", False))
         self.zero_allow_untested_optimizer = bool(g("zero_allow_untested_optimizer", False))
         self.gradient_accumulation_dtype = g("data_types", {}).get(

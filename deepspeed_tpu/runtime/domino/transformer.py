@@ -21,6 +21,7 @@ plain TP forward — only the schedule differs.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Optional
 
@@ -100,7 +101,12 @@ def _mlp_partial(cfg: TransformerConfig, lyr, xc):
     if cfg.activation == "swiglu":
         h = jax.nn.silu(h @ m["w_gate"]) * (h @ m["w_up"])
     else:
-        act = jax.nn.relu if cfg.activation == "relu" else jax.nn.gelu
+        if cfg.activation == "relu":
+            act = jax.nn.relu
+        elif cfg.activation == "gelu_exact":  # erf form (opt/falcon)
+            act = functools.partial(jax.nn.gelu, approximate=False)
+        else:
+            act = jax.nn.gelu
         h = act(h @ m["w_up"] + (m["b_up"] if cfg.use_bias else 0))
     return h @ m["w_down"]
 

@@ -796,6 +796,30 @@ class DeepSpeedTPUEngine:
             lambda x: jnp.zeros(x.shape, x.dtype, device=sharding_of(x)),
             tree)
 
+    def _sanity_check_maybe(self, loss) -> None:
+        """Reference is_sanity_checks_enabled (engine.py:1119): fail FAST on
+        a non-finite loss instead of training on garbage; the host sync it
+        costs is why this is opt-in.  Covers both train_batch and the
+        forward/backward/step loop."""
+        if not self.config.sanity_checks or loss is None:
+            return
+        lv = float(loss)
+        if not np.isfinite(lv):
+            raise FloatingPointError(
+                f"sanity_checks: non-finite loss {lv} at step "
+                f"{self.global_steps} (grad norm "
+                f"{float(self.state.global_grad_norm):.3g})")
+
+    def start_profiler_trace(self, log_dir: str) -> None:
+        """Start an XLA/TPU profiler trace (reference nvtx ranges +
+        torch.profiler story, utils/nvtx.py): the trace captures device
+        timelines, fusions, and memory, viewable in TensorBoard/XProf."""
+        jax.profiler.start_trace(log_dir)
+
+    def stop_profiler_trace(self) -> None:
+        jax.block_until_ready(self.state.step)  # flush in-flight steps
+        jax.profiler.stop_trace()
+
     def train_batch(self, batch=None, data_iter: Optional[Iterator] = None):
         """One full optimizer step (the native fused path).
 
@@ -841,6 +865,7 @@ class DeepSpeedTPUEngine:
             self._apply_step_offload()
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps or 1
+        self._sanity_check_maybe(loss)
         # dispatch is async: drain the device queue at reporting boundaries so
         # the throughput window [boundary, boundary] measures real wall time
         if self.global_steps % self.config.steps_per_print == 0 or \
@@ -894,6 +919,7 @@ class DeepSpeedTPUEngine:
                 self._repin_opt_state()
             self._acc_dirty = False  # buffer consumed and re-zeroed
             self.global_steps += 1
+            self._sanity_check_maybe(self._cached_loss)
             self.lr_scheduler.step()
             if self.config.wall_clock_breakdown:
                 jax.block_until_ready(self.state.step)

@@ -250,3 +250,43 @@ def test_checkpoint_reshard_across_zero_stage(tmp_path, devices8):
     a = jax.device_get(e0.state.params["layer_0"]["w"])
     b = jax.device_get(e3.state.params["layer_0"]["w"])
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_sanity_checks_catches_nonfinite_loss():
+    """Opt-in NaN guard (reference is_sanity_checks_enabled): a poisoned
+    param tree must raise at the step instead of training on garbage."""
+    engine = _make_engine({"sanity_checks": True})
+    engine.train_batch(random_batch(batch_size=16, gas=1))  # healthy step
+    import dataclasses
+
+    poisoned = jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), engine.state.params)
+    engine.state = dataclasses.replace(engine.state, params=poisoned)
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        engine.train_batch(random_batch(batch_size=16, gas=1))
+
+
+def test_profiler_trace_roundtrip(tmp_path):
+    """start/stop_profiler_trace writes an XLA trace directory."""
+    engine = _make_engine()
+    engine.start_profiler_trace(str(tmp_path))
+    engine.train_batch(random_batch(batch_size=16, gas=1))
+    engine.stop_profiler_trace()
+    import glob
+
+    assert glob.glob(str(tmp_path) + "/**/*.pb", recursive=True) or \
+        glob.glob(str(tmp_path) + "/**/*.json*", recursive=True) or \
+        glob.glob(str(tmp_path) + "/plugins/**", recursive=True)
+
+
+def test_sanity_checks_covers_incremental_loop():
+    """The guard must also fire in the forward/backward/step cadence."""
+    engine = _make_engine({"sanity_checks": True})
+    import dataclasses
+
+    engine.state = dataclasses.replace(
+        engine.state, params=jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, jnp.nan), engine.state.params))
+    with pytest.raises(FloatingPointError, match="non-finite loss"):
+        engine.backward(engine(random_batch(batch_size=16, gas=0)))
+        engine.step()
