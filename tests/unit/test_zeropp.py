@@ -193,3 +193,48 @@ def test_hpz_mesh_contract_enforced(devices8):
     initialize_topology(MeshConfig(data=8), jax.devices()[:8])
     with pytest.raises(ValueError, match="zero_hpz_partition_size"):
         _engine({"stage": 3, "zero_hpz_partition_size": 2}, {"data": 8})
+
+
+def test_stage3_gathers_stay_inside_layer_loop(devices8):
+    """Stage-3 memory property of the XLA-delegated param coordinator
+    (SURVEY §7 hard part #2, VERDICT r3 coverage row 16): the compiled
+    train step must gather params PER LAYER inside the scan loops — a
+    gather hoisted to top level would materialize every layer's params at
+    once, the exact failure the reference's prefetch coordinator exists to
+    prevent.  (Overlap timing needs hardware; the memory property is
+    structural and checkable here.)
+
+    gas=1 here, so the only while loops ARE the layer scans; gathers are
+    classified by REACHABILITY from the loop bodies (async-wrapped or
+    outlined collectives live in computations the body calls)."""
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e = _engine({"stage": 3}, {"data": 8})
+    hlo = _train_hlo(e)
+    # computation name -> text
+    comps, name = {}, None
+    for ln in hlo.splitlines():
+        m = re.match(r"^(?:ENTRY )?%?([\w\.\-]+) \(.*\{", ln)
+        if m:
+            name = m.group(1)
+            comps[name] = []
+        if name:
+            comps[name].append(ln)
+    comps = {k: "\n".join(v) for k, v in comps.items()}
+    bodies = set(re.findall(r"body=%([\w\.\-]+)", hlo))
+    assert bodies, "no scan loops in the compiled step?"
+    # everything transitively referenced from a loop body counts as inside
+    reachable = set(bodies)
+    frontier = list(bodies)
+    while frontier:
+        c = frontier.pop()
+        for other in comps:
+            if other not in reachable and f"%{other}" in comps.get(c, ""):
+                reachable.add(other)
+                frontier.append(other)
+    gather_comps = {k for k, v in comps.items() if "all-gather" in v}
+    assert gather_comps & reachable, \
+        "stage-3 step compiled with no per-layer gathers"
+    hoisted = gather_comps - reachable
+    assert not hoisted, (
+        f"all-gathers outside the layer loops in {sorted(hoisted)} — "
+        f"stage-3 would materialize all layers' params at once")
