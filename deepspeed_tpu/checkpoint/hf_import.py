@@ -13,9 +13,10 @@ header + raw little-endian buffer; no external dependency) and
 ``pytorch_model*.bin`` (via torch, CPU map).  Multi-shard index files of
 both flavors are followed.
 
-Families: llama / mistral / qwen2 / mixtral / gpt2 / opt / phi import with
-logit parity against ``transformers`` (bert is post-norm and intentionally
-unsupported — this runtime's transformer is pre-norm).
+Families: llama / mistral / qwen2 / mixtral / gpt2 / opt / phi / falcon /
+bert — all with logit parity against ``transformers`` (bert rides the
+transformer core's post-norm mode: norm after each residual add,
+embeddings LayerNorm, segment embeddings, full MLM prediction head).
 
 Conventions handled:
   * torch ``nn.Linear`` stores [out, in]; this runtime right-multiplies
@@ -183,6 +184,24 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             norm_eps=c.get("layer_norm_eps", 1e-5),
             rope_theta=float(c.get("rope_theta", 10000.0)),
             tie_embeddings=bool(c.get("tie_word_embeddings", False)))
+    if mtype == "bert":
+        act = c.get("hidden_act", "gelu")
+        if act not in ("gelu", "gelu_new", "relu"):
+            raise ValueError(f"hf_import: bert hidden_act '{act}' "
+                             f"not supported")
+        return TransformerConfig(
+            vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
+            n_layers=c["num_hidden_layers"],
+            n_heads=c["num_attention_heads"],
+            intermediate_size=c["intermediate_size"],
+            max_seq_len=c.get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation={"gelu": "gelu_exact", "gelu_new": "gelu",
+                        "relu": "relu"}[act],
+            position="learned", causal=False, use_bias=True,
+            tie_embeddings=True, post_norm=True,
+            type_vocab_size=c.get("type_vocab_size", 2),
+            norm_eps=c.get("layer_norm_eps", 1e-12))
     if mtype == "falcon":
         if c.get("new_decoder_architecture"):
             raise ValueError(
@@ -256,6 +275,8 @@ def import_hf_params(cfg, state: Dict[str, np.ndarray],
         return _import_phi(cfg, state)
     if model_type == "falcon":
         return _import_falcon(cfg, state)
+    if model_type == "bert":
+        return _import_bert(cfg, state)
     p: Dict[str, Any] = {
         "embed": {"tok": np.asarray(state["model.embed_tokens.weight"])},
         "final_norm": {"scale": np.asarray(state["model.norm.weight"])},
@@ -456,6 +477,73 @@ def _import_phi(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
     if not cfg.tie_embeddings:
         p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T,
                         "b": np.asarray(state["lm_head.bias"])}
+    return p
+
+
+def _import_bert(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """BertForMaskedLM: post-norm encoder — attention.output.LayerNorm is
+    the post-attention norm (our norm1), output.LayerNorm the post-FFN norm
+    (norm2); embeddings get word+position+token_type then LayerNorm; the
+    MLM head is dense+gelu+LayerNorm+tied-decoder+bias (cls.predictions)."""
+    L = cfg.n_layers
+    pre = "bert.encoder.layer"
+
+    def g(k):
+        return np.asarray(state[k])
+
+    p: Dict[str, Any] = {
+        "embed": {
+            "tok": g("bert.embeddings.word_embeddings.weight"),
+            "pos": g("bert.embeddings.position_embeddings.weight"),
+            "type": g("bert.embeddings.token_type_embeddings.weight"),
+            "norm": {"scale": g("bert.embeddings.LayerNorm.weight"),
+                     "bias": g("bert.embeddings.LayerNorm.bias")},
+        },
+        "layers": {
+            "attn": {
+                "wq": _stack(state, pre + ".{i}.attention.self.query.weight", L),
+                "wk": _stack(state, pre + ".{i}.attention.self.key.weight", L),
+                "wv": _stack(state, pre + ".{i}.attention.self.value.weight", L),
+                "wo": _stack(state, pre + ".{i}.attention.output.dense.weight", L),
+                "bq": _stack(state, pre + ".{i}.attention.self.query.bias", L,
+                             transpose=False),
+                "bk": _stack(state, pre + ".{i}.attention.self.key.bias", L,
+                             transpose=False),
+                "bv": _stack(state, pre + ".{i}.attention.self.value.bias", L,
+                             transpose=False),
+                "bo": _stack(state, pre + ".{i}.attention.output.dense.bias", L,
+                             transpose=False),
+            },
+            "mlp": {
+                "w_up": _stack(state, pre + ".{i}.intermediate.dense.weight", L),
+                "b_up": _stack(state, pre + ".{i}.intermediate.dense.bias", L,
+                               transpose=False),
+                "w_down": _stack(state, pre + ".{i}.output.dense.weight", L),
+                "b_down": _stack(state, pre + ".{i}.output.dense.bias", L,
+                                 transpose=False),
+            },
+            "norm1": {"scale": _stack(
+                state, pre + ".{i}.attention.output.LayerNorm.weight", L,
+                transpose=False),
+                "bias": _stack(
+                state, pre + ".{i}.attention.output.LayerNorm.bias", L,
+                transpose=False)},
+            "norm2": {"scale": _stack(
+                state, pre + ".{i}.output.LayerNorm.weight", L,
+                transpose=False),
+                "bias": _stack(
+                state, pre + ".{i}.output.LayerNorm.bias", L,
+                transpose=False)},
+        },
+    }
+    if "cls.predictions.transform.dense.weight" in state:
+        p["mlm_head"] = {
+            "dense_w": g("cls.predictions.transform.dense.weight").T,
+            "dense_b": g("cls.predictions.transform.dense.bias"),
+            "norm_scale": g("cls.predictions.transform.LayerNorm.weight"),
+            "norm_bias": g("cls.predictions.transform.LayerNorm.bias"),
+            "bias": g("cls.predictions.bias"),
+        }
     return p
 
 

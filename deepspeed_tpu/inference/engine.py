@@ -65,6 +65,11 @@ class InferenceEngine:
                             "(models.llama_model / gpt2_model / ...)")
         self.model = model
         self.cfg: TransformerConfig = model.config
+        if self.cfg.post_norm:
+            raise NotImplementedError(
+                "InferenceEngine serves causal decoders with a KV cache; "
+                "post_norm (BERT-style encoder) models have no generative "
+                "path — call transformer_forward/mlm_logits directly")
         self.topology = topology or (
             initialize_topology(MeshConfig(model=self.config.tp_size, data=-1))
             if self.config.tp_size > 1 else get_topology())

@@ -93,6 +93,10 @@ class InferenceEngineV2:
         # own COPY of the model config: quantization flags must not leak
         # into other engines sharing the model object
         self.cfg: TransformerConfig = dataclasses.replace(model.config)
+        if self.cfg.post_norm:
+            raise NotImplementedError(
+                "InferenceEngineV2 serves causal decoders; post_norm "
+                "(BERT-style encoder) models have no generative path")
         block = self.config.block
         if block.num_pages < block.max_pages_per_seq:
             raise ValueError(

@@ -29,21 +29,39 @@ def bert_config(size: str = "base", **overrides) -> TransformerConfig:
     cfg = TransformerConfig(
         vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh,
         intermediate_size=4 * h, max_seq_len=seq, norm="layernorm",
-        activation="gelu", position="learned", causal=False, use_bias=True,
-        tie_embeddings=True)
+        activation="gelu_exact", position="learned", causal=False,
+        use_bias=True, tie_embeddings=True, post_norm=True)
     for k, v in overrides.items():
         setattr(cfg, k, v)
     return cfg
 
 
+def mlm_logits(cfg: TransformerConfig, params, hidden):
+    """MLM prediction head.  With an imported/initialized ``mlm_head`` this
+    is BERT's full head (dense + gelu + LayerNorm + tied decoder + bias,
+    HF cls.predictions); otherwise the plain tied projection."""
+    from .transformer import _norm
+
+    mh = params.get("mlm_head")
+    if mh is not None:
+        h = jax.nn.gelu(hidden @ mh["dense_w"] + mh["dense_b"],
+                        approximate=False)
+        h = _norm(h, mh["norm_scale"], mh["norm_bias"], "layernorm",
+                  cfg.norm_eps)
+        return h @ params["embed"]["tok"].T + mh["bias"]
+    return hidden @ params["embed"]["tok"].T
+
+
 def mlm_loss(cfg: TransformerConfig, params, batch, rng=None):
     """Masked-LM cross entropy.  batch: dict(input_ids, labels,
-    optional attention_mask); label -100 = not predicted (HF convention)."""
+    optional attention_mask/token_type_ids); label -100 = not predicted
+    (HF convention)."""
     ids = batch["input_ids"]
     labels = batch["labels"]
     mask = batch.get("attention_mask")
-    hidden, aux = transformer_forward(cfg, params, ids, mask)
-    logits = hidden @ params["embed"]["tok"].T
+    hidden, aux = transformer_forward(cfg, params, ids, mask,
+                                      batch.get("token_type_ids"))
+    logits = mlm_logits(cfg, params, hidden)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     safe = jnp.maximum(labels, 0)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
@@ -59,7 +77,10 @@ def bert_model(size: str = "base", config: Optional[TransformerConfig] = None,
         loss_fn=lambda params, batch, rng: mlm_loss(cfg, params, batch, rng),
         partition_rules=transformer_partition_rules(cfg),
         apply_fn=lambda params, batch: transformer_forward(
-            cfg, params, batch["input_ids"] if isinstance(batch, dict) else batch)[0],
+            cfg, params,
+            batch["input_ids"] if isinstance(batch, dict) else batch,
+            batch.get("attention_mask") if isinstance(batch, dict) else None,
+            batch.get("token_type_ids") if isinstance(batch, dict) else None)[0],
         flops_per_sample=flops_per_token(cfg, cfg.max_seq_len) * cfg.max_seq_len,
     )
     spec.config = cfg

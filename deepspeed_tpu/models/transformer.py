@@ -56,6 +56,14 @@ class TransformerConfig:
     qkv_bias: bool = False  # bias on q/k/v only (qwen2 style)
     rotary_pct: float = 1.0  # fraction of head_dim under rope (phi/neox)
     parallel_block: bool = False  # x + attn(ln x) + mlp(ln x), shared ln (falcon/phi)
+    # post-norm (original-transformer/BERT ordering): norm AFTER each
+    # residual add — norm1(x + attn(x)), norm2(h + ffn(h)); embeddings get
+    # their own LayerNorm and there is no final norm.  Encoder-style: the
+    # generative engines (KV cache, pipeline, domino) reject it.
+    post_norm: bool = False
+    # segment-embedding table size for post-norm encoders (BERT
+    # type_vocab_size); 0 disables the table
+    type_vocab_size: int = 2
     dtype: Any = jnp.float32  # params storage dtype at init (engine recasts)
     remat: bool = False
     remat_policy: str = "nothing_saveable"
@@ -119,10 +127,19 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
 
     p: Dict[str, Any] = {
         "embed": {"tok": nrm(keys[0], V, H)},
-        "final_norm": {"scale": jnp.ones((H,), dt)},
     }
-    if cfg.norm == "layernorm":
-        p["final_norm"]["bias"] = jnp.zeros((H,), dt)
+    if not cfg.post_norm:
+        p["final_norm"] = {"scale": jnp.ones((H,), dt)}
+        if cfg.norm == "layernorm":
+            p["final_norm"]["bias"] = jnp.zeros((H,), dt)
+    else:
+        # post-norm models norm the EMBEDDINGS instead of the final hidden
+        p["embed"]["norm"] = {"scale": jnp.ones((H,), dt)}
+        if cfg.type_vocab_size > 0:
+            p["embed"]["type"] = nrm(jax.random.fold_in(keys[0], 1),
+                                     cfg.type_vocab_size, H)
+        if cfg.norm == "layernorm":
+            p["embed"]["norm"]["bias"] = jnp.zeros((H,), dt)
     if cfg.position == "learned":
         p["embed"]["pos"] = nrm(keys[1], cfg.max_seq_len, H)
     if not cfg.tie_embeddings:
@@ -331,7 +348,11 @@ def attn_qkv(cfg: TransformerConfig, layer, x, positions):
     NH, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     a = layer["attn"]
     qb = cfg.use_bias or cfg.qkv_bias
-    h = _norm(x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
+    # post-norm: projections read the RAW residual stream; the norm comes
+    # after the residual add in _block
+    h = x if cfg.post_norm else _norm(
+        x, layer["norm1"]["scale"], layer["norm1"].get("bias"), cfg.norm,
+        cfg.norm_eps)
     q = (_mm(cfg, h, a["wq"], None, MODEL_AXIS) + (a["bq"] if qb else 0)).reshape(B, T, NH, D)
     k = (_mm(cfg, h, a["wk"], None, MODEL_AXIS) + (a["bk"] if qb else 0)).reshape(B, T, KVH, D)
     v = (_mm(cfg, h, a["wv"], None, MODEL_AXIS) + (a["bv"] if qb else 0)).reshape(B, T, KVH, D)
@@ -350,6 +371,13 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
     XLA CSEs the duplicate _norm with the one inside attn_qkv."""
     ln = layer["norm1"] if cfg.parallel_block else layer["norm2"]
     h = _norm(x, ln["scale"], ln.get("bias"), cfg.norm, cfg.norm_eps)
+    h, aux = _ffn(cfg, layer, h, training)
+    return x + h, aux
+
+
+def _ffn(cfg: TransformerConfig, layer, h, training: bool = True):
+    """The raw FFN (no norm, no residual) — mlp_block wraps it pre-norm;
+    the post-norm block applies norm2 AFTER the residual add instead."""
     m = layer["mlp"]
     aux = jnp.asarray(0.0, jnp.float32)
     if cfg.moe_experts > 0:
@@ -368,7 +396,7 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
             res = _mm(cfg, act(_mm(cfg, h, m["res_w_up"], None, MODEL_AXIS)),
                       m["res_w_down"], MODEL_AXIS, None)  # plain dense MLP
             coef = jax.nn.softmax((h @ m["coef"]).astype(jnp.float32), -1)
-            h = (moe_out * coef[..., 0:1] + res * coef[..., 1:2]).astype(x.dtype)
+            h = (moe_out * coef[..., 0:1] + res * coef[..., 1:2]).astype(moe_out.dtype)
         else:
             h = moe_out
     elif cfg.activation == "swiglu":
@@ -390,7 +418,7 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
                 m["w_down"], MODEL_AXIS, None)
         if cfg.use_bias:
             h = h + m["b_down"]
-    return x + h, aux
+    return h, aux
 
 
 def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
@@ -413,16 +441,32 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
         # falcon/phi: attention and MLP both read the block input
         out, aux = mlp_block(cfg, layer, x)
         return out + attn_delta, aux
+    if cfg.post_norm:
+        # BERT/original-transformer ordering: norm AFTER each residual add
+        h = _norm(x + attn_delta, layer["norm1"]["scale"],
+                  layer["norm1"].get("bias"), cfg.norm, cfg.norm_eps)
+        ffn, aux = _ffn(cfg, layer, h)
+        out = _norm(h + ffn, layer["norm2"]["scale"],
+                    layer["norm2"].get("bias"), cfg.norm, cfg.norm_eps)
+        return out, aux
     return mlp_block(cfg, layer, x + attn_delta)
 
 
-def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None):
+def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
+                        token_type_ids=None):
     """[B, S] int tokens -> ([B, S, H] final hidden states, aux loss)."""
     x = params["embed"]["tok"][input_ids]
     B, S = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
     if cfg.position == "learned":
         x = x + params["embed"]["pos"][:S][None]
+    if "type" in params["embed"]:  # BERT segment embeddings
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(input_ids))
+        x = x + params["embed"]["type"][tt]
+    if "norm" in params["embed"]:  # post-norm models norm the embeddings
+        x = _norm(x, params["embed"]["norm"]["scale"],
+                  params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
     attn_fn = _pick_attn(cfg)
 
     block = lambda x, layer: _block(cfg, x, layer, positions, mask, attn_fn)  # noqa: E731
@@ -444,6 +488,9 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None):
             x, a = block(x, layer)
             aux = aux + a
 
+    if cfg.post_norm:
+        # each block already ends in norm2; a final norm would re-normalize
+        return x, aux
     hidden = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
                    cfg.norm, cfg.norm_eps)
     return hidden, aux
@@ -580,6 +627,10 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache,
     """Prefill or decode: run [B, T] tokens against/into the cache starting
     at ``position`` ([B] int32, same value per batch row for dense decode).
     Returns (logits [B, T, V], new_cache)."""
+    if cfg.post_norm:
+        raise NotImplementedError(
+            "post_norm models (BERT-style encoders) have no KV-cache "
+            "generative path; use transformer_forward + mlm_logits")
     x = params["embed"]["tok"][input_ids]
     B, T = input_ids.shape
     if cfg.position == "learned":

@@ -81,6 +81,8 @@ def per_module_breakdown(cfg, params, batch_size: int = 1,
         return T.mlp_block(cfg, layer, x)[0]
 
     def norm_fn(p, x):
+        if "final_norm" not in p:  # post-norm models end inside the block
+            return x
         return T._norm(x, p["final_norm"]["scale"],
                        p["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
 
@@ -101,7 +103,7 @@ def per_module_breakdown(cfg, params, batch_size: int = 1,
         ("__attn", attn_part, (layer0, x_s), attn_params, None),
         ("__mlp", mlp_part, (layer0, x_s), layer_params - attn_params, None),
         ("final_norm", norm_fn, (params, x_s),
-         count_params(params["final_norm"]), None),
+         count_params(params.get("final_norm", {})), None),
         ("lm_head", head_fn, (params, x_s),
          0 if cfg.tie_embeddings else count_params(params.get("lm_head", {})),
          None),

@@ -150,6 +150,9 @@ def domino_transformer_forward(cfg: TransformerConfig, params, input_ids,
     if cfg.n_heads % tp or cfg.kv_heads % tp:
         raise ValueError(f"n_heads ({cfg.n_heads}) and kv_heads ({cfg.kv_heads}) "
                          f"must divide the TP degree {tp}")
+    if cfg.post_norm:
+        raise ValueError("Domino covers pre-norm decoder blocks; post_norm "
+                         "(encoder-style) models are unsupported")
     if cfg.moe_experts > 0:
         raise ValueError("Domino covers dense blocks; route MoE through "
                          "moe/sharded_moe expert parallelism instead")

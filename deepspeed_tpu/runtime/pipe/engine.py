@@ -155,6 +155,9 @@ def pipelined_causal_lm(cfg: TransformerConfig, num_microbatches: int = 4,
     The engine uses it like any model; ``gradient_accumulation`` inside the
     pipeline = ``num_microbatches`` (set engine gas=1).
     """
+    if cfg.post_norm:
+        raise NotImplementedError("pipelined_causal_lm: post_norm "
+                                  "(encoder-style) models are unsupported")
     rules = pipeline_partition_rules(cfg)
 
     def loss_fn(params, batch, rng):

@@ -505,3 +505,38 @@ def test_export_new_families_transformers_load(tmp_path, family):
         want = m(torch.tensor(ids)).logits.float().numpy()
         got = hf2(torch.tensor(ids)).logits.float().numpy()
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_bert_mlm_parity(tmp_path):
+    """BertForMaskedLM: post-norm encoder + full MLM prediction head must
+    reproduce HF logits (bidirectional attention, segment embeddings,
+    embeddings LayerNorm, exact gelu)."""
+    import torch
+    from transformers import BertConfig, BertForMaskedLM
+
+    hf_cfg = BertConfig(vocab_size=100, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=64,
+                        type_vocab_size=2)
+    torch.manual_seed(14)
+    m = BertForMaskedLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+    from deepspeed_tpu.models.bert import mlm_logits
+    from deepspeed_tpu.models.transformer import transformer_forward
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.post_norm and not cfg.causal
+    cfg.attn_impl = "xla"
+    r = np.random.RandomState(11)
+    ids = r.randint(0, 100, (2, 12)).astype(np.int32)
+    tt = r.randint(0, 2, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64)),
+                 token_type_ids=torch.tensor(tt.astype(np.int64))
+                 ).logits.float().numpy()
+    hidden, _ = transformer_forward(cfg, params, jnp.asarray(ids),
+                                    token_type_ids=jnp.asarray(tt))
+    got = np.asarray(mlm_logits(cfg, params, hidden), np.float32)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)

@@ -228,7 +228,10 @@ def test_stage3_gathers_stay_inside_layer_loop(devices8):
     while frontier:
         c = frontier.pop()
         for other in comps:
-            if other not in reachable and f"%{other}" in comps.get(c, ""):
+            # full-token match: "%name" must not be followed by more name
+            # chars, or "%body" would falsely match a "%body.1" reference
+            if other not in reachable and re.search(
+                    rf"%{re.escape(other)}(?![\w.\-])", comps.get(c, "")):
                 reachable.add(other)
                 frontier.append(other)
     gather_comps = {k for k, v in comps.items() if "all-gather" in v}
