@@ -65,6 +65,8 @@ def export_hf_state(cfg, params: Dict[str, Any],
 
         return np.asarray(jax.device_get(tree))
 
+    if model_type == "bert":
+        return _export_bert(cfg, params, get)
     if model_type == "opt":
         return _export_opt(cfg, params, get)
     if model_type == "phi":
@@ -163,6 +165,60 @@ def _emit_stacked(host, get, tree, spec, fmt):
     for hf, ours, transpose in spec:
         for i, w in _unstack(get(tree[ours]), transpose=transpose):
             host[fmt.format(i=i, hf=hf)] = w
+
+
+def _export_bert(cfg, params, get) -> Dict[str, np.ndarray]:
+    if not getattr(cfg, "post_norm", False):
+        raise ValueError(
+            "hf_export: bert checkpoints are post-norm; a pre-norm model "
+            "has no BERT representation")
+    if "type" not in params.get("embed", {}):
+        raise ValueError(
+            "hf_export: bert checkpoints carry token_type embeddings; a "
+            "model trained with type_vocab_size=0 has no representation")
+    if "mlm_head" not in params:
+        # BertForMaskedLM would random-init cls.predictions on load and
+        # produce garbage MLM logits with only a warning
+        raise ValueError(
+            "hf_export: this bert model has no mlm_head (plain tied "
+            "projection); BERT checkpoints need the full prediction head — "
+            "import one from HF or add an mlm_head before exporting")
+    host = {
+        "bert.embeddings.word_embeddings.weight": get(params["embed"]["tok"]),
+        "bert.embeddings.position_embeddings.weight": get(params["embed"]["pos"]),
+        "bert.embeddings.token_type_embeddings.weight": get(params["embed"]["type"]),
+        "bert.embeddings.LayerNorm.weight": get(params["embed"]["norm"]["scale"]),
+        "bert.embeddings.LayerNorm.bias": get(params["embed"]["norm"]["bias"]),
+    }
+    a, m = params["layers"]["attn"], params["layers"]["mlp"]
+    fmt = "bert.encoder.layer.{i}.{hf}"
+    _emit_stacked(host, get, a, [
+        ("attention.self.query.weight", "wq", True),
+        ("attention.self.key.weight", "wk", True),
+        ("attention.self.value.weight", "wv", True),
+        ("attention.output.dense.weight", "wo", True),
+        ("attention.self.query.bias", "bq", False),
+        ("attention.self.key.bias", "bk", False),
+        ("attention.self.value.bias", "bv", False),
+        ("attention.output.dense.bias", "bo", False)], fmt)
+    _emit_stacked(host, get, m, [
+        ("intermediate.dense.weight", "w_up", True),
+        ("intermediate.dense.bias", "b_up", False),
+        ("output.dense.weight", "w_down", True),
+        ("output.dense.bias", "b_down", False)], fmt)
+    for ln, hf in (("norm1", "attention.output.LayerNorm"),
+                   ("norm2", "output.LayerNorm")):
+        _emit_stacked(host, get, params["layers"][ln], [
+            (f"{hf}.weight", "scale", False), (f"{hf}.bias", "bias", False)],
+            fmt)
+    mh = params.get("mlm_head")
+    if mh is not None:
+        host["cls.predictions.transform.dense.weight"] = get(mh["dense_w"]).T
+        host["cls.predictions.transform.dense.bias"] = get(mh["dense_b"])
+        host["cls.predictions.transform.LayerNorm.weight"] = get(mh["norm_scale"])
+        host["cls.predictions.transform.LayerNorm.bias"] = get(mh["norm_bias"])
+        host["cls.predictions.bias"] = get(mh["bias"])
+    return host
 
 
 def _export_opt(cfg, params, get) -> Dict[str, np.ndarray]:
@@ -290,6 +346,21 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
                 "n_positions": cfg.max_seq_len,
                 "n_inner": cfg.ffn_size,
                 "layer_norm_epsilon": cfg.norm_eps}
+    if model_type == "bert":
+        return {"model_type": "bert", "architectures": ["BertForMaskedLM"],
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.n_layers,
+                "num_attention_heads": cfg.n_heads,
+                "intermediate_size": cfg.ffn_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "type_vocab_size": getattr(cfg, "type_vocab_size", 2),
+                # inverse of the import map: our "gelu" is HF's tanh
+                # approximation ("gelu_new"); "gelu_exact" is HF "gelu"
+                "hidden_act": {"gelu_exact": "gelu", "gelu": "gelu_new",
+                               "relu": "relu"}.get(cfg.activation, "gelu"),
+                "layer_norm_eps": cfg.norm_eps,
+                "tie_word_embeddings": True}
     if model_type == "opt":
         return {"model_type": "opt", "architectures": ["OPTForCausalLM"],
                 "vocab_size": cfg.vocab_size,

@@ -189,6 +189,11 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
         if act not in ("gelu", "gelu_new", "relu"):
             raise ValueError(f"hf_import: bert hidden_act '{act}' "
                              f"not supported")
+        if c.get("position_embedding_type", "absolute") != "absolute":
+            raise ValueError(
+                "hf_import: relative-position BERT variants "
+                "(position_embedding_type != absolute) are not supported — "
+                "their attention bias has no runtime counterpart")
         return TransformerConfig(
             vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
             n_layers=c["num_hidden_layers"],

@@ -44,8 +44,15 @@ def mlm_logits(cfg: TransformerConfig, params, hidden):
 
     mh = params.get("mlm_head")
     if mh is not None:
-        h = jax.nn.gelu(hidden @ mh["dense_w"] + mh["dense_b"],
-                        approximate=False)
+        # HF BertPredictionHeadTransform uses the CONFIGURED hidden_act,
+        # same as the FFN — not unconditional gelu
+        if cfg.activation == "relu":
+            act = jax.nn.relu
+        elif cfg.activation == "gelu_exact":
+            act = lambda x: jax.nn.gelu(x, approximate=False)  # noqa: E731
+        else:
+            act = jax.nn.gelu
+        h = act(hidden @ mh["dense_w"] + mh["dense_b"])
         h = _norm(h, mh["norm_scale"], mh["norm_bias"], "layernorm",
                   cfg.norm_eps)
         return h @ params["embed"]["tok"].T + mh["bias"]
@@ -69,11 +76,28 @@ def mlm_loss(cfg: TransformerConfig, params, batch, rng=None):
     return jnp.sum(nll * sel) / jnp.maximum(jnp.sum(sel), 1.0) + aux
 
 
+def init_bert_params(cfg: TransformerConfig, rng):
+    """Transformer core + the BERT MLM prediction head
+    (cls.predictions.transform dense+LayerNorm and the decoder bias) — the
+    head is part of BERT pretraining and of the HF checkpoint format."""
+    p = init_transformer_params(cfg, rng)
+    k = jax.random.fold_in(rng, 17)
+    H, dt = cfg.hidden_size, cfg.dtype
+    p["mlm_head"] = {
+        "dense_w": (jax.random.normal(k, (H, H)) * 0.02).astype(dt),
+        "dense_b": jnp.zeros((H,), dt),
+        "norm_scale": jnp.ones((H,), dt),
+        "norm_bias": jnp.zeros((H,), dt),
+        "bias": jnp.zeros((cfg.vocab_size,), dt),
+    }
+    return p
+
+
 def bert_model(size: str = "base", config: Optional[TransformerConfig] = None,
                **overrides) -> ModelSpec:
     cfg = config or bert_config(size, **overrides)
     spec = ModelSpec(
-        init_params=lambda rng: init_transformer_params(cfg, rng),
+        init_params=lambda rng: init_bert_params(cfg, rng),
         loss_fn=lambda params, batch, rng: mlm_loss(cfg, params, batch, rng),
         partition_rules=transformer_partition_rules(cfg),
         apply_fn=lambda params, batch: transformer_forward(

@@ -540,3 +540,34 @@ def test_bert_mlm_parity(tmp_path):
                                     token_type_ids=jnp.asarray(tt))
     got = np.asarray(mlm_logits(cfg, params, hidden), np.float32)
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_bert_export_roundtrip(tmp_path):
+    """BERT export: transformers reloads our re-export with identical MLM
+    logits (post-norm, segment embeddings, full prediction head)."""
+    import torch
+    from transformers import AutoModelForMaskedLM, BertConfig, BertForMaskedLM
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    hf_cfg = BertConfig(vocab_size=100, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=64, type_vocab_size=2)
+    torch.manual_seed(15)
+    m = BertForMaskedLM(hf_cfg).eval()
+    src = tmp_path / "src"
+    m.save_pretrained(src)
+    cfg, params = load_hf_model(str(src), dtype=jnp.float32)
+    out = tmp_path / "exported"
+    save_hf_checkpoint(str(out), cfg, params, "bert")
+    hf2 = AutoModelForMaskedLM.from_pretrained(str(out)).eval()
+    r = np.random.RandomState(16)
+    ids = r.randint(0, 100, (2, 10))
+    tt = r.randint(0, 2, (2, 10))
+    with torch.no_grad():
+        want = m(torch.tensor(ids), token_type_ids=torch.tensor(tt)
+                 ).logits.float().numpy()
+        got = hf2(torch.tensor(ids), token_type_ids=torch.tensor(tt)
+                  ).logits.float().numpy()
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
