@@ -306,11 +306,11 @@ def _export_falcon(cfg, params, get) -> Dict[str, np.ndarray]:
             "hf_export: biased falcon-family models have no 7b-style "
             "checkpoint representation (falcon bias=false) — retrain "
             "without use_bias or export another family")
-    if cfg.kv_heads != 1:
+    if cfg.kv_heads != 1 or getattr(cfg, "parallel_norms", 1) != 1:
         raise ValueError(
-            "hf_export: only multi-query (kv_heads=1) falcon models map "
-            "onto the 7b-style fused QKV layout; grouped-KV falcon "
-            "(new_decoder_architecture) is not supported")
+            "hf_export: only multi-query (kv_heads=1, single-norm) falcon "
+            "models map onto the 7b-style fused QKV layout; grouped-KV / "
+            "dual-norm falcon (new_decoder_architecture) is not supported")
     L = cfg.n_layers
     host = {
         "transformer.word_embeddings.weight": get(params["embed"]["tok"]),

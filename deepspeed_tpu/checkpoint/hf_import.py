@@ -208,16 +208,12 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             type_vocab_size=c.get("type_vocab_size", 2),
             norm_eps=c.get("layer_norm_eps", 1e-12))
     if mtype == "falcon":
-        if c.get("new_decoder_architecture"):
-            raise ValueError(
-                "hf_import: falcon new_decoder_architecture (40b/180b "
-                "grouped-QKV, dual layernorm) is not supported yet — "
-                "7b-style checkpoints (multi_query, parallel_attn) are")
         if not c.get("parallel_attn", True):
             raise ValueError("hf_import: sequential-attention falcon "
                              "variants are not supported by the "
                              "parallel-block runtime")
-        if not c.get("multi_query", True):
+        new_arch = bool(c.get("new_decoder_architecture"))
+        if not new_arch and not c.get("multi_query", True):
             # old-arch multi_query=false interleaves q/k/v PER HEAD inside
             # the fused weight; the block split below would silently
             # misread it
@@ -229,11 +225,18 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
                              "are not supported (runtime is rotary)")
         if c.get("bias"):
             raise ValueError("hf_import: biased falcon variants are not "
-                             "supported (7b-style bias=false is)")
+                             "supported (7b/40b-style bias=false is)")
         nh = c["num_attention_heads"]
+        # new arch defaults to separate ln_attn/ln_mlp; falcon-11B-style
+        # sets num_ln_in_parallel_attn=1 (single input_layernorm)
+        n_ln = int(c.get("num_ln_in_parallel_attn")
+                   or (2 if new_arch else 1))
         return TransformerConfig(
             vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
-            n_layers=c["num_hidden_layers"], n_heads=nh, n_kv_heads=1,
+            n_layers=c["num_hidden_layers"], n_heads=nh,
+            parallel_norms=n_ln,
+            # new arch (40b/180b): grouped KV; old arch: multi-query
+            n_kv_heads=c.get("num_kv_heads", nh) if new_arch else 1,
             intermediate_size=4 * c["hidden_size"],
             max_seq_len=c.get("max_position_embeddings", 2048),
             norm="layernorm", activation="gelu_exact", position="rope",
@@ -553,18 +556,34 @@ def _import_bert(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
 
 
 def _import_falcon(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
-    """FalconForCausalLM (7b-style): fused ``query_key_value`` rows are all
-    query heads, then the shared k head(s), then v — split into wq/wk/wv;
-    parallel attention+MLP shares the single input_layernorm."""
+    """FalconForCausalLM.  7b-style (old arch, multi-query): fused
+    ``query_key_value`` rows are all query heads, then the shared k/v —
+    block split; one shared ``input_layernorm``.  40b/180b-style (new
+    decoder architecture, detected by the ``ln_attn`` keys): rows are
+    GROUPED per kv-head as [q_1..q_{NH/KVH}, k, v], and the parallel
+    branches carry separate ``ln_attn``/``ln_mlp`` norms (mlp_block uses a
+    parallel layer's norm2 when present)."""
     L, NH, KVH, D = cfg.n_layers, cfg.n_heads, cfg.kv_heads, cfg.head_dim
     wq, wk, wv = [], [], []
     for i in range(L):
         w = np.asarray(
             state[f"transformer.h.{i}.self_attention.query_key_value.weight"])
-        q, k, v = np.split(w, [NH * D, NH * D + KVH * D])
+        # grouped per-kv-head layout [q_1..q_{NH/KVH}, k, v]; at KVH=1
+        # (old-arch multi-query) this coincides with the block layout, so
+        # ONE split covers every supported falcon flavor
+        g = w.reshape(KVH, NH // KVH + 2, D, w.shape[-1])
+        q = g[:, :-2].reshape(NH * D, -1)
+        k = g[:, -2].reshape(KVH * D, -1)
+        v = g[:, -1].reshape(KVH * D, -1)
         wq.append(q.T)
         wk.append(k.T)
         wv.append(v.T)
+    # config (not key-sniffing) decides the norm layout: a config/weights
+    # mismatch then fails loudly on a missing key instead of silently
+    # misreading (falcon-11B: new arch with ONE input_layernorm)
+    norm1_name = ("ln_attn" if getattr(cfg, "parallel_norms", 1) >= 2
+                  else "input_layernorm")
+    new_arch = getattr(cfg, "parallel_norms", 1) >= 2
     p: Dict[str, Any] = {
         "embed": {"tok": np.asarray(state["transformer.word_embeddings.weight"])},
         "final_norm": {"scale": np.asarray(state["transformer.ln_f.weight"]),
@@ -582,13 +601,19 @@ def _import_falcon(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
                     state, "transformer.h.{i}.mlp.dense_4h_to_h.weight", L),
             },
             "norm1": {"scale": _stack(
-                state, "transformer.h.{i}.input_layernorm.weight", L,
+                state, "transformer.h.{i}." + norm1_name + ".weight", L,
                 transpose=False),
                 "bias": _stack(
-                state, "transformer.h.{i}.input_layernorm.bias", L,
+                state, "transformer.h.{i}." + norm1_name + ".bias", L,
                 transpose=False)},
         },
     }
+    if new_arch:
+        p["layers"]["norm2"] = {
+            "scale": _stack(state, "transformer.h.{i}.ln_mlp.weight", L,
+                            transpose=False),
+            "bias": _stack(state, "transformer.h.{i}.ln_mlp.bias", L,
+                           transpose=False)}
     if not cfg.tie_embeddings and "lm_head.weight" in state:
         p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T}
     return p

@@ -56,6 +56,9 @@ class TransformerConfig:
     qkv_bias: bool = False  # bias on q/k/v only (qwen2 style)
     rotary_pct: float = 1.0  # fraction of head_dim under rope (phi/neox)
     parallel_block: bool = False  # x + attn(ln x) + mlp(ln x), shared ln (falcon/phi)
+    # norms in a parallel block: 1 = one shared input norm (falcon-7b/phi);
+    # 2 = separate attn/mlp norms (falcon-40b/180b ln_attn+ln_mlp)
+    parallel_norms: int = 1
     # post-norm (original-transformer/BERT ordering): norm AFTER each
     # residual add — norm1(x + attn(x)), norm2(h + ffn(h)); embeddings get
     # their own LayerNorm and there is no final norm.  Encoder-style: the
@@ -156,7 +159,9 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         "mlp": {},
         "norm1": {"scale": jnp.ones((L, H), dt)},
     }
-    if not cfg.parallel_block:  # falcon/phi share norm1 across both branches
+    # falcon-7b/phi share norm1 across both branches; falcon-40b-style
+    # parallel blocks (parallel_norms=2) carry separate attn/mlp norms
+    if not cfg.parallel_block or cfg.parallel_norms >= 2:
         layers["norm2"] = {"scale": jnp.ones((L, H), dt)}
     if cfg.moe_experts > 0:
         E = cfg.moe_experts
@@ -185,7 +190,7 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         layers["mlp"]["b_down"] = jnp.zeros((L, H), dt)
     if cfg.norm == "layernorm":
         layers["norm1"]["bias"] = jnp.zeros((L, H), dt)
-        if not cfg.parallel_block:
+        if "norm2" in layers:
             layers["norm2"]["bias"] = jnp.zeros((L, H), dt)
     p["layers"] = layers
     return p
@@ -366,10 +371,15 @@ def mlp_block(cfg: TransformerConfig, layer, x, training: bool = True):
     """norm2 + FFN (dense swiglu/gelu or MoE) with residual; returns
     (x + ffn(norm(x)), aux_loss).  Shared by training and inference paths.
 
-    parallel_block (falcon/phi) shares ONE input layernorm between the
-    attention and MLP branches — there is no norm2 in those checkpoints;
-    XLA CSEs the duplicate _norm with the one inside attn_qkv."""
-    ln = layer["norm1"] if cfg.parallel_block else layer["norm2"]
+    parallel_block (falcon-7b/phi) shares ONE input layernorm between the
+    attention and MLP branches — there is no norm2 in those checkpoints
+    (XLA CSEs the duplicate _norm with the one inside attn_qkv).  Falcon's
+    new decoder architecture (40b/180b) runs parallel branches with
+    SEPARATE norms (cfg.parallel_norms == 2: ln_attn/ln_mlp -> norm1/norm2)."""
+    if cfg.parallel_block and cfg.parallel_norms < 2:
+        ln = layer["norm1"]
+    else:
+        ln = layer["norm2"]
     h = _norm(x, ln["scale"], ln.get("bias"), cfg.norm, cfg.norm_eps)
     h, aux = _ffn(cfg, layer, h, training)
     return x + h, aux

@@ -571,3 +571,61 @@ def test_bert_export_roundtrip(tmp_path):
         got = hf2(torch.tensor(ids), token_type_ids=torch.tensor(tt)
                   ).logits.float().numpy()
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_falcon_new_arch_parity(tmp_path):
+    """Falcon 40b/180b-style (new decoder architecture): grouped-KV fused
+    QKV split and separate ln_attn/ln_mlp parallel norms."""
+    import torch
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(vocab_size=80, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_kv_heads=2, new_decoder_architecture=True,
+                          parallel_attn=True, bias=False,
+                          max_position_embeddings=64)
+    torch.manual_seed(21)
+    m = FalconForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.parallel_block and cfg.n_kv_heads == 2
+    assert "norm2" in params["layers"]  # ln_mlp imported
+    cfg.attn_impl = "xla"
+    ids = np.random.RandomState(17).randint(0, 80, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_falcon_11b_style_parity(tmp_path):
+    """Falcon2/11B-style: new decoder architecture (grouped KV) but a
+    SINGLE shared input_layernorm (num_ln_in_parallel_attn=1) — the
+    config, not key-sniffing, must pick both the split and the norms."""
+    import torch
+    from transformers import FalconConfig, FalconForCausalLM
+
+    hf_cfg = FalconConfig(vocab_size=80, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_kv_heads=2, new_decoder_architecture=True,
+                          num_ln_in_parallel_attn=1,
+                          parallel_attn=True, bias=False,
+                          max_position_embeddings=64)
+    torch.manual_seed(22)
+    m = FalconForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.parallel_norms == 1 and cfg.n_kv_heads == 2
+    assert "norm2" not in params["layers"]
+    cfg.attn_impl = "xla"
+    ids = np.random.RandomState(18).randint(0, 80, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
