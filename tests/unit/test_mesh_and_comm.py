@@ -155,3 +155,46 @@ def test_monitored_barrier_single_process():
 
     comm.monitored_barrier("t")  # no-op single host
     comm.monitored_barrier("t")  # reentrant under the same name
+
+
+def test_monitored_barrier_deferred_stamp_retirement(monkeypatch):
+    """KV-fallback barrier: each round rnd retires the process's own stamp
+    from round rnd - _MB_RETIRE_LAG at ENTRY (deleting at exit would race
+    slower peers into misreporting THIS process as missing); coordinator
+    memory stays bounded across timeout/retry loops (advisor r3)."""
+    import deepspeed_tpu.comm.comm as C
+
+    store = {}
+
+    class FakeClient:
+        # no wait_at_barrier attr -> the KV-store fallback path
+        def key_value_set(self, k, v):
+            store[k] = v
+
+        def blocking_key_value_get(self, k, timeout_ms):
+            if k in store:
+                return store[k]
+            raise RuntimeError("DEADLINE_EXCEEDED waiting for key")
+
+        def key_value_delete(self, k):
+            store.pop(k, None)
+
+    # patch the internals monitored_barrier consults for multi-process mode
+    monkeypatch.setattr(C.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(C.jax, "process_index", lambda: 0)
+    monkeypatch.setattr(
+        C.jax._src.distributed.global_state, "client", FakeClient(),
+        raising=False)
+    C._MB_ROUNDS.pop("ret", None)
+
+    # peer (rank 1) always pre-stamps, so every round succeeds
+    lag = C._MB_RETIRE_LAG
+    for rnd in range(lag + 3):
+        store[f"dstpu_mb/ret/{rnd}/1"] = "peer"
+        C.monitored_barrier("ret", timeout_s=1.0)
+        own = [k for k in store if k.endswith("/0")]
+        # own stamps live for at most _MB_RETIRE_LAG rounds
+        assert len(own) <= lag, (rnd, sorted(own))
+    # the oldest own stamps were retired
+    assert "dstpu_mb/ret/0/0" not in store
+    assert f"dstpu_mb/ret/{lag + 2}/0" in store
