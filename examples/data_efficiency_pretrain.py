@@ -51,7 +51,9 @@ def main() -> None:
           f"median len {np.median(out['seqlen']['index_to_metric']):.0f}, "
           f"rarity(sample 0) {rarity(corpus[0]):.1f}")
 
-    # 2) curriculum over the seqlen metric: cap doubles every 30 steps
+    # 2) curriculum over the seqlen metric: fixed_root schedule raises the
+    # cap from 16 toward MAX_SEQ over 90 steps (snapped to difficulty_step
+    # increments: 16,16,16,24,24,... on the first steps)
     sched = CurriculumScheduler(CurriculumConfig(
         min_difficulty=16, max_difficulty=MAX_SEQ, schedule_type="fixed_root",
         total_curriculum_step=90))
@@ -61,7 +63,7 @@ def main() -> None:
     engine, *_ = deepspeed_tpu.initialize(
         model=gpt2_model("tiny", max_seq_len=MAX_SEQ, vocab_size=VOCAB,
                          attn_impl="xla"),
-        config={"train_micro_batch_size_per_gpu": 8,
+        config={"train_micro_batch_size_per_gpu": 1,  # x dp(8) = 8 rows
                 "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
                 "zero_optimization": {"stage": 1}})
 
@@ -78,21 +80,24 @@ def main() -> None:
         idx = sampler.next_indices()
         lens = np.asarray([len(corpus[i]["input_ids"]) for i in idx])
         groups, lr_mults = batch_by_token_budget(lens, vb)
-        rows = [int(idx[j]) for j in groups[0]]
         cap = int(sched.get_difficulty(step))
-        losses = []
-        for lo in range(0, len(rows), 8):
-            chunk = rows[lo:lo + 8]
-            chunk = (chunk * 8)[:8]  # pad the tail by repetition
-            ids = np.zeros((1, 8, cap), np.int32)
-            for r, row in enumerate(chunk):
-                seq = corpus[row]["input_ids"][:cap]
-                ids[0, r, :len(seq)] = seq
-            losses.append(float(engine.train_batch(
-                {"input_ids": jnp.asarray(ids)})))
-        print(f"step {step}: difficulty cap {cap:3d}, {len(rows)} rows -> "
-              f"{len(losses)} micro-batches (vblr would scale lr "
-              f"x{lr_mults[0]:.2f}), mean loss {np.mean(losses):.3f}")
+        losses, n_rows = [], 0
+        for grp in groups:  # EVERY packed group trains
+            rows = [int(idx[j]) for j in grp]
+            n_rows += len(rows)
+            for lo in range(0, len(rows), 8):
+                chunk = rows[lo:lo + 8]
+                chunk = (chunk * 8)[:8]  # pad the tail by repetition
+                ids = np.zeros((1, 8, cap), np.int32)
+                for r, row in enumerate(chunk):
+                    seq = corpus[row]["input_ids"][:cap]
+                    ids[0, r, :len(seq)] = seq
+                losses.append(float(engine.train_batch(
+                    {"input_ids": jnp.asarray(ids)})))
+        print(f"step {step}: cap {cap:3d}, {n_rows} rows in {len(groups)} "
+              f"token-budget groups -> {len(losses)} micro-batches, vblr "
+              f"mults {min(lr_mults):.2f}..{max(lr_mults):.2f}, "
+              f"mean loss {np.mean(losses):.3f}")
 
 
 if __name__ == "__main__":
