@@ -142,6 +142,22 @@ def test_two_process_train_barrier_checkpoint(tmp_path, stage):
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
                 "zero_optimization": {"stage": stage}})
     engine.load_checkpoint(str(tmp_path / "ckpt"), "mp")
+    assert engine.global_steps == 10  # the workers' training step count
+    # the reloaded leaves must BYTE-match the workers' saved shards — a
+    # silently-skipped or misassembled leaf would still train finitely
+    from deepspeed_tpu.checkpoint.partitioned import _assemble
+
+    full = _assemble(str(tmp_path / "ckpt" / "mp"), prefix=".params")
+    import re as _re
+
+    for key, want in full.items():
+        cur = engine.state.params
+        parts = _re.findall(r"\['([^']+)'\]", key)
+        for p in parts:
+            cur = cur[p]
+        got = np.asarray(jax.device_get(cur))
+        np.testing.assert_allclose(got, want.reshape(got.shape), rtol=1e-6,
+                                   err_msg=key)
     loss = float(engine.train_batch(random_batch(batch_size=16, seed=3,
                                                  gas=1)))
     assert np.isfinite(loss)
