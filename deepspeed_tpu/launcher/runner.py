@@ -97,7 +97,14 @@ def build_launch_commands(hosts: "OrderedDict[str, int]", script: str,
         inner = f"cd {shlex.quote(os.getcwd())} && {envstr} " \
                 f"{shlex.quote(sys.executable)} -u {shlex.quote(script)} " + \
                 " ".join(shlex.quote(a) for a in script_args)
-        if n == 1 and host in ("localhost", "127.0.0.1"):
+        local = host in ("localhost", "127.0.0.1")
+        if local and all(h in ("localhost", "127.0.0.1") for h in hosts):
+            # ALL-local job (the reference's local num_gpus>1 launch):
+            # spawn directly, no sshd needed.  Mixed local/remote jobs ssh
+            # every rank so each gets the same clean login environment —
+            # a bash-spawned local rank inheriting the launcher's shell
+            # (XLA_FLAGS etc.) while remote ranks don't would desync the
+            # rendezvous topology.
             cmds.append(["bash", "-c", inner])
         else:
             cmds.append(["ssh", "-p", str(ssh_port), host, inner])
