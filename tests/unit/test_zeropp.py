@@ -241,3 +241,36 @@ def test_stage3_gathers_stay_inside_layer_loop(devices8):
     assert not hoisted, (
         f"all-gathers outside the layer loops in {sorted(hoisted)} — "
         f"stage-3 would materialize all layers' params at once")
+
+
+def test_stage3_gather_bytes_bounded(devices8):
+    """Wire-volume change-detector for stage-3: the compiled step's
+    all-gather output bytes, counted STATICALLY (once per HLO occurrence,
+    on this fixture's fixed 2-layer model), stay near the fwd+bwd ideal.
+    This is not exact wire accounting — loop-body gathers execute once per
+    scan trip — but a remat misconfiguration, duplicated gather sites, or
+    an accidental fp32 gather all move the static ratio far outside the
+    measured 2.54x (bound 0.5..3.5).  Tuple-typed outputs (XLA's
+    all-gather combiner) are summed element-wise."""
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    e = _engine({"stage": 3}, {"data": 8})
+    hlo = _train_hlo(e)
+    DT = {"bf16": 2, "f16": 2, "f32": 4, "f64": 8, "s8": 1, "u8": 1,
+          "s32": 4}
+
+    def shape_bytes(text):
+        return sum(int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
+                   * DT.get(dt, 4)
+                   for dt, dims in re.findall(r"([a-z][a-z0-9]*)\[([\d,]*)\]",
+                                              text))
+
+    total = 0
+    for ln in hlo.splitlines():
+        if re.search(r"= .*? all-gather(?:-done)?\(", ln) \
+                and "all-gather-start" not in ln:
+            total += shape_bytes(ln.split(" all-gather")[0])
+    pbytes = sum(l.size * 2 for l in jax.tree_util.tree_leaves(e.state.params))
+    ratio = total / pbytes
+    assert 0.5 < ratio < 3.5, (
+        f"stage-3 gather bytes {total} vs param bytes {pbytes} "
+        f"(ratio {ratio:.2f}) — expected ~2.5x static on this fixture")
