@@ -796,19 +796,49 @@ class DeepSpeedTPUEngine:
             lambda x: jnp.zeros(x.shape, x.dtype, device=sharding_of(x)),
             tree)
 
-    def _sanity_check_maybe(self, loss) -> None:
+    #: consecutive non-finite losses tolerated while the DYNAMIC fp16 loss
+    #: scaler is skipping steps: enough for a full backoff from 2^32 to the
+    #: floor; persistent NaN divergence skips forever and must still abort
+    _SANITY_MAX_SKIP_RUN = 50
+
+    def _skipped_steps_snapshot(self) -> Optional[int]:
+        """Pre-step skip count when the fp16 overflow tolerance applies
+        (dynamic scaling only — a static scale never recovers, so a
+        non-finite loss there is immediately fatal); None = no tolerance."""
+        if (self.config.sanity_checks and self.fp16_enabled
+                and float(self.config.fp16.loss_scale) == 0.0):
+            return int(self.state.skipped_steps)
+        return None
+
+    def _sanity_check_maybe(self, loss,
+                            skipped_before: Optional[int] = None) -> None:
         """Reference is_sanity_checks_enabled (engine.py:1119): fail FAST on
         a non-finite loss instead of training on garbage; the host sync it
         costs is why this is opt-in.  Covers both train_batch and the
-        forward/backward/step loop."""
+        forward/backward/step loop.
+
+        fp16 exception: an overflow step the dynamic-loss-scaler SKIPPED
+        (scale comes down, training recovers) is the mechanism working —
+        tolerated, but only for ``_SANITY_MAX_SKIP_RUN`` consecutive
+        non-finite losses: a diverged model NaNs (and therefore skips)
+        every step forever, and that must still abort."""
         if not self.config.sanity_checks or loss is None:
             return
         lv = float(loss)
-        if not np.isfinite(lv):
-            raise FloatingPointError(
-                f"sanity_checks: non-finite loss {lv} at step "
-                f"{self.global_steps} (grad norm "
-                f"{float(self.state.global_grad_norm):.3g})")
+        if np.isfinite(lv):
+            self._sanity_skip_run = 0
+            return
+        if (skipped_before is not None
+                and int(self.state.skipped_steps) > skipped_before):
+            self._sanity_skip_run = getattr(self, "_sanity_skip_run", 0) + 1
+            if self._sanity_skip_run <= self._SANITY_MAX_SKIP_RUN:
+                return  # overflow handled by the loss scaler
+        raise FloatingPointError(
+            f"sanity_checks: non-finite loss {lv} at step "
+            f"{self.global_steps} (grad norm "
+            f"{float(self.state.global_grad_norm):.3g}, "
+            f"consecutive tolerated skips "
+            f"{getattr(self, '_sanity_skip_run', 0)})")
 
     def start_profiler_trace(self, log_dir: str) -> None:
         """Start an XLA/TPU profiler trace (reference nvtx ranges +
@@ -842,6 +872,7 @@ class DeepSpeedTPUEngine:
         if self.flops_profiler is not None:
             self.flops_profiler.start_profile_maybe(self.global_steps, batch)
         self.tput_timer.start()
+        skipped_before = self._skipped_steps_snapshot()
         if self._acc_dirty:
             # abandoned incremental micro-step(s): reset the stale
             # accumulation so the fused path's still-zeros invariant holds
@@ -865,7 +896,7 @@ class DeepSpeedTPUEngine:
             self._apply_step_offload()
         self.global_steps += 1
         self.micro_steps += self.config.gradient_accumulation_steps or 1
-        self._sanity_check_maybe(loss)
+        self._sanity_check_maybe(loss, skipped_before)
         # dispatch is async: drain the device queue at reporting boundaries so
         # the throughput window [boundary, boundary] measures real wall time
         if self.global_steps % self.config.steps_per_print == 0 or \
@@ -911,6 +942,7 @@ class DeepSpeedTPUEngine:
         engine.py:2641)."""
         self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
+            skipped_before = self._skipped_steps_snapshot()
             if self.offload_optimizer is not None:
                 self._apply_step_offload()
             else:
@@ -919,7 +951,7 @@ class DeepSpeedTPUEngine:
                 self._repin_opt_state()
             self._acc_dirty = False  # buffer consumed and re-zeroed
             self.global_steps += 1
-            self._sanity_check_maybe(self._cached_loss)
+            self._sanity_check_maybe(self._cached_loss, skipped_before)
             self.lr_scheduler.step()
             if self.config.wall_clock_breakdown:
                 jax.block_until_ready(self.state.step)

@@ -290,3 +290,40 @@ def test_sanity_checks_covers_incremental_loop():
     with pytest.raises(FloatingPointError, match="non-finite loss"):
         engine.backward(engine(random_batch(batch_size=16, gas=0)))
         engine.step()
+
+
+def test_sanity_checks_tolerates_fp16_overflow_skip():
+    """A dynamic-loss-scale SKIPPED step (overflow handled, scale lowered)
+    is recovery in action — sanity_checks must not abort on it; a
+    non-finite loss WITHOUT a skip still raises."""
+    import dataclasses
+
+    engine = _make_engine({"fp16": {"enabled": True,
+                                    "initial_scale_power": 8},
+                           "sanity_checks": True})
+    engine.train_batch(random_batch(batch_size=16, gas=1))
+    # overflow step: skipped_steps advanced past the pre-step snapshot ->
+    # the non-finite loss is the scaler recovering, not garbage
+    engine.state = dataclasses.replace(
+        engine.state,
+        skipped_steps=engine.state.skipped_steps + 1)
+    before = int(engine.state.skipped_steps) - 1
+    engine._sanity_check_maybe(jnp.asarray(jnp.inf), before)  # no raise
+    # same loss with NO skip this step -> abort
+    with pytest.raises(FloatingPointError):
+        engine._sanity_check_maybe(jnp.asarray(jnp.inf),
+                                   int(engine.state.skipped_steps))
+    # legacy one-arg call: no tolerance, non-finite always aborts
+    with pytest.raises(FloatingPointError):
+        engine._sanity_check_maybe(jnp.asarray(jnp.nan))
+    # persistent divergence: skipping EVERY step runs out of tolerance
+    engine._sanity_skip_run = 0
+    with pytest.raises(FloatingPointError, match="consecutive"):
+        for _ in range(engine._SANITY_MAX_SKIP_RUN + 2):
+            engine.state = dataclasses.replace(
+                engine.state, skipped_steps=engine.state.skipped_steps + 1)
+            engine._sanity_check_maybe(
+                jnp.asarray(jnp.nan), int(engine.state.skipped_steps) - 1)
+    # a finite loss resets the run counter
+    engine._sanity_check_maybe(jnp.asarray(1.0), None)
+    assert engine._sanity_skip_run == 0

@@ -266,9 +266,13 @@ def test_stage3_gather_bytes_bounded(devices8):
 
     total = 0
     for ln in hlo.splitlines():
-        if re.search(r"= .*? all-gather(?:-done)?\(", ln) \
-                and "all-gather-start" not in ln:
+        if re.search(r"= .*? all-gather\(", ln):
+            # sync form: output type precedes the op
             total += shape_bytes(ln.split(" all-gather")[0])
+        elif re.search(r"= .*? all-gather-start\(", ln):
+            # async form: output is an (operand, result) tuple — count the
+            # result half only (the -done line just forwards it)
+            total += shape_bytes(ln.split(" all-gather-start")[0]) // 2
     pbytes = sum(l.size * 2 for l in jax.tree_util.tree_leaves(e.state.params))
     ratio = total / pbytes
     assert 0.5 < ratio < 3.5, (
