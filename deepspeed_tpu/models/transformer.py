@@ -663,11 +663,21 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache,
 
 
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
-    """6*N + attention flops per token (training fwd+bwd)."""
+    """6*N_active + attention flops per token (training fwd+bwd).
+
+    For MoE layers N_active counts the router plus only the ``top_k``
+    experts a token actually flows through — total expert params would
+    overstate MFU by experts/top_k on the MLP term (mixtral 8x: 4x).
+    """
+    mlp = cfg.hidden_size * cfg.ffn_size * (3 if cfg.activation == "swiglu" else 2)
+    if cfg.moe_experts > 0:
+        mlp = mlp * cfg.moe_top_k + cfg.hidden_size * cfg.moe_experts
+        if cfg.moe_use_residual:  # PR-MoE: dense res MLP + 2-way mixer
+            mlp += 2 * cfg.hidden_size * cfg.ffn_size + 2 * cfg.hidden_size
     n_params = (cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
                 + cfg.n_layers * (
                     cfg.hidden_size * cfg.head_dim * (cfg.n_heads + 2 * cfg.kv_heads)
                     + cfg.n_heads * cfg.head_dim * cfg.hidden_size
-                    + cfg.hidden_size * cfg.ffn_size * (3 if cfg.activation == "swiglu" else 2)))
+                    + mlp))
     attn = 12 * cfg.n_layers * cfg.hidden_size * seq_len
     return 6.0 * n_params + attn

@@ -138,3 +138,23 @@ def test_mics_mesh_and_sharding(devices8):
     ids = np.random.RandomState(0).randint(0, 256, (1, 8, SEQ)).astype(np.int32)
     loss = engine.train_batch({"input_ids": jnp.asarray(ids)})
     assert np.isfinite(float(loss))
+
+
+def test_flops_per_token_counts_active_experts_only():
+    """MFU denominator: a mixtral layer prices top_k experts + router, not
+    all experts (total-param pricing would overstate MoE MFU 4x at 8x/top2)."""
+    from deepspeed_tpu.models.mixtral import mixtral_config
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.models.transformer import flops_per_token
+
+    moe = mixtral_config("8x160m", max_seq_len=1024)
+    dense = llama_config("160m", max_seq_len=1024)
+    f_moe = flops_per_token(moe, 1024)
+    f_dense = flops_per_token(dense, 1024)
+    # same trunk; MoE adds (top_k - 1) extra expert MLPs + router per layer
+    mlp = moe.hidden_size * moe.ffn_size * 3
+    expect_extra = 6.0 * moe.n_layers * (
+        (moe.moe_top_k - 1) * mlp + moe.hidden_size * moe.moe_experts)
+    np.testing.assert_allclose(f_moe - f_dense, expect_extra, rtol=1e-6)
+    # and nowhere near total-expert pricing
+    assert f_moe < f_dense + 6.0 * moe.n_layers * 3 * mlp

@@ -258,21 +258,24 @@ def test_stage3_gather_bytes_bounded(devices8):
     DT = {"bf16": 2, "f16": 2, "f32": 4, "f64": 8, "s8": 1, "u8": 1,
           "s32": 4}
 
-    def shape_bytes(text):
-        return sum(int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
-                   * DT.get(dt, 4)
-                   for dt, dims in re.findall(r"([a-z][a-z0-9]*)\[([\d,]*)\]",
-                                              text))
+    def shapes_in(text):
+        return [int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
+                * DT.get(dt, 4)
+                for dt, dims in re.findall(r"([a-z][a-z0-9]*)\[([\d,]*)\]",
+                                           text)]
 
     total = 0
     for ln in hlo.splitlines():
         if re.search(r"= .*? all-gather\(", ln):
             # sync form: output type precedes the op
-            total += shape_bytes(ln.split(" all-gather")[0])
+            total += sum(shapes_in(ln.split(" all-gather")[0]))
         elif re.search(r"= .*? all-gather-start\(", ln):
-            # async form: output is an (operand, result) tuple — count the
-            # result half only (the -done line just forwards it)
-            total += shape_bytes(ln.split(" all-gather-start")[0]) // 2
+            # async form: output is an (operands..., results...) tuple —
+            # count only the result half (the second half of the shapes;
+            # a flat half-of-total-bytes would undercount, since each
+            # result is N-times its operand for an N-way gather)
+            ss = shapes_in(ln.split(" all-gather-start")[0])
+            total += sum(ss[len(ss) // 2:])
     pbytes = sum(l.size * 2 for l in jax.tree_util.tree_leaves(e.state.params))
     ratio = total / pbytes
     assert 0.5 < ratio < 3.5, (
