@@ -178,7 +178,11 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     if family == "mixtral":
         from deepspeed_tpu.models.mixtral import mixtral_model
 
-        model = mixtral_model(size, max_seq_len=seq, **over)
+        # dropless: the grouped-matmul MoE path — the capacity-factor
+        # default would drop overflow tokens and run dispatch einsums,
+        # a different algorithm than the top_k-priced MFU metric
+        model = mixtral_model(size, max_seq_len=seq, moe_drop_tokens=False,
+                              **over)
     elif family == "llama":
         model = llama_model(size, max_seq_len=seq, **over)
     else:

@@ -1,6 +1,8 @@
 """Tests: compressed comm, curriculum/data pipeline, compression, LoRA,
 eigenvalue."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -220,3 +222,45 @@ def test_structured_pruning_non_transformer_degrades_gracefully():
     out, sched = init_compression(params, comp, n_heads=4)
     assert not sched.head_prune.enabled
     np.testing.assert_allclose(np.asarray(out["w1"]), np.ones((8, 8)))
+
+
+def test_bench_sweep_tool_routing(tmp_path, monkeypatch):
+    """The sweep drives bench.py for train rungs and the named tool for
+    _tool rungs, with ambient DSTPU_BENCH_/DSTPU_IBENCH_ vars scrubbed so
+    a leaked export cannot silently reshape a rung."""
+    import importlib.util
+    import subprocess as sp
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "bench_sweep", os.path.join(repo, "tools", "bench_sweep.py"))
+    sweep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep)
+
+    calls = []
+
+    def fake_run(cmd, capture_output, text, env, timeout):
+        calls.append((cmd, env))
+
+        class R:
+            stdout = '{"value": 1, "unit": "x"}'
+            stderr = ""
+        return R()
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    monkeypatch.setattr(sweep, "subprocess", sp)
+    monkeypatch.setattr(sweep, "ROOT", str(tmp_path))
+    os.makedirs(tmp_path / "docs", exist_ok=True)
+    monkeypatch.setenv("DSTPU_BENCH_SIZE", "leaked")
+    monkeypatch.setenv("DSTPU_IBENCH_GEN", "leaked")
+    monkeypatch.setattr(sweep.sys, "argv", ["bench_sweep.py", "flagship",
+                                            "serving-160m"])
+    assert sweep.main() == 0
+    (cmd1, env1), (cmd2, env2) = calls
+    assert cmd1[1].endswith("bench.py")
+    assert env1["DSTPU_BENCH_SIZE"] == "160m"  # rung wins over ambient
+    assert "DSTPU_IBENCH_GEN" not in env1
+    assert cmd2[1].endswith(os.path.join("tools", "bench_inference.py"))
+    assert env2["DSTPU_IBENCH_GEN"] == "128"
+    assert "_tool" not in env2 and "DSTPU_BENCH_SIZE" not in env2

@@ -27,12 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-
-def _int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+from bench import _backend_usable, _int_env as _int, _pin_cpu
 
 
 def main() -> None:
@@ -88,15 +83,21 @@ def main() -> None:
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }
+    reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
+    if reason and jax.default_backend() == "cpu":
+        result["fallback_reason"] = reason
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    # same wedged-chip discipline as bench.py: probe the backend in a
+    # subprocess (a hung TPU lease hangs backend init uninterruptibly
+    # in-process) and fall back to a self-describing CPU run
     if "--cpu" in sys.argv:
-        # env var alone is not enough: a site plugin may have pinned
-        # jax_platforms already (and a wedged chip hangs backend init)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        _pin_cpu()
+    else:
+        usable, reason = _backend_usable()
+        if not usable:
+            os.environ["DSTPU_BENCH_FALLBACK_REASON"] = reason
+            _pin_cpu()
     main()

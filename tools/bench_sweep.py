@@ -42,6 +42,16 @@ RUNGS = {
     "moe-8x160m": {"DSTPU_BENCH_MODEL": "mixtral", "DSTPU_BENCH_SIZE": "8x160m",
                    "DSTPU_BENCH_SEQ": "1024", "DSTPU_BENCH_BS": "8",
                    "DSTPU_BENCH_STEPS": "10"},
+    # long-sequence MFU: the Ulysses headline regime (attention-heavy);
+    # remat + bf16 accumulation to fit seq=8k activations on one chip
+    "160m-seq8k": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "8192",
+                   "DSTPU_BENCH_BS": "2", "DSTPU_BENCH_STEPS": "10",
+                   "DSTPU_BENCH_REMAT": "1", "DSTPU_BENCH_ACC": "bf16"},
+    # serving: continuous-batching decode tok/s on the paged v2 engine
+    # (runs tools/bench_inference.py instead of bench.py)
+    "serving-160m": {"_tool": "bench_inference", "DSTPU_IBENCH_SIZE": "160m",
+                     "DSTPU_IBENCH_PROMPT": "512", "DSTPU_IBENCH_GEN": "128",
+                     "DSTPU_IBENCH_NREQ": "32"},
 }
 
 
@@ -57,13 +67,18 @@ def main() -> int:
         # ambient DSTPU_BENCH_* exports must not silently reshape a rung:
         # the rung definition + DSTPU_SWEEP_OVERRIDES are the only knobs
         ambient = {k: v for k, v in os.environ.items()
-                   if not k.startswith("DSTPU_BENCH_")}
-        env = {**ambient, **RUNGS[name], **overrides}
-        print(f"=== rung {name}: {RUNGS[name]}", file=sys.stderr, flush=True)
-        rec = {"rung": name, "env": RUNGS[name]}
+                   if not (k.startswith("DSTPU_BENCH_")
+                           or k.startswith("DSTPU_IBENCH_"))}
+        rung = dict(RUNGS[name])
+        tool = rung.pop("_tool", None)
+        env = {**ambient, **rung, **overrides}
+        script = os.path.join(ROOT, "tools", tool + ".py") if tool \
+            else os.path.join(ROOT, "bench.py")
+        print(f"=== rung {name}: {rung}", file=sys.stderr, flush=True)
+        rec = {"rung": name, "env": rung}
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.join(ROOT, "bench.py"), *args],
+                [sys.executable, script, *args],
                 capture_output=True, text=True, env=env, timeout=3600)
             line = (proc.stdout.strip().splitlines() or [""])[-1]
             try:
