@@ -49,16 +49,19 @@ def _backend_usable() -> tuple:
     in-process, so a hung TPU plugin would hang the benchmark itself; the
     subprocess is the only safe way to find out.
 
-    Returns ``(ok, reason)``: ``reason`` is "" when the backend is usable,
-    else a short description of why the bench is falling back to CPU — it
-    is recorded inside the JSON artifact so a CPU run can never masquerade
-    as a chip number.
+    Returns ``(ok, reason, backend)``: ``reason`` is "" when the backend is
+    usable, else a short description of why the bench is falling back to
+    CPU — it is recorded inside the JSON artifact so a CPU run can never
+    masquerade as a chip number.  ``backend`` is the platform name the
+    probe subprocess saw ("" when the probe failed) — the parent process
+    itself never initializes jax, so this is how it learns what hardware
+    the children will run on.
     """
     # Probe unless explicitly pinned to cpu: a site PJRT plugin can select a
     # TPU backend via jax.config even when JAX_PLATFORMS is unset, and the
     # subprocess (same sitecustomize) reproduces whatever main() would see.
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return True, ""
+        return True, "", "cpu"
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((128, 128), jnp.bfloat16); "
             "x = (x @ x); "
@@ -91,7 +94,8 @@ def _backend_usable() -> tuple:
                                   capture_output=True, text=True,
                                   timeout=_PROBE_TIMEOUT_S)
             if proc.returncode == 0:
-                return True, ""
+                out = proc.stdout.split()
+                return True, "", (out[-1] if out else "")
             err = proc.stderr[-2000:]
         except subprocess.TimeoutExpired:
             timeouts += 1
@@ -117,7 +121,7 @@ def _backend_usable() -> tuple:
               if timeouts else f"backend probe failed: {err[-300:]}")
     print(f"bench: backend probe failed; falling back to cpu\n{err}",
           file=sys.stderr)
-    return False, reason
+    return False, reason, ""
 
 PEAK_BF16_FLOPS = {
     # per-chip peak bf16 FLOP/s
@@ -351,29 +355,129 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def _cpu_fallback(reason: str) -> int:
+    """Re-run the whole bench on CPU in a fresh process, recording why."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DSTPU_BENCH_FALLBACK_REASON=reason)
+    return subprocess.run([sys.executable, __file__, "--cpu"],
+                          env=env).returncode
+
+
+def _parent_ladder() -> int:
+    """Run each accelerator rung in a CHILD process with a hard timeout.
+
+    Round-4 field observation: a rung can HANG mid-run (bs=16 sat >400s
+    inside a dispatch the lease never served) — an in-process ladder then
+    hangs the whole benchmark and the round records no artifact at all.
+    The parent never initializes jax itself; it probes in a subprocess,
+    spawns one child per rung, kills a wedged rung at the budget, and
+    classifies the child's failure (OOM -> smaller bs; Pallas lowering ->
+    XLA attention; hang -> re-probe, and straight to the CPU fallback if
+    the kill wedged the lease).
+    """
+    size = os.environ.get("DSTPU_BENCH_SIZE", "160m")
+    seq = int(os.environ.get("DSTPU_BENCH_SEQ", 1024))
+    steps = int(os.environ.get("DSTPU_BENCH_STEPS", 20))
+    bs_pinned = bool(os.environ.get("DSTPU_BENCH_BS"))
+    ladder = ([int(os.environ["DSTPU_BENCH_BS"])] if bs_pinned
+              else [32, 16, 8])
+    # budget per rung: compile (~40s on the tunneled chip, more for big
+    # models) + warmup + timed steps; generous but finite
+    rung_timeout = _int_env("DSTPU_BENCH_RUNG_TIMEOUT", 900)
+    env_attn = os.environ.get("DSTPU_BENCH_ATTN")
+    # children get an EXPLICIT attn pin either way ("flash" = phase 1) so
+    # a child never runs its own in-process phase fallback
+    phases = (env_attn,) if env_attn else ("flash", "xla")
+    for attn in phases:
+        if attn == "xla" and not env_attn and not bs_pinned:
+            # xla attention needs more HBM than flash; dedup after capping
+            bs_ladder = list(dict.fromkeys(min(b, 8) for b in ladder))
+        else:
+            bs_ladder = ladder
+        mosaic_failure = False
+        for i, bs in enumerate(bs_ladder):
+            env = dict(os.environ, DSTPU_BENCH_SIZE=size,
+                       DSTPU_BENCH_SEQ=str(seq), DSTPU_BENCH_STEPS=str(steps),
+                       DSTPU_BENCH_BS=str(bs), DSTPU_BENCH_ATTN=attn)
+            try:
+                proc = subprocess.run([sys.executable, __file__, "--child"],
+                                      capture_output=True, text=True, env=env,
+                                      timeout=rung_timeout)
+            except subprocess.TimeoutExpired:
+                print(f"bench: rung bs={bs} attn={attn} hung "
+                      f">{rung_timeout}s; killed", file=sys.stderr)
+                # a killed client can wedge the tunnel lease — one quick
+                # probe decides between the next rung and the CPU fallback
+                os.environ["DSTPU_BENCH_PROBE_RETRIES"] = "0"
+                ok, _, _ = _backend_usable()
+                if not ok:
+                    return _cpu_fallback(
+                        f"rung bs={bs} hung >{rung_timeout}s and the kill "
+                        f"wedged the backend lease")
+                continue
+            lines = proc.stdout.strip().splitlines()
+            last = lines[-1] if lines else ""
+            if proc.returncode == 0 and last:
+                print(last)
+                return 0
+            # classify on the child's own error marker; stderr tail only
+            # as a last resort (e.g. the child was killed by a signal)
+            try:
+                err = json.loads(last)["child_error"]
+            except (ValueError, TypeError, KeyError):
+                err = proc.stderr[-2000:]
+            oom = "RESOURCE_EXHAUSTED" in err or "memory" in err.lower()
+            if oom and (i + 1 < len(bs_ladder)):
+                print(f"bench: bs={bs} OOM; trying bs={bs_ladder[i + 1]}",
+                      file=sys.stderr)
+                continue
+            if attn != "xla" and not env_attn and (
+                    "mosaic" in err.lower() or "pallas" in err.lower()):
+                print("bench: Pallas kernel failed to lower; retrying with "
+                      "attn_impl=xla", file=sys.stderr)
+                mosaic_failure = True
+                break
+            if oom:  # smallest rung: xla attention would only need MORE
+                return _cpu_fallback(
+                    f"OOM at the smallest rung (bs={bs}, attn={attn})")
+            return _cpu_fallback(f"mid-run failure on configured backend: "
+                                 f"{err[-300:]}")
+        if not mosaic_failure:
+            # every rung of this phase hung; phase 2 would hang the same
+            return _cpu_fallback("all accelerator rungs hung past the "
+                                 f"{rung_timeout}s budget")
+    return _cpu_fallback("Pallas lowering failed and the XLA-attention "
+                         "phase found no usable rung")
+
+
 if __name__ == "__main__":
-    if "--cpu" in sys.argv:
+    if "--child" in sys.argv:
+        # one pinned rung on the configured backend; a failure exits
+        # nonzero with a machine-readable marker as the LAST stdout line,
+        # so the parent classifies the exception message itself — not the
+        # raw stderr tail, where jax runtime log noise (e.g. a benign
+        # "memory_space" line) could masquerade as an OOM
+        if "--cpu" in sys.argv:
+            _pin_cpu()
+        try:
+            main()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps(
+                {"child_error": f"{type(e).__name__}: {str(e)[:500]}"}))
+            sys.exit(1)
+    elif "--cpu" in sys.argv:
         _pin_cpu()
         main()
     else:
-        usable, reason = _backend_usable()
+        usable, reason, backend = _backend_usable()
         if not usable:
             os.environ["DSTPU_BENCH_FALLBACK_REASON"] = reason
             _pin_cpu()
             main()
+        elif backend == "cpu":
+            main()  # no accelerator: in-process, nothing can wedge
         else:
-            try:
-                main()
-            except Exception as e:  # mid-run TPU failure: rerun on cpu
-                import traceback
-                traceback.print_exc()
-                print("bench: run failed on configured backend; retrying on "
-                      "cpu", file=sys.stderr)
-                env = dict(
-                    os.environ, JAX_PLATFORMS="cpu",
-                    DSTPU_BENCH_FALLBACK_REASON=(
-                        f"mid-run failure on configured backend: "
-                        f"{type(e).__name__}: {str(e)[:300]}"))
-                ret = subprocess.run([sys.executable, __file__, "--cpu"],
-                                     env=env)
-                sys.exit(ret.returncode)
+            sys.exit(_parent_ladder())

@@ -96,7 +96,7 @@ if __name__ == "__main__":
     if "--cpu" in sys.argv:
         _pin_cpu()
     else:
-        usable, reason = _backend_usable()
+        usable, reason, _backend = _backend_usable()
         if not usable:
             os.environ["DSTPU_BENCH_FALLBACK_REASON"] = reason
             _pin_cpu()

@@ -77,9 +77,14 @@ def main() -> int:
         print(f"=== rung {name}: {rung}", file=sys.stderr, flush=True)
         rec = {"rung": name, "env": rung}
         try:
+            # budget: the hang-proof ladder's worst case is
+            # 3 rungs x (rung_timeout + 240s post-hang probe) + a CPU
+            # fallback run — keep the rung budget small enough that the
+            # whole ladder plus fallback fits the rung-set timeout
+            env.setdefault("DSTPU_BENCH_RUNG_TIMEOUT", "600")
             proc = subprocess.run(
                 [sys.executable, script, *args],
-                capture_output=True, text=True, env=env, timeout=3600)
+                capture_output=True, text=True, env=env, timeout=5400)
             line = (proc.stdout.strip().splitlines() or [""])[-1]
             try:
                 rec["result"] = json.loads(line)
@@ -87,7 +92,7 @@ def main() -> int:
                 rec["error"] = (proc.stderr[-500:] or "no output")
         except subprocess.TimeoutExpired:
             # one hung rung must not discard the completed rungs' results
-            rec["error"] = "rung timed out after 3600s"
+            rec["error"] = "rung timed out after 5400s"
         out.append(rec)
         print(json.dumps(rec), file=sys.stderr)
         # write incrementally: hardware sweeps are long and interruptible
