@@ -204,13 +204,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
-         res, do):
+         bwd_block_q, bwd_block_k, res, do):
     q, k, v, out, lse = res
     bh, seq_q, d = q.shape
     bkv = k.shape[0]
     seq_k = k.shape[1]
-    bq = min(block_q, seq_q)
-    bk = min(block_k, seq_k)
+    # the fwd-optimal tiling need not be bwd-optimal (dq/dkv kernels keep
+    # different residents in VMEM); 0 = inherit the forward blocks.
+    # Clamp against the TRUE lengths (valid_*), not the padded seq_*: the
+    # wrapper's lcm padding used min(bwd_block, true_len), and the
+    # effective tile here must match it so every block divides the padding
+    bq = min(bwd_block_q or block_q, valid_q, seq_q)
+    bk = min(bwd_block_k or block_k, valid_k, seq_k)
     g = q_per_kv
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
@@ -263,25 +268,26 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10,
+                                                    11))
 def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k,
-                q_per_kv):
+                q_per_kv, bwd_block_q, bwd_block_k):
     out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
                   valid_k, q_per_kv)
     return out
 
 
 def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
-                    valid_k, q_per_kv):
+                    valid_k, q_per_kv, bwd_block_q, bwd_block_k):
     out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
                     valid_k, q_per_kv)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, valid_q, valid_k,
-                    q_per_kv, res, do):
+                    q_per_kv, bwd_block_q, bwd_block_k, res, do):
     return _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k,
-                q_per_kv, res, do)
+                q_per_kv, bwd_block_q, bwd_block_k, res, do)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -289,12 +295,17 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512, impl: str = "pallas"):
+                    block_q: int = 512, block_k: int = 512, impl: str = "pallas",
+                    bwd_block_q: int = 0, bwd_block_k: int = 0):
     """Public API on [B, S, NH, D] (matching models/transformer.py).
 
     GQA-native: k/v may carry KVH < NH heads (NH % KVH == 0) — each kv
     head is read once via the kernel's index map instead of materializing
     the NH/KVH-fold repeat in HBM.
+
+    ``bwd_block_q``/``bwd_block_k`` tile the BACKWARD kernels independently
+    of the forward (0 = inherit): the dq/dkv kernels keep different
+    residents in VMEM, so the fwd-optimal tiling need not be bwd-optimal.
 
     ``segment_mask``: optional [B, S_k] padding mask (1 = keep); falls back
     to the XLA path when given (masked flash variant: future work).
@@ -327,16 +338,19 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
     kh = k.transpose(0, 2, 1, 3).reshape(B * KVH, Sk, D)
     vh = v.transpose(0, 2, 1, 3).reshape(B * KVH, Sk, D)
     # pad to block multiples: pl.ds clamps out-of-bounds starts, which would
-    # silently mislabel columns in edge blocks; masks use the true lengths
+    # silently mislabel columns in edge blocks; masks use the true lengths.
+    # The padded length must be a multiple of BOTH the fwd and bwd tiles.
     bq = min(block_q, Sq)
     bk = min(block_k, Sk)
-    pad_q = (-Sq) % bq
-    pad_k = (-Sk) % bk
+    pad_q = (-Sq) % (math.lcm(bq, min(bwd_block_q, Sq)) if bwd_block_q
+                     else bq)
+    pad_k = (-Sk) % (math.lcm(bk, min(bwd_block_k, Sk)) if bwd_block_k
+                     else bk)
     if pad_q or pad_k:
         qh = jnp.pad(qh, ((0, 0), (0, pad_q), (0, 0)))
         kh = jnp.pad(kh, ((0, 0), (0, pad_k), (0, 0)))
         vh = jnp.pad(vh, ((0, 0), (0, pad_k), (0, 0)))
     out = _flash_bhsd(qh, kh, vh, scale, causal, block_q, block_k, Sq, Sk,
-                      q_per_kv)
+                      q_per_kv, bwd_block_q, bwd_block_k)
     out = out[:, :Sq]
     return out.reshape(B, NH, Sq, D).transpose(0, 2, 1, 3)

@@ -143,3 +143,25 @@ def test_random_shape_sweep_gradients():
             np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                        atol=1e-3, rtol=2e-3,
                                        err_msg=f"trial {trial} {nm}")
+
+
+@pytest.mark.parametrize("bwd_bq,bwd_bk", [(16, 16), (64, 32), (32, 64)])
+def test_gradients_with_independent_bwd_blocks(bwd_bq, bwd_bk):
+    """bwd tiling decoupled from fwd tiling (incl. non-divisible mixes
+    that force lcm padding) must not change any gradient."""
+    q, k, v = _qkv(b=1, s=48, nh=2, d=32)  # 48: not a multiple of 32
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, True) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=32, block_k=32,
+                                       bwd_block_q=bwd_bq,
+                                       bwd_block_k=bwd_bk) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")

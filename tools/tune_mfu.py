@@ -72,6 +72,13 @@ VARIANTS = {
     "160m-losschunk341": ("160m", 1024, 8, {"loss_chunk": 341}),
     "160m-bs32": ("160m", 1024, 32, {}),
     "160m-bs16": ("160m", 1024, 16, {}),
+    # bwd-tile decoupling: fwd stays 512/512 (the measured optimum), bwd
+    # kernels sweep their own tiles — targets the 27ms bwd/fwd slack in
+    # docs/PERF_NOTES.md's decomposition
+    "160m-bwd256x256": ("160m", 1024, 16, {"attn_impl": "flash_bwd256x256"}),
+    "160m-bwd256x512": ("160m", 1024, 16, {"attn_impl": "flash_bwd256x512"}),
+    "160m-bwd512x256": ("160m", 1024, 16, {"attn_impl": "flash_bwd512x256"}),
+    "160m-bwd1024x512": ("160m", 1024, 16, {"attn_impl": "flash_bwd1024x512"}),
     "1b-bs8-remat": ("1b", 1024, 8, {"remat": True}),
     "1b-bs4": ("1b", 1024, 4, {}),
 }
@@ -94,6 +101,14 @@ def main():
             return lambda q, k, v, causal, mask=None: flash_attention(
                 q, k, v, causal=causal, segment_mask=mask,
                 block_q=256, block_k=256)
+        if cfg.attn_impl.startswith("flash_bwd"):
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            bq, bk = map(int, cfg.attn_impl[len("flash_bwd"):].split("x"))
+            fn = lambda q, k, v, causal, mask=None: flash_attention(  # noqa: E731
+                q, k, v, causal=causal, segment_mask=mask,
+                bwd_block_q=bq, bwd_block_k=bk)
+            fn.handles_gqa = True  # GQA-native kernel, kv heads unrepeated
+            return fn
         return orig_pick(cfg)
 
     T._pick_attn = pick
