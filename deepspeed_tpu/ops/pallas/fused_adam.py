@@ -20,14 +20,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _adam_kernel(p_ref, g_ref, m_ref, v_ref, step_ref,
-                 p_out, m_out, v_out, *, lr, beta1, beta2, eps, weight_decay,
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                 p_out, m_out, v_out, *, beta1, beta2, eps, weight_decay,
                  adam_w_mode, bias_correction):
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
     m = m_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
-    step = step_ref[0]
+    step = sc_ref[0]  # SMEM scalars: [step, lr] — lr may be a traced
+    lr = sc_ref[1]    # schedule value, so it rides in memory, not in code
 
     if weight_decay != 0.0 and not adam_w_mode:  # L2 into grad (adam mode)
         g = g + weight_decay * p
@@ -60,7 +61,8 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
                       block: int = 1 << 18) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Flat-buffer Adam step.  All arrays 1-D of equal length; returns
     (new_params, new_exp_avg, new_exp_avg_sq).  ``step`` is the 1-based step
-    count (scalar int array)."""
+    count (scalar int array).  ``lr`` may be a Python float or a TRACED
+    scalar (e.g. a schedule value) — it is carried in SMEM either way."""
     n = params.size
     pad = (-n) % 128
     if pad:
@@ -73,10 +75,11 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
     grid = (pl.cdiv(rows, block_rows),)
 
     args = [a.reshape(shape2d) for a in (params, grads, exp_avg, exp_avg_sq)]
-    step_f = jnp.asarray(step, jnp.float32).reshape(1)
+    scalars = jnp.stack([jnp.asarray(step, jnp.float32).reshape(()),
+                         jnp.asarray(lr, jnp.float32).reshape(())])
 
     out = pl.pallas_call(
-        functools.partial(_adam_kernel, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        functools.partial(_adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
                           weight_decay=weight_decay, adam_w_mode=adam_w_mode,
                           bias_correction=bias_correction),
         grid=grid,
@@ -89,6 +92,6 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
             jax.ShapeDtypeStruct(shape2d, exp_avg_sq.dtype),
         ],
         interpret=jax.default_backend() != "tpu",
-    )(*args, step_f)
+    )(*args, scalars)
     p, m, v = (o.reshape(total)[:n] for o in out)
     return p, m, v

@@ -114,6 +114,18 @@ class DeepSpeedTPUEngine:
         else:
             self.optimizer, self.base_lr = build_optimizer(
                 config.optimizer.type, config.optimizer.params, self.lr_schedule)
+        if (getattr(self.optimizer, "direct_update", None) is not None
+                and self.topology.world_size > 1):
+            # the Pallas kernel updates a leaf's LOCAL layout; under a
+            # sharded (ZeRO) master it would force a gather — fall back to
+            # the XLA-fused optax path until the shard_map integration lands
+            logger.warning("optimizer fused_kernel is single-device only; "
+                           "falling back to the optax path on this "
+                           f"{self.topology.world_size}-device mesh")
+            self.optimizer, self.base_lr = build_optimizer(
+                config.optimizer.type,
+                {**config.optimizer.params, "fused_kernel": False},
+                self.lr_schedule)
         self.lr_scheduler = LRSchedulerShim(self.lr_schedule)
 
         # observability
@@ -479,8 +491,14 @@ class DeepSpeedTPUEngine:
 
         def do_update(operand):
             params, opt_state, grads = operand
-            updates, new_opt = self.optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            direct = getattr(self.optimizer, "direct_update", None)
+            if direct is not None:
+                # fused-kernel path: new params come straight out of the
+                # kernel, skipping the updates-delta + apply_updates passes
+                new_params, new_opt = direct(grads, opt_state, params)
+            else:
+                updates, new_opt = self.optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
             return new_params, new_opt, jnp.asarray(0, jnp.int32)
 
         def skip_update(operand):

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Unattended TPU measurement session, priority-ordered so an early wedge
+# still leaves the most important artifacts behind.  Run from repo root:
+#     bash tools/chip_session.sh >> docs/CHIP_SESSION.log 2>&1 &
+# Each stage appends to docs/CHIP_SESSION.log; bench_sweep also writes
+# docs/BENCH_SWEEP.json incrementally.
+set -u
+cd "$(dirname "$0")/.."
+
+stamp() { echo "=== [$(date -u +%H:%M:%S)] $*"; }
+
+stamp "chip session start"
+
+# 1. the headline artifact: flagship rung first, then the 1b shape
+stamp "bench_sweep flagship"
+timeout 2000 python tools/bench_sweep.py flagship
+stamp "bench_sweep 1b"
+timeout 2400 python tools/bench_sweep.py 1b
+
+# 2. decomposition + bwd-tile sweep on the flagship shape
+stamp "tune_mfu bwd tiles + fused adam"
+timeout 3600 python tools/tune_mfu.py 160m-bs16 160m-bwd256x256 \
+    160m-bwd256x512 160m-bwd512x256 160m-bwd1024x512 160m-fusedadam \
+    160m-xla-attn
+stamp "profile_step 160m bs16"
+timeout 1200 python tools/profile_step.py --size 160m --seq 1024 --bs 16 \
+    --outdir /tmp/dstpu_trace_160m --top 25
+
+# 3. the stage/offload/MoE/long-seq/serving rungs
+stamp "bench_sweep 160m-zero3"
+timeout 2000 python tools/bench_sweep.py 160m-zero3
+stamp "bench_sweep 160m-offload"
+timeout 2000 python tools/bench_sweep.py 160m-offload
+stamp "bench_sweep moe-8x160m"
+timeout 2400 python tools/bench_sweep.py moe-8x160m
+stamp "bench_sweep 160m-seq8k"
+timeout 2400 python tools/bench_sweep.py 160m-seq8k
+stamp "bench_sweep serving-160m"
+timeout 2400 python tools/bench_sweep.py serving-160m
+
+# 4. remaining tune variants (bs ladder, loss chunking, stock-kernel ref)
+stamp "tune_mfu remainder"
+timeout 3600 python tools/tune_mfu.py base-160m-flash512 160m-bs32 \
+    160m-losschunk341 160m-flash-jaxstock 1b-bs8-remat 1b-bs4
+
+stamp "chip session done"

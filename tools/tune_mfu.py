@@ -25,16 +25,24 @@ def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
     from deepspeed_tpu.models.llama import llama_model
     from deepspeed_tpu.models.transformer import flops_per_token
 
+    fused_opt = bool(model_overrides.pop("fused_opt", False))
     model = llama_model(size, max_seq_len=seq, **model_overrides)
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "optimizer": {"type": "FusedAdam" if fused_opt else "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.1,
+                                 **({"fused_kernel": True} if fused_opt else {})}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},
         "gradient_clipping": 1.0,
     }
     engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+    if fused_opt:
+        # on a multi-chip mesh the engine falls back to optax — that would
+        # silently A/B the identical path; fail loudly instead
+        assert getattr(engine.optimizer, "direct_update", None) is not None, \
+            "fused_kernel fell back to optax (multi-device mesh?)"
     rng = np.random.RandomState(0)
     vocab = model.config.vocab_size
 
@@ -79,6 +87,9 @@ VARIANTS = {
     "160m-bwd256x512": ("160m", 1024, 16, {"attn_impl": "flash_bwd256x512"}),
     "160m-bwd512x256": ("160m", 1024, 16, {"attn_impl": "flash_bwd512x256"}),
     "160m-bwd1024x512": ("160m", 1024, 16, {"attn_impl": "flash_bwd1024x512"}),
+    # single-pass Pallas Adam vs the XLA-fused optax chain (~10ms of the
+    # 195ms step is optimizer+clip in PERF_NOTES' decomposition)
+    "160m-fusedadam": ("160m", 1024, 16, {"fused_opt": True}),
     "1b-bs8-remat": ("1b", 1024, 8, {"remat": True}),
     "1b-bs4": ("1b", 1024, 4, {}),
 }
