@@ -114,18 +114,6 @@ class DeepSpeedTPUEngine:
         else:
             self.optimizer, self.base_lr = build_optimizer(
                 config.optimizer.type, config.optimizer.params, self.lr_schedule)
-        if (getattr(self.optimizer, "direct_update", None) is not None
-                and self.topology.world_size > 1):
-            # the Pallas kernel updates a leaf's LOCAL layout; under a
-            # sharded (ZeRO) master it would force a gather — fall back to
-            # the XLA-fused optax path until the shard_map integration lands
-            logger.warning("optimizer fused_kernel is single-device only; "
-                           "falling back to the optax path on this "
-                           f"{self.topology.world_size}-device mesh")
-            self.optimizer, self.base_lr = build_optimizer(
-                config.optimizer.type,
-                {**config.optimizer.params, "fused_kernel": False},
-                self.lr_schedule)
         self.lr_scheduler = LRSchedulerShim(self.lr_schedule)
 
         # observability
@@ -495,7 +483,29 @@ class DeepSpeedTPUEngine:
             if direct is not None:
                 # fused-kernel path: new params come straight out of the
                 # kernel, skipping the updates-delta + apply_updates passes
-                new_params, new_opt = direct(grads, opt_state, params)
+                if self.topology.world_size > 1:
+                    # Adam is elementwise: run the kernel on each device's
+                    # LOCAL master/grad shard via shard_map — no gather.
+                    # Replicated leaves (P()) update redundantly but
+                    # identically on every device.
+                    from jax.sharding import PartitionSpec as P
+
+                    # specs for the moments must come from the OPT_STATE
+                    # tree's own paths ("m/<leaf path>"), exactly as its
+                    # initial shardings were derived — reusing the param
+                    # tree's specs diverges whenever a partition rule
+                    # anchors on the path start (auto_tp's '^...$' rules),
+                    # and a mismatch reshards m/v through an all-to-all
+                    # every step
+                    pspecs = self.zero_plan.tree_specs(params, "master")
+                    sspecs = self.zero_plan.tree_specs(opt_state, "master")
+                    fn = jax.shard_map(direct, mesh=self.topology.mesh,
+                                       in_specs=(pspecs, sspecs, pspecs),
+                                       out_specs=(pspecs, sspecs),
+                                       check_vma=False)
+                    new_params, new_opt = fn(grads, opt_state, params)
+                else:
+                    new_params, new_opt = direct(grads, opt_state, params)
             else:
                 updates, new_opt = self.optimizer.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)

@@ -114,7 +114,13 @@ class DirectTransformation(NamedTuple):
     (new_params, new_state) straight from the kernel — the engine uses it
     to skip the updates-delta round trip optax's contract would force
     (delta = new_p - p costs one extra full-tree pass, apply_updates a
-    second)."""
+    second).
+
+    Layout caveat: both entry points run the kernel on the operands'
+    layout AS GIVEN.  Under a mesh with sharded (ZeRO) masters, call
+    ``direct_update`` through shard_map over the master specs (the engine
+    does, ``engine._apply_step_body``); calling plain ``update`` there
+    would make XLA gather every sharded leaf to feed the kernel."""
 
     init: Callable
     update: Callable
@@ -127,9 +133,10 @@ def pallas_fused_adam(schedule: Callable, b1: float, b2: float, eps: float,
     FusedAdam, ``csrc/adam/multi_tensor_adam.cu``): p/m/v/g are read once
     and p/m/v written once, blocked through VMEM, instead of trusting XLA
     to fuse the 6-op optax chain into one sweep.  The traced schedule
-    value rides in SMEM.  Single-device today: leaves are updated with
-    their local layout; sharded (ZeRO) masters fall back to the optax
-    path in the engine (shard_map integration is the follow-up)."""
+    value rides in SMEM.  ``direct_update`` works on the LOCAL layout of
+    each leaf; on sharded meshes the engine wraps it in shard_map over
+    the master specs, so each device updates its own ZeRO shard in place
+    (engine._apply_step_body) — Adam is elementwise, no collective."""
     import jax
 
     from ..ops.pallas.fused_adam import fused_adam_update

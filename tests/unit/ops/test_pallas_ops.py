@@ -139,24 +139,41 @@ def test_engine_fused_kernel_matches_optax_path():
                                    atol=2e-6, rtol=1e-4)
 
 
-def test_engine_fused_kernel_multi_device_fallback(devices8):
-    """On a sharded mesh the fused kernel falls back to optax (with a
-    warning) instead of gathering the ZeRO master onto one device."""
+@pytest.mark.parametrize("stage", [1, 3])
+def test_engine_fused_kernel_sharded_matches_optax(stage, devices8):
+    """On a sharded mesh the fused kernel runs on each device's LOCAL
+    master shard via shard_map (no gather); trained params must equal the
+    optax path's bit-for-bit modulo fp rounding."""
     import deepspeed_tpu
     from deepspeed_tpu.models.llama import llama_model
     from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
     import jax
 
-    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
-    model = llama_model("tiny", max_seq_len=16, attn_impl="xla")
-    engine, *_ = deepspeed_tpu.initialize(
-        model=model,
-        config={"train_micro_batch_size_per_gpu": 1,
-                "optimizer": {"type": "FusedAdam",
-                              "params": {"lr": 1e-3, "fused_kernel": True}},
-                "zero_optimization": {"stage": 1},
-                "mesh": {"data": 8}},
-        topology=deepspeed_tpu.get_topology())
-    assert getattr(engine.optimizer, "direct_update", None) is None
-    ids = np.random.RandomState(0).randint(0, 256, (1, 8, 16)).astype(np.int32)
-    assert np.isfinite(float(engine.train_batch({"input_ids": jnp.asarray(ids)})))
+    def train(fused):
+        initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+        model = llama_model("tiny", max_seq_len=16, attn_impl="xla")
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "FusedAdam",
+                                  "params": {"lr": 1e-3, "weight_decay": 0.01,
+                                             "fused_kernel": fused}},
+                    "gradient_clipping": 1.0,
+                    "zero_optimization": {"stage": stage},
+                    "mesh": {"data": 8}},
+            topology=deepspeed_tpu.get_topology())
+        if fused:
+            assert getattr(engine.optimizer, "direct_update", None) is not None
+        r = np.random.RandomState(0)
+        ids = r.randint(0, 256, (4, 1, 8, 16)).astype(np.int32)
+        losses = [float(engine.train_batch({"input_ids": jnp.asarray(b)}))
+                  for b in ids]
+        return losses, engine.state.params
+
+    l_ref, p_ref = train(False)
+    l_fused, p_fused = train(True)
+    np.testing.assert_allclose(l_fused, l_ref, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_fused),
+                    jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-6, rtol=1e-4)
