@@ -8,9 +8,12 @@ sequences release their pages immediately so new requests can start while
 others are mid-generation.
 
 The device work is two compiled programs (model_runner.py); everything
-here is host-side bookkeeping between steps.  Sampling (greedy /
-temperature) happens on host from the returned logits — batch sizes are
-small and this keeps the device programs sampling-free and cacheable.
+here is host-side bookkeeping between steps.  DECODE samples on device
+(greedy argmax / Gumbel-max temperature inside the jitted program) and
+returns only [max_seqs] token ids — fetching the full [max_seqs, vocab]
+logits every step through a tunneled device link costs ~1MB/step of
+transfer where 32 bytes suffice.  Prefill (once per admitted request)
+still returns logits and samples on host.
 """
 
 from __future__ import annotations
@@ -135,10 +138,24 @@ class InferenceEngineV2:
                                    block.trash_page, dtype=np.int32)
 
         cfg = self.cfg
-        self._decode = jax.jit(
-            lambda *a: paged_decode(cfg, *a), donate_argnums=(1,))
+
+        def _decode_and_sample(params, pools, last, pos, table, act, temps,
+                               key, ctr):
+            logits, pools = paged_decode(cfg, params, pools, last, pos,
+                                         table, act)
+            z = logits.astype(jnp.float32)
+            greedy = jnp.argmax(z, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                jax.random.fold_in(key, ctr),  # fold inside the program:
+                z / jnp.maximum(temps[:, None], 1e-6),  # no extra dispatch
+                axis=-1).astype(jnp.int32)
+            return jnp.where(temps > 0.0, sampled, greedy), pools
+
+        self._decode = jax.jit(_decode_and_sample, donate_argnums=(1,))
         self._prefill = jax.jit(
             lambda *a: paged_prefill(cfg, *a), donate_argnums=(1,))
+        self._sample_key = jax.random.PRNGKey(seed)
+        self._decode_steps = 0
 
     # -- request API ---------------------------------------------------------
     def put(self, request: RaggedRequest) -> int:
@@ -289,19 +306,24 @@ class InferenceEngineV2:
         last = np.zeros((B,), np.int32)
         pos = np.zeros((B,), np.int32)
         act = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
         for seq in active:
             last[seq.slot] = seq.tokens[-1]
             pos[seq.slot] = seq.length - 1
             act[seq.slot] = True
+            temps[seq.slot] = max(seq.temperature, 0.0)
 
-        logits, self._pools = self._decode(
+        self._decode_steps += 1
+        tokens, self._pools = self._decode(
             self.params, self._pools,
             jnp.asarray(last), jnp.asarray(pos),
-            jnp.asarray(self._page_table), jnp.asarray(act))
-        logits = np.asarray(logits, np.float32)
+            jnp.asarray(self._page_table), jnp.asarray(act),
+            jnp.asarray(temps), self._sample_key,
+            jnp.asarray(self._decode_steps, jnp.uint32))
+        tokens = np.asarray(tokens)
 
         for seq in active:
-            tok = self._sample(seq, logits[seq.slot])
+            tok = int(tokens[seq.slot])
             seq.tokens.append(tok)
             rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
             rec["tokens"].append(tok)
