@@ -302,6 +302,11 @@ class PipelineModule:
             group_trees.append(tuple(layers_p))
         tied = {k: fn(keys[len(self.layers) + i]) if fn is not None else ()
                 for i, (k, fn) in enumerate(tied_inits.items())}
+        return self._pack_group_trees(group_trees, tied)
+
+    def _pack_group_trees(self, group_trees, tied) -> Any:
+        """Per-stage non-tied layer tuples -> the params tree in this
+        module's representation (stacked or flat-packed)."""
         if self.stackable:
             stages = jax.tree_util.tree_map(
                 lambda *xs: jnp.stack(xs), *group_trees)
@@ -312,6 +317,59 @@ class PipelineModule:
         if self.stackable:
             return [(r"^stages/", P(PIPE_AXIS))]
         return [(r"^stages_flat/", P(PIPE_AXIS))]
+
+    # -- stage-count resharding (reference 3D reshape: checkpoint's
+    # reshape_3d_utils regroups pp stages; here the per-LAYER canonical
+    # view converts between any two stage partitionings) ---------------
+    def export_layer_params(self, params) -> List[Any]:
+        """Params in GLOBAL layer order (one entry per LayerSpec; ``None``
+        for tied layers, whose params live in the shared subtree)."""
+        out: List[Any] = []
+        for g in range(self.num_stages):
+            it = iter(self._stage_group_params(params, g))
+            for spec in self.groups[g]:
+                out.append(None if isinstance(spec, TiedLayerSpec)
+                           else next(it))
+        return out
+
+    def import_layer_params(self, layer_params: List[Any], tied) -> Any:
+        """Inverse of ``export_layer_params`` under THIS module's
+        partitioning (stage count / bounds may differ from the source)."""
+        if len(layer_params) != len(self.layers):
+            raise ValueError(f"{len(layer_params)} layer params for "
+                             f"{len(self.layers)} layers")
+        group_trees, idx = [], 0
+        for group in self.groups:
+            layers_p = []
+            for spec in group:
+                lp = layer_params[idx]
+                idx += 1
+                if isinstance(spec, TiedLayerSpec):
+                    continue
+                layers_p.append(lp)
+            group_trees.append(tuple(layers_p))
+        return self._pack_group_trees(group_trees, tied)
+
+    @staticmethod
+    def reshard_params(src: "PipelineModule", params, dst: "PipelineModule"):
+        """Convert ``params`` trained under ``src``'s stage partitioning to
+        ``dst``'s (e.g. pipe=2 -> pipe=4 on a resized cluster).  The layer
+        lists must describe the same model; tied params pass through."""
+        if len(src.layers) != len(dst.layers):
+            raise ValueError("src/dst pipeline modules have different "
+                             f"layer counts ({len(src.layers)} vs "
+                             f"{len(dst.layers)})")
+        for i, (a, b) in enumerate(zip(src.layers, dst.layers)):
+            ta, tb = isinstance(a, TiedLayerSpec), isinstance(b, TiedLayerSpec)
+            if ta != tb or (ta and a.key != b.key):
+                # a tie mismatch would silently swap a trained weight for
+                # the shared one (or desync every later layer's params)
+                raise ValueError(
+                    f"layer {i} tie structure differs between src and dst "
+                    f"({'tied:' + a.key if ta else 'untied'} vs "
+                    f"{'tied:' + b.key if tb else 'untied'})")
+        return dst.import_layer_params(src.export_layer_params(params),
+                                       params["tied"])
 
     # -- forward -------------------------------------------------------------
     def _apply_group(self, g: int, group_params, tied, x):
