@@ -52,6 +52,14 @@ RUNGS = {
     "serving-160m": {"_tool": "bench_inference", "DSTPU_IBENCH_SIZE": "160m",
                      "DSTPU_IBENCH_PROMPT": "512", "DSTPU_IBENCH_GEN": "128",
                      "DSTPU_IBENCH_NREQ": "32"},
+    # quantized serving: int8 KV pages + int8 weight-only matmuls — the
+    # FastGen-style memory-bound regime where quantization buys capacity
+    "serving-160m-int8": {"_tool": "bench_inference",
+                          "DSTPU_IBENCH_SIZE": "160m",
+                          "DSTPU_IBENCH_PROMPT": "512",
+                          "DSTPU_IBENCH_GEN": "128",
+                          "DSTPU_IBENCH_NREQ": "32",
+                          "DSTPU_IBENCH_KVQ": "1", "DSTPU_IBENCH_WQ": "8"},
 }
 
 
@@ -95,10 +103,19 @@ def main() -> int:
             rec["error"] = "rung timed out after 5400s"
         out.append(rec)
         print(json.dumps(rec), file=sys.stderr)
-        # write incrementally: hardware sweeps are long and interruptible
+        # write incrementally, MERGING over any previous sweep file: a
+        # session runs one rung per invocation, and each must extend the
+        # artifact, not clobber the earlier rungs' records
         path = os.path.join(ROOT, "docs", "BENCH_SWEEP.json")
+        merged = []
+        try:
+            with open(path) as f:
+                merged = [r for r in json.load(f)
+                          if r.get("rung") not in {o["rung"] for o in out}]
+        except (OSError, ValueError):
+            pass
         with open(path, "w") as f:
-            json.dump(out, f, indent=1)
+            json.dump(merged + out, f, indent=1)
     for rec in out:
         r = rec.get("result", {})
         print(f"{rec['rung']:>14}: "

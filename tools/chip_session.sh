@@ -37,6 +37,8 @@ stamp "bench_sweep 160m-seq8k"
 timeout 2400 python tools/bench_sweep.py 160m-seq8k
 stamp "bench_sweep serving-160m"
 timeout 2400 python tools/bench_sweep.py serving-160m
+stamp "bench_sweep serving-160m-int8"
+timeout 2400 python tools/bench_sweep.py serving-160m-int8
 
 # 4. remaining tune variants (bs ladder, loss chunking, stock-kernel ref)
 stamp "tune_mfu remainder"
