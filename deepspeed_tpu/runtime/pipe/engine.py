@@ -177,12 +177,33 @@ def pipelined_causal_lm(cfg: TransformerConfig, num_microbatches: int = 4,
 
         plan = ZeroShardingPlan(topo, None, rules)
         param_specs = plan.tree_specs(params, "param")
+        # PARTIAL-manual shard_map: only the pipe + batch axes are manual
+        # (the body ppermutes over pipe and pmeans over batch); the model
+        # and sequence axes stay AUTO — GSPMD keeps partitioning the
+        # attention/MLP matmuls from the params' own shardings and inserts
+        # the TP collectives inside each stage.  Without this split, a
+        # model-sharded wqkv arrives as a local half and the global-head
+        # reshape in the shared layer code is simply wrong.
+        manual = (PIPE_AXIS,) + BATCH_AXES
+
+        def _manual_only(spec):
+            ent = []
+            for e in spec:
+                axes = (e if isinstance(e, tuple) else (e,)) if e else ()
+                kept = tuple(a for a in axes if a in manual)
+                ent.append(kept if len(kept) > 1 else
+                           (kept[0] if kept else None))
+            return P(*ent)
+
+        manual_specs = jax.tree_util.tree_map(
+            _manual_only, param_specs,
+            is_leaf=lambda x: isinstance(x, P))
         body = functools.partial(_pipe_body, cfg=cfg, num_micro=num_microbatches,
                                  pp=pp)
         fn = jax.shard_map(
             body, mesh=topo.mesh,
-            in_specs=(param_specs, P(BATCH_AXES, None), P(BATCH_AXES, None)),
-            out_specs=P(), check_vma=False)
+            in_specs=(manual_specs, P(BATCH_AXES, None), P(BATCH_AXES, None)),
+            out_specs=P(), axis_names=set(manual), check_vma=False)
         return fn(params, ids, labels)
 
     spec = ModelSpec(

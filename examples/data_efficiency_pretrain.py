@@ -14,9 +14,17 @@ data analysis, runtime/data_pipeline):
 """
 
 import os
+import sys
 import tempfile
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # honor the env var even when a site plugin pre-pinned jax_platforms
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import numpy as np
 

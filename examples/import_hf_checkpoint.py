@@ -9,9 +9,17 @@ Works with llama / mistral / qwen2 / mixtral / gpt2 directories containing
 config.json plus model.safetensors[.index.json] or pytorch_model.bin.
 """
 
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # honor the env var even when a site plugin pre-pinned jax_platforms
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import numpy as np
 
