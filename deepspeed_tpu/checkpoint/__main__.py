@@ -59,7 +59,7 @@ def main(argv=None) -> int:
         # only families the exporter has a name map for — anything else
         # would write a llama-layout checkpoint with the wrong model_type
         supported = ("llama", "mistral", "qwen2", "mixtral", "gpt2",
-                     "opt", "phi", "falcon", "bert")
+                     "opt", "phi", "phi3", "falcon", "bert")
         if family not in supported:
             raise SystemExit(
                 f"to-hf supports families {supported}; got '{family}'")

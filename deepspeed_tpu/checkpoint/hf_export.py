@@ -73,6 +73,20 @@ def export_hf_state(cfg, params: Dict[str, Any],
         return _export_phi(cfg, params, get)
     if model_type == "falcon":
         return _export_falcon(cfg, params, get)
+    if model_type == "phi3":
+        # llama layout first, then RE-FUSE the projections the way HF
+        # Phi3 stores them: qkv_proj rows are [q | k | v], gate_up_proj
+        # rows are [gate | up] (exact inverse of the import split)
+        host = export_hf_state(cfg, params, "llama")
+        for i in range(cfg.n_layers):
+            pre = f"model.layers.{i}"
+            host[f"{pre}.self_attn.qkv_proj.weight"] = np.concatenate(
+                [host.pop(f"{pre}.self_attn.{n}_proj.weight")
+                 for n in ("q", "k", "v")], axis=0)
+            host[f"{pre}.mlp.gate_up_proj.weight"] = np.concatenate(
+                [host.pop(f"{pre}.mlp.gate_proj.weight"),
+                 host.pop(f"{pre}.mlp.up_proj.weight")], axis=0)
+        return host
     if model_type == "gpt2":
         if not cfg.tie_embeddings and "lm_head" in params:
             # GPT2LMHeadModel always ties lm_head to wte on load — an
@@ -404,7 +418,7 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
                 "rope_theta": cfg.rope_theta,
                 "tie_word_embeddings": bool(cfg.tie_embeddings)}
     arch = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
-            "qwen2": "Qwen2ForCausalLM",
+            "qwen2": "Qwen2ForCausalLM", "phi3": "Phi3ForCausalLM",
             "mixtral": "MixtralForCausalLM"}.get(model_type,
                                                  "LlamaForCausalLM")
     out = {"model_type": model_type, "architectures": [arch],
@@ -419,6 +433,10 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
     if model_type == "mixtral":
         out["num_local_experts"] = cfg.moe_experts
         out["num_experts_per_tok"] = cfg.moe_top_k
+    if model_type == "phi3":
+        # Phi3Config's default pad_token_id (32000) would exceed a small
+        # exported vocab and fail Embedding construction on load
+        out["pad_token_id"] = 0
     return out
 
 

@@ -13,8 +13,8 @@ header + raw little-endian buffer; no external dependency) and
 ``pytorch_model*.bin`` (via torch, CPU map).  Multi-shard index files of
 both flavors are followed.
 
-Families: llama / mistral / qwen2 / mixtral / gpt2 / opt / phi / falcon /
-bert — all with logit parity against ``transformers`` (bert rides the
+Families: llama / mistral / qwen2 / mixtral / gpt2 / opt / phi / phi3 /
+falcon / bert — all with logit parity against ``transformers`` (bert rides the
 transformer core's post-norm mode: norm after each residual add,
 embeddings LayerNorm, segment embeddings, full MLM prediction head).
 
@@ -259,6 +259,11 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
         cfg.moe_top_k = c.get("num_experts_per_tok", 2)
     if mtype == "qwen2":
         cfg.qkv_bias = True
+    if mtype == "phi3" and c.get("rope_scaling"):
+        # long-context phi3 variants use longrope (per-dim scale tables);
+        # only the plain-rope (4k) variants map onto our rope
+        raise ValueError("hf_import: phi3 rope_scaling (longrope) is "
+                         "unsupported; use a 4k-context phi3 variant")
     return cfg
 
 
@@ -285,6 +290,23 @@ def import_hf_params(cfg, state: Dict[str, np.ndarray],
         return _import_falcon(cfg, state)
     if model_type == "bert":
         return _import_bert(cfg, state)
+    if model_type == "phi3":
+        # phi3 is llama-shaped with FUSED projections: qkv_proj rows are
+        # [q | k | v] and gate_up_proj rows are [gate | up] (reference
+        # model_implementations/phi3 unfuses the same way); split them
+        # into llama names and fall through to the llama mapping
+        state = dict(state)
+        qd = cfg.n_heads * cfg.head_dim
+        kvd = cfg.n_kv_heads * cfg.head_dim
+        for i in range(L):
+            pre = f"model.layers.{i}"
+            qkv = np.asarray(state.pop(f"{pre}.self_attn.qkv_proj.weight"))
+            state[f"{pre}.self_attn.q_proj.weight"] = qkv[:qd]
+            state[f"{pre}.self_attn.k_proj.weight"] = qkv[qd:qd + kvd]
+            state[f"{pre}.self_attn.v_proj.weight"] = qkv[qd + kvd:]
+            gu = np.asarray(state.pop(f"{pre}.mlp.gate_up_proj.weight"))
+            state[f"{pre}.mlp.gate_proj.weight"] = gu[:cfg.ffn_size]
+            state[f"{pre}.mlp.up_proj.weight"] = gu[cfg.ffn_size:]
     p: Dict[str, Any] = {
         "embed": {"tok": np.asarray(state["model.embed_tokens.weight"])},
         "final_norm": {"scale": np.asarray(state["model.norm.weight"])},

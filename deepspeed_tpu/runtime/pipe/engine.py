@@ -177,14 +177,24 @@ def pipelined_causal_lm(cfg: TransformerConfig, num_microbatches: int = 4,
 
         plan = ZeroShardingPlan(topo, None, rules)
         param_specs = plan.tree_specs(params, "param")
-        # PARTIAL-manual shard_map: only the pipe + batch axes are manual
-        # (the body ppermutes over pipe and pmeans over batch); the model
-        # and sequence axes stay AUTO — GSPMD keeps partitioning the
-        # attention/MLP matmuls from the params' own shardings and inserts
-        # the TP collectives inside each stage.  Without this split, a
-        # model-sharded wqkv arrives as a local half and the global-head
-        # reshape in the shared layer code is simply wrong.
-        manual = (PIPE_AXIS,) + BATCH_AXES
+        # With TP (or SP) inside the stages, the shard_map goes PARTIAL-
+        # manual: only the pipe + batch axes are manual (the body
+        # ppermutes over pipe and pmeans over batch); the model/sequence
+        # axes stay AUTO — GSPMD keeps partitioning the attention/MLP
+        # matmuls from the params' own shardings and inserts the TP
+        # collectives inside each stage.  Under a fully manual map a
+        # model-sharded wqkv would arrive as a local half and the
+        # global-head reshape in the shared layer code would be wrong.
+        # Pure pipe x data stays FULLY manual: the partial-manual form
+        # trips an XLA CPU-backend crash for bf16 (AllReducePromotion,
+        # "invalid binary instruction opcode copy") even with the auto
+        # axes at size 1, and the fully manual form is field-proven there.
+        from ...parallel.mesh import MODEL_AXIS, SEQ_AXIS
+
+        tp_in_play = (topo.axis_size(MODEL_AXIS) > 1
+                      or topo.axis_size(SEQ_AXIS) > 1)
+        manual = ((PIPE_AXIS,) + BATCH_AXES if tp_in_play
+                  else tuple(topo.mesh.axis_names))
 
         def _manual_only(spec):
             ent = []

@@ -629,3 +629,82 @@ def test_falcon_11b_style_parity(tmp_path):
         want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
     got = _logits_ours(cfg, params, ids)
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_phi3_safetensors_parity(tmp_path):
+    """phi3: llama-shaped with FUSED qkv_proj / gate_up_proj — the split
+    must land every row in the right projection (an off-by-head split
+    shows up immediately as logit divergence)."""
+    import torch
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    hf_cfg = Phi3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, pad_token_id=0)  # default pad id (32000)
+    torch.manual_seed(3)
+    m = Phi3ForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.n_kv_heads == 2 and cfg.activation == "swiglu"
+    cfg.attn_impl = "xla"
+
+    ids = np.random.RandomState(3).randint(0, 96, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_phi3_longrope_rejected(tmp_path):
+    from deepspeed_tpu.checkpoint.hf_import import config_from_hf
+
+    c = {"model_type": "phi3", "vocab_size": 96, "hidden_size": 32,
+         "intermediate_size": 64, "num_hidden_layers": 2,
+         "num_attention_heads": 4,
+         "rope_scaling": {"type": "longrope", "short_factor": [1.0],
+                          "long_factor": [1.0]}}
+    with pytest.raises(ValueError, match="longrope"):
+        config_from_hf(c)
+
+
+def test_export_phi3_roundtrip_and_transformers_load(tmp_path):
+    """phi3 export re-fuses q/k/v -> qkv_proj and gate/up -> gate_up_proj;
+    Phi3ForCausalLM must load it and reproduce our logits, and re-import
+    must return the identical tree."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.models.transformer import init_transformer_params
+
+    cfg = llama_config("tiny", max_seq_len=64, vocab_size=96,
+                       n_layers=2, n_heads=4, n_kv_heads=2,
+                       attn_impl="xla", tie_embeddings=False,
+                       dtype=jnp.float32)
+    params = init_transformer_params(cfg, jax.random.PRNGKey(9))
+    out = tmp_path / "export_phi3"
+    save_hf_checkpoint(str(out), cfg, params, "phi3")
+
+    ids = np.random.RandomState(4).randint(0, 96, (2, 10)).astype(np.int32)
+    ours = _logits_ours(cfg, params, ids)
+    hf = AutoModelForCausalLM.from_pretrained(str(out)).eval()
+    assert type(hf).__name__ == "Phi3ForCausalLM"
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-4, rtol=2e-3)
+
+    cfg2, params2 = load_hf_model(str(out), dtype=jnp.float32)
+    flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat2 = jax.tree_util.tree_flatten_with_path(params2)[0]
+    assert len(flat1) == len(flat2)
+    for (kp, a), (_, b) in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(kp))
