@@ -123,6 +123,15 @@ def export_hf_state(cfg, params: Dict[str, Any],
             raise ValueError(
                 "hf_export: PR-MoE (moe_use_residual) has no mixtral "
                 "checkpoint representation; export without residual experts")
+        if getattr(cfg, "moe_shared_expert", 0) or not getattr(
+                cfg, "moe_norm_topk", True):
+            # qwen2-moe states (shared expert / raw-softmax routing) would
+            # be silently dropped by the mixtral name map — refuse until a
+            # qwen2_moe export map exists
+            raise ValueError(
+                "hf_export: qwen2-moe models (moe_shared_expert / "
+                "moe_norm_topk=False) have no mixtral representation; "
+                "qwen2-moe is import-only today")
         for i, g in _unstack(get(mlp["router"])):
             host[f"model.layers.{i}.block_sparse_moe.gate.weight"] = g
         wmap = {"w_gate": "w1", "w_down": "w2", "w_up": "w3"}

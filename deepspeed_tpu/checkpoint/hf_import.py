@@ -13,8 +13,8 @@ header + raw little-endian buffer; no external dependency) and
 ``pytorch_model*.bin`` (via torch, CPU map).  Multi-shard index files of
 both flavors are followed.
 
-Families: llama / mistral / qwen2 / mixtral / gpt2 / opt / phi / phi3 /
-falcon / bert — all with logit parity against ``transformers`` (bert rides the
+Families: llama / mistral / qwen2 / qwen2-moe / mixtral / gpt2 / opt /
+phi / phi3 / falcon / bert — all with logit parity against ``transformers`` (bert rides the
 transformer core's post-norm mode: norm after each residual add,
 embeddings LayerNorm, segment embeddings, full MLM prediction head).
 
@@ -264,6 +264,21 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
         # only the plain-rope (4k) variants map onto our rope
         raise ValueError("hf_import: phi3 rope_scaling (longrope) is "
                          "unsupported; use a 4k-context phi3 variant")
+    if mtype == "qwen2_moe":
+        if c.get("decoder_sparse_step", 1) != 1 or c.get("mlp_only_layers"):
+            raise ValueError(
+                "hf_import: qwen2_moe variants mixing dense and sparse "
+                "layers (decoder_sparse_step != 1 / mlp_only_layers) are "
+                "unsupported — every layer must be MoE")
+        cfg.qkv_bias = True
+        cfg.moe_experts = c["num_experts"]
+        cfg.moe_top_k = c.get("num_experts_per_tok", 4)
+        # experts use moe_intermediate_size, NOT the dense
+        # intermediate_size the default path read
+        cfg.intermediate_size = c["moe_intermediate_size"]
+        cfg.moe_shared_expert = c.get("shared_expert_intermediate_size", 0)
+        cfg.moe_norm_topk = bool(c.get("norm_topk_prob", False))
+        cfg.moe_drop_tokens = False  # exact per-token routing for parity
     return cfg
 
 
@@ -333,7 +348,30 @@ def import_hf_params(cfg, state: Dict[str, np.ndarray],
             state, "model.layers.{i}.post_attention_layernorm.weight", L,
             transpose=False)},
     }
-    if cfg.moe_experts > 0:  # mixtral
+    if model_type == "qwen2_moe":
+        E = cfg.moe_experts
+
+        def _experts(name):
+            return np.stack([np.stack([np.asarray(state[
+                f"model.layers.{i}.mlp.experts.{e}.{name}.weight"]).T
+                for e in range(E)]) for i in range(L)])
+
+        layers["mlp"] = {
+            "router": _stack(state, "model.layers.{i}.mlp.gate.weight", L),
+            "w_gate": _experts("gate_proj"),
+            "w_up": _experts("up_proj"),
+            "w_down": _experts("down_proj"),
+            # always-on shared expert + its per-token sigmoid gate
+            "shared_w_gate": _stack(
+                state, "model.layers.{i}.mlp.shared_expert.gate_proj.weight", L),
+            "shared_w_up": _stack(
+                state, "model.layers.{i}.mlp.shared_expert.up_proj.weight", L),
+            "shared_w_down": _stack(
+                state, "model.layers.{i}.mlp.shared_expert.down_proj.weight", L),
+            "shared_gate": _stack(
+                state, "model.layers.{i}.mlp.shared_expert_gate.weight", L),
+        }
+    elif cfg.moe_experts > 0:  # mixtral
         E = cfg.moe_experts
         layers["mlp"] = {
             "router": _stack(

@@ -77,6 +77,12 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    #: qwen2-moe shared expert: its FFN width (0 = off); output is added to
+    #: the routed MoE output, scaled by sigmoid(x @ shared_gate) per token
+    moe_shared_expert: int = 0
+    #: renormalize kept top-k gate probs to sum 1 (mixtral/reference
+    #: normalize_gate_probabilities); qwen2-moe ships norm_topk_prob=false
+    moe_norm_topk: bool = True
     moe_drop_tokens: bool = True  # False => dropless sort+grouped-matmul path
     # PR-MoE residual experts (reference moe/layer.py use_residual): a dense
     # MLP runs beside the MoE and a learned 2-way coefficient mixes them
@@ -121,7 +127,7 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
     H, L = cfg.hidden_size, cfg.n_layers
     D, NH, KVH = cfg.head_dim, cfg.n_heads, cfg.kv_heads
     F, V = cfg.ffn_size, cfg.vocab_size
-    keys = jax.random.split(rng, 13)
+    keys = jax.random.split(rng, 16)
     dt = cfg.dtype
     std = 0.02
 
@@ -173,6 +179,13 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
             layers["mlp"]["res_w_up"] = nrm(keys[11], L, H, F)
             layers["mlp"]["res_w_down"] = nrm(keys[12], L, F, H, s=proj_out_std)
             layers["mlp"]["coef"] = jnp.zeros((L, H, 2), dt)
+        if cfg.moe_shared_expert > 0:  # qwen2-moe: always-on shared expert
+            Fs = cfg.moe_shared_expert
+            layers["mlp"]["shared_w_gate"] = nrm(keys[13], L, H, Fs)
+            layers["mlp"]["shared_w_up"] = nrm(keys[14], L, H, Fs)
+            layers["mlp"]["shared_w_down"] = nrm(keys[15], L, Fs, H,
+                                                 s=proj_out_std)
+            layers["mlp"]["shared_gate"] = jnp.zeros((L, H, 1), dt)
     elif cfg.activation == "swiglu":
         layers["mlp"]["w_gate"] = nrm(keys[7], L, H, F)
         layers["mlp"]["w_up"] = nrm(keys[8], L, H, F)
@@ -213,6 +226,9 @@ def transformer_partition_rules(cfg: TransformerConfig) -> List[Tuple[str, P]]:
         rules += [
             (r"mlp/router$", P(*lead, None, None)),  # gate replicated
             (r"mlp/w_(gate|up)$", P(*lead, "expert", None, MODEL_AXIS)),
+            (r"mlp/shared_w_(gate|up)$", P(*lead, None, MODEL_AXIS)),
+            (r"mlp/shared_w_down$", P(*lead, MODEL_AXIS, None)),
+            (r"mlp/shared_gate$", P(*lead, None, None)),
             (r"mlp/w_down$", P(*lead, "expert", MODEL_AXIS, None)),
             (r"mlp/res_w_up$", P(*lead, None, MODEL_AXIS)),  # PR-MoE dense
             (r"mlp/res_w_down$", P(*lead, MODEL_AXIS, None)),
@@ -396,9 +412,21 @@ def _ffn(cfg: TransformerConfig, layer, h, training: bool = True):
         moe_cfg = MoEConfig(num_experts=cfg.moe_experts, top_k=cfg.moe_top_k,
                             capacity_factor=cfg.moe_capacity_factor,
                             aux_loss_coef=cfg.moe_aux_coef,
-                            drop_tokens=cfg.moe_drop_tokens)
+                            drop_tokens=cfg.moe_drop_tokens,
+                            norm_topk=cfg.moe_norm_topk)
         moe_out, aux = moe_ffn(h, m["router"], m, moe_cfg,
                                activation=cfg.activation, training=training)
+        if cfg.moe_shared_expert > 0:
+            # qwen2-moe: the shared expert sees every token; its output is
+            # gated by a per-token sigmoid scalar and ADDED to the routed
+            # output (reference qwen_v2_moe model implementation)
+            sh = _mm(cfg, jax.nn.silu(
+                _mm(cfg, h, m["shared_w_gate"], None, MODEL_AXIS))
+                * _mm(cfg, h, m["shared_w_up"], None, MODEL_AXIS),
+                m["shared_w_down"], MODEL_AXIS, None)
+            sgate = jax.nn.sigmoid((h @ m["shared_gate"]).astype(jnp.float32))
+            moe_out = moe_out + (sgate * sh.astype(jnp.float32)).astype(
+                moe_out.dtype)
         if cfg.moe_use_residual:
             # PR-MoE (reference moe/layer.py use_residual): dense MLP beside
             # the MoE, mixed by a learned per-token 2-way coefficient
@@ -674,6 +702,8 @@ def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
         mlp = mlp * cfg.moe_top_k + cfg.hidden_size * cfg.moe_experts
         if cfg.moe_use_residual:  # PR-MoE: dense res MLP + 2-way mixer
             mlp += 2 * cfg.hidden_size * cfg.ffn_size + 2 * cfg.hidden_size
+        if cfg.moe_shared_expert > 0:  # always-on shared expert + its gate
+            mlp += 3 * cfg.hidden_size * cfg.moe_shared_expert + cfg.hidden_size
     n_params = (cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
                 + cfg.n_layers * (
                     cfg.hidden_size * cfg.head_dim * (cfg.n_heads + 2 * cfg.kv_heads)

@@ -32,6 +32,9 @@ class MoEConfig:
     z_loss_coef: float = 0.0
     drop_tokens: bool = True
     noisy_gate_policy: Optional[str] = None  # None | 'Jitter' | 'RSample'
+    #: renormalize the kept top-k gate probs to sum 1 (reference
+    #: normalize_gate_probabilities); qwen2-moe uses raw softmax values
+    norm_topk: bool = True
 
 
 def compute_capacity(tokens: int, cfg: MoEConfig, training: bool = True) -> int:
@@ -65,9 +68,12 @@ def top_k_gating(logits: jnp.ndarray, cfg: MoEConfig, capacity: int,
 
     gate_k = jnp.take_along_axis(gates, expert_idx, axis=1)  # [T, K]
     gate_k = gate_k * keep.astype(gates.dtype)
-    # renormalize kept top-k gates (reference normalize_gate_probabilities)
-    denom = jnp.sum(gate_k, axis=-1, keepdims=True)
-    gate_k = gate_k / jnp.maximum(denom, 1e-9)
+    if cfg.norm_topk:
+        # renormalize kept top-k gates (reference
+        # normalize_gate_probabilities); norm_topk=False (qwen2-moe)
+        # keeps the raw softmax values here too, matching the dropless path
+        denom = jnp.sum(gate_k, axis=-1, keepdims=True)
+        gate_k = gate_k / jnp.maximum(denom, 1e-9)
 
     cap_onehot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)  # [T,K,C]
     # combine[t,e,c] = sum_k gate_k[t,k] * onehot[t,k,e] * cap_onehot[t,k,c]
@@ -95,7 +101,8 @@ def _gate_and_aux(logits: jnp.ndarray, cfg: MoEConfig, rng=None):
         aux = aux + cfg.z_loss_coef * jnp.mean(
             jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)))
     gate_k = jnp.take_along_axis(gates, expert_idx, axis=1)  # [T, K]
-    gate_k = gate_k / jnp.maximum(jnp.sum(gate_k, -1, keepdims=True), 1e-9)
+    if cfg.norm_topk:
+        gate_k = gate_k / jnp.maximum(jnp.sum(gate_k, -1, keepdims=True), 1e-9)
     return gates, expert_idx, gate_k, aux
 
 

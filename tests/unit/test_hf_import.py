@@ -708,3 +708,37 @@ def test_export_phi3_roundtrip_and_transformers_load(tmp_path):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=1e-6,
                                    err_msg=jax.tree_util.keystr(kp))
+
+
+def test_qwen2_moe_safetensors_parity(tmp_path):
+    """qwen2-moe: routed experts + always-on shared expert with a sigmoid
+    per-token gate, norm_topk_prob=False (raw softmax weights). Logit
+    parity pins the routing semantics end to end."""
+    import torch
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    hf_cfg = Qwen2MoeConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(11)
+    m = Qwen2MoeForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.moe_experts == 4 and cfg.moe_shared_expert == 56
+    assert cfg.moe_norm_topk is False and cfg.qkv_bias
+    assert cfg.ffn_size == 48  # experts use moe_intermediate_size
+    cfg.attn_impl = "xla"
+
+    ids = np.random.RandomState(6).randint(0, 96, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)

@@ -123,3 +123,32 @@ def test_prmoe_residual_trains(devices8):
               for i in range(14)]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
+
+
+def test_shared_expert_trains_and_gets_grads(devices8):
+    """qwen2-moe shared expert: always-on branch beside the routed MoE;
+    grads must flow into shared weights AND its sigmoid gate."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.mixtral import mixtral_config, mixtral_model
+    from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+
+    initialize_topology(MeshConfig(expert=2, data=-1), jax.devices()[:8])
+    cfg = mixtral_config("tiny", max_seq_len=16, attn_impl="xla",
+                         moe_drop_tokens=False, moe_shared_expert=48,
+                         moe_norm_topk=False)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=mixtral_model(config=cfg),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"expert": 2, "data": -1}},
+        topology=deepspeed_tpu.get_topology())
+    before = np.asarray(
+        engine.state.params["layers"]["mlp"]["shared_w_down"]).copy()
+    r = np.random.RandomState(0)
+    corpus = r.randint(0, cfg.vocab_size, (4, 8, 16)).astype(np.int32)
+    losses = [float(engine.train_batch({"input_ids": jnp.asarray(corpus[i % 4][None])}))
+              for i in range(12)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    after = np.asarray(engine.state.params["layers"]["mlp"]["shared_w_down"])
+    assert np.abs(after - before).max() > 0, "shared expert never updated"
