@@ -126,6 +126,24 @@ class Autotuner:
         if "micro_batch" in cand:
             cfg["train_micro_batch_size_per_gpu"] = cand["micro_batch"]
             cfg.pop("train_batch_size", None)
+        if "fused_kernel" in cand:
+            # Pallas single-pass Adam vs the XLA-fused optax chain: a
+            # legitimate tunable (tune with e.g.
+            # tuning_space={"fused_kernel": [False, True], ...})
+            opt = dict(cfg.get("optimizer", {"type": "FusedAdam",
+                                             "params": {}}))
+            if str(opt.get("type", "adamw")).lower() not in (
+                    "adam", "adamw", "fusedadam", "deepspeedcpuadam"):
+                # non-adam optimizers ignore the knob — injecting it would
+                # double the grid with identical trials and let timing
+                # noise pick a dead param as "best"
+                logger.warning(
+                    f"autotuner: fused_kernel is not tunable for optimizer "
+                    f"type {opt.get('type')!r}; dropping the knob")
+            else:
+                opt["params"] = {**opt.get("params", {}),
+                                 "fused_kernel": bool(cand["fused_kernel"])}
+                cfg["optimizer"] = opt
         return cfg
 
     def _run_trial(self, cand: Dict[str, Any]) -> Optional[float]:

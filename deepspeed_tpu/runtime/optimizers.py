@@ -70,14 +70,18 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any],
             tx = one_bit_lamb(**common, eps=float(params.get("eps", 1e-6)),
                               freeze_step=int(params.get("freeze_step", 100)))
         return tx, base_lr
+    if params.get("fused_kernel") and name in (
+            ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM, ADAMW_OPTIMIZER):
+        # single-pass Pallas kernel per leaf instead of the optax chain;
+        # plain "adamw" is the adam_w_mode=True fused kernel
+        adam_w_mode = (True if name == ADAMW_OPTIMIZER
+                       else bool(params.get("adam_w_mode", True)))
+        a = _adam_args(params)
+        return pallas_fused_adam(schedule, a["b1"], a["b2"], a["eps"],
+                                 wd, adam_w_mode), base_lr
     if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         # reference FusedAdam defaults to adam_w_mode=True (ops/adam/fused_adam.py)
         adam_w_mode = bool(params.get("adam_w_mode", True))
-        if params.get("fused_kernel"):
-            # single-pass Pallas kernel per leaf instead of the optax chain
-            a = _adam_args(params)
-            return pallas_fused_adam(schedule, a["b1"], a["b2"], a["eps"],
-                                     wd, adam_w_mode), base_lr
         if adam_w_mode:
             tx = optax.adamw(schedule, weight_decay=wd, **_adam_args(params))
         else:

@@ -379,3 +379,22 @@ def test_subprocess_trial_runner(tmp_path):
                   Reservation(node, 1))
     assert tput == 21.0
     assert (tmp_path / "results" / "t0" / "exp.json").exists()
+
+
+def test_autotuner_tunes_fused_kernel():
+    """fused_kernel rides the tuning space into the trial's optimizer
+    params (single-device trials use the Pallas path when True)."""
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    tuner = Autotuner(
+        model_factory=simple_mlp_spec,
+        base_config={"optimizer": {"type": "FusedAdam",
+                                   "params": {"lr": 1e-3}}},
+        batch_factory=lambda mb: random_batch(batch_size=mb * 8, gas=1),
+        tuning_space={"fused_kernel": [False, True], "micro_batch": [2]},
+        steps_per_trial=1)
+    cfg_on = tuner._trial_config({"fused_kernel": True, "micro_batch": 2})
+    assert cfg_on["optimizer"]["params"]["fused_kernel"] is True
+    assert cfg_on["optimizer"]["params"]["lr"] == 1e-3  # params merged
+    result = tuner.tune()
+    assert result["best"] is not None and len(result["trials"]) == 2
