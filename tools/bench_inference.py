@@ -63,8 +63,13 @@ def main() -> None:
         return [RaggedRequest(prompt_ids=rng.randint(1, vocab, prompt).tolist(),
                               max_new_tokens=gen) for _ in range(n)]
 
-    # warmup: compile prefill buckets + decode program on a small wave
-    engine.generate_all(requests(min(2, nreq)))
+    # warmup: compile the prompt-length prefill bucket + the decode
+    # program on a SHORT wave — full-length generations would double the
+    # session for no extra compile coverage
+    warm = requests(min(2, nreq))
+    for r in warm:
+        r.max_new_tokens = min(8, gen)
+    engine.generate_all(warm)
 
     t0 = time.perf_counter()
     got = engine.generate_all(requests(nreq))
