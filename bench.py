@@ -200,10 +200,17 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     zero_cfg = {"stage": stage}
     if os.environ.get("DSTPU_BENCH_OFFLOAD") == "1":
         zero_cfg["offload_optimizer"] = {"device": "cpu"}
+    opt_params = {"lr": 1e-4, "weight_decay": 0.1}
+    if os.environ.get("DSTPU_BENCH_MU_DTYPE"):
+        # bf16 exp_avg: -2 bytes/param of optimizer HBM (helps the 1b
+        # model fit one chip without offload)
+        opt_params["mu_dtype"] = os.environ["DSTPU_BENCH_MU_DTYPE"]
+    if os.environ.get("DSTPU_BENCH_FUSED_OPT") == "1":
+        opt_params["fused_kernel"] = True
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "optimizer": {"type": "AdamW", "params": opt_params},
         "bf16": {"enabled": True},
         "zero_optimization": zero_cfg,
         "gradient_clipping": 1.0,

@@ -40,6 +40,21 @@ def _adam_args(params: Dict[str, Any]) -> Dict[str, Any]:
     )
 
 
+def _mu_dtype(params: Dict[str, Any]):
+    """Optional first-moment storage dtype ("bf16"): exp_avg is smooth and
+    tolerates bf16 storage, shaving 2 bytes/param of optimizer HBM (the
+    variance stays fp32 — its magnitude range does not).  None = fp32."""
+    name = str(params.get("mu_dtype", "")).lower()
+    if not name:
+        return None
+    table = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+             "fp32": jnp.float32, "float32": jnp.float32}
+    if name not in table:
+        raise ValueError(f"optimizer params mu_dtype {name!r} not supported "
+                         f"(use one of {sorted(table)})")
+    return table[name]
+
+
 def build_optimizer(name: Optional[str], params: Dict[str, Any],
                     schedule: Callable) -> Tuple[optax.GradientTransformation, float]:
     """Returns (transformation, base_lr).
@@ -78,17 +93,21 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any],
                        else bool(params.get("adam_w_mode", True)))
         a = _adam_args(params)
         return pallas_fused_adam(schedule, a["b1"], a["b2"], a["eps"],
-                                 wd, adam_w_mode), base_lr
+                                 wd, adam_w_mode,
+                                 mu_dtype=_mu_dtype(params)), base_lr
     if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         # reference FusedAdam defaults to adam_w_mode=True (ops/adam/fused_adam.py)
         adam_w_mode = bool(params.get("adam_w_mode", True))
         if adam_w_mode:
-            tx = optax.adamw(schedule, weight_decay=wd, **_adam_args(params))
+            tx = optax.adamw(schedule, weight_decay=wd,
+                             mu_dtype=_mu_dtype(params), **_adam_args(params))
         else:
             tx = optax.chain(optax.add_decayed_weights(wd) if wd else optax.identity(),
-                             optax.adam(schedule, **_adam_args(params)))
+                             optax.adam(schedule, mu_dtype=_mu_dtype(params),
+                                        **_adam_args(params)))
     elif name == ADAMW_OPTIMIZER:
-        tx = optax.adamw(schedule, weight_decay=wd, **_adam_args(params))
+        tx = optax.adamw(schedule, weight_decay=wd,
+                         mu_dtype=_mu_dtype(params), **_adam_args(params))
     elif name == LAMB_OPTIMIZER:
         tx = optax.lamb(schedule, weight_decay=wd, **_adam_args(params))
     elif name in (LION_OPTIMIZER, "fusedlion", "deepspeedcpulion"):
@@ -110,6 +129,12 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any],
         )
     else:
         raise ValueError(f"Unknown optimizer '{name}'")
+    if params.get("mu_dtype") and name not in (ADAM_OPTIMIZER, FUSED_ADAM,
+                                               CPU_ADAM, ADAMW_OPTIMIZER):
+        from ..utils.logging import logger
+
+        logger.warning(f"optimizer {name!r} ignores mu_dtype — only the "
+                       f"adam family stores a bf16 first moment")
     return tx, base_lr
 
 
@@ -132,7 +157,8 @@ class DirectTransformation(NamedTuple):
 
 
 def pallas_fused_adam(schedule: Callable, b1: float, b2: float, eps: float,
-                      wd: float, adam_w_mode: bool = True) -> DirectTransformation:
+                      wd: float, adam_w_mode: bool = True,
+                      mu_dtype=None) -> DirectTransformation:
     """AdamW/Adam as ONE single-pass Pallas kernel per leaf (reference
     FusedAdam, ``csrc/adam/multi_tensor_adam.cu``): p/m/v/g are read once
     and p/m/v written once, blocked through VMEM, instead of trusting XLA
@@ -146,9 +172,11 @@ def pallas_fused_adam(schedule: Callable, b1: float, b2: float, eps: float,
     from ..ops.pallas.fused_adam import fused_adam_update
 
     def init(params):
-        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
-        return {"m": jax.tree_util.tree_map(zeros, params),
-                "v": jax.tree_util.tree_map(zeros, params),
+        mdt = mu_dtype or jnp.float32
+        return {"m": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, mdt), params),
+                "v": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params),
                 "step": jnp.zeros((), jnp.int32)}
 
     def direct_update(grads, state, params):

@@ -177,3 +177,40 @@ def test_engine_fused_kernel_sharded_matches_optax(stage, devices8):
                     jax.tree_util.tree_leaves(p_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-6, rtol=1e-4)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_mu_dtype_bf16_moment_storage(fused):
+    """optimizer params {"mu_dtype": "bf16"}: the first moment is stored
+    bf16 in BOTH the optax and the Pallas fused paths; training stays
+    finite and close to the fp32-moment run."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+    import jax
+
+    def train(mu):
+        initialize_topology(MeshConfig(), jax.devices()[:1])
+        model = llama_model("tiny", max_seq_len=16, attn_impl="xla")
+        params = {"lr": 1e-3, "weight_decay": 0.01, "fused_kernel": fused}
+        if mu:
+            params["mu_dtype"] = mu
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": params},
+                    "zero_optimization": {"stage": 0}},
+            topology=deepspeed_tpu.get_topology())
+        r = np.random.RandomState(0)
+        ids = r.randint(0, 256, (5, 1, 2, 16)).astype(np.int32)
+        losses = [float(engine.train_batch({"input_ids": jnp.asarray(b)}))
+                  for b in ids]
+        return losses, engine.state.opt_state
+
+    l16, opt16 = train("bf16")
+    l32, _ = train(None)
+    mus = [l for l in jax.tree_util.tree_leaves(opt16)
+           if getattr(l, "dtype", None) == jnp.bfloat16]
+    assert mus, "no bf16 moment found in opt state"
+    assert np.isfinite(l16).all()
+    np.testing.assert_allclose(l16, l32, rtol=2e-2)

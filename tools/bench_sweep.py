@@ -28,6 +28,14 @@ RUNGS = {
     # the shape PERF_NOTES predicts feeds the MXU better (hidden 2048)
     "1b": {"DSTPU_BENCH_SIZE": "1b", "DSTPU_BENCH_SEQ": "1024",
            "DSTPU_BENCH_STEPS": "10"},
+    # fp32 master + m + v for 1.1B params is ~13GB before activations —
+    # two fallbacks if the pure-HBM rung OOMs: bf16 exp_avg (-2.2GB,
+    # stays on-chip) and host-offloaded optimizer states (ZeRO-Infinity)
+    "1b-mu16": {"DSTPU_BENCH_SIZE": "1b", "DSTPU_BENCH_SEQ": "1024",
+                "DSTPU_BENCH_STEPS": "10", "DSTPU_BENCH_MU_DTYPE": "bf16"},
+    "1b-offload": {"DSTPU_BENCH_SIZE": "1b", "DSTPU_BENCH_SEQ": "1024",
+                   "DSTPU_BENCH_BS": "8", "DSTPU_BENCH_STEPS": "5",
+                   "DSTPU_BENCH_OFFLOAD": "1"},
     # ZeRO-3 on the same model/chip: settles the stage-3 XLA-prefetch bet
     "160m-zero3": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
                    "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",

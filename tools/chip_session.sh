@@ -16,6 +16,10 @@ stamp "bench_sweep flagship"
 timeout 2000 python tools/bench_sweep.py flagship
 stamp "bench_sweep 1b"
 timeout 2400 python tools/bench_sweep.py 1b
+stamp "bench_sweep 1b-mu16"
+timeout 2400 python tools/bench_sweep.py 1b-mu16
+stamp "bench_sweep 1b-offload"
+timeout 2400 python tools/bench_sweep.py 1b-offload
 
 # 2. decomposition + bwd-tile sweep on the flagship shape
 stamp "tune_mfu bwd tiles + fused adam"
