@@ -26,13 +26,15 @@ def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
     from deepspeed_tpu.models.transformer import flops_per_token
 
     fused_opt = bool(model_overrides.pop("fused_opt", False))
+    mu_dtype = model_overrides.pop("mu_dtype", None)
     model = llama_model(size, max_seq_len=seq, **model_overrides)
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "FusedAdam" if fused_opt else "AdamW",
                       "params": {"lr": 1e-4, "weight_decay": 0.1,
-                                 **({"fused_kernel": True} if fused_opt else {})}},
+                                 **({"fused_kernel": True} if fused_opt else {}),
+                                 **({"mu_dtype": mu_dtype} if mu_dtype else {})}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},
         "gradient_clipping": 1.0,
@@ -92,6 +94,10 @@ VARIANTS = {
     "160m-fusedadam": ("160m", 1024, 16, {"fused_opt": True}),
     "1b-bs8-remat": ("1b", 1024, 8, {"remat": True}),
     "1b-bs4": ("1b", 1024, 4, {}),
+    # memory-lean 1b: bf16 exp_avg + fused single-pass update — the
+    # config the 1b-mu16 bench rung runs if plain 1b OOMs
+    "1b-bs8-mu16-fused": ("1b", 1024, 8, {"remat": True, "fused_opt": True,
+                                          "mu_dtype": "bf16"}),
 }
 
 
