@@ -73,6 +73,8 @@ def export_hf_state(cfg, params: Dict[str, Any],
         return _export_phi(cfg, params, get)
     if model_type == "falcon":
         return _export_falcon(cfg, params, get)
+    if model_type == "qwen2_moe":
+        return _export_qwen2_moe(cfg, params, get)
     if model_type == "phi3":
         # llama layout first, then RE-FUSE the projections the way HF
         # Phi3 stores them: qkv_proj rows are [q | k | v], gate_up_proj
@@ -126,12 +128,11 @@ def export_hf_state(cfg, params: Dict[str, Any],
         if getattr(cfg, "moe_shared_expert", 0) or not getattr(
                 cfg, "moe_norm_topk", True):
             # qwen2-moe states (shared expert / raw-softmax routing) would
-            # be silently dropped by the mixtral name map — refuse until a
-            # qwen2_moe export map exists
+            # be silently dropped by the mixtral name map
             raise ValueError(
-                "hf_export: qwen2-moe models (moe_shared_expert / "
-                "moe_norm_topk=False) have no mixtral representation; "
-                "qwen2-moe is import-only today")
+                "hf_export: this model carries qwen2-moe states "
+                "(moe_shared_expert / moe_norm_topk=False) — export with "
+                "model_type='qwen2_moe' instead of 'mixtral'")
         for i, g in _unstack(get(mlp["router"])):
             host[f"model.layers.{i}.block_sparse_moe.gate.weight"] = g
         wmap = {"w_gate": "w1", "w_down": "w2", "w_up": "w3"}
@@ -361,6 +362,61 @@ def _export_falcon(cfg, params, get) -> Dict[str, np.ndarray]:
     return host
 
 
+def _export_qwen2_moe(cfg, params, get) -> Dict[str, np.ndarray]:
+    """Inverse of the qwen2_moe import map: routed experts under
+    mlp.experts.{e}, the shared expert + its sigmoid gate, router at
+    mlp.gate, qwen2-style qkv biases."""
+    if not getattr(cfg, "moe_experts", 0):
+        raise ValueError("hf_export: qwen2_moe export needs an MoE model "
+                         "(moe_experts > 0)")
+    if getattr(cfg, "moe_use_residual", False):
+        raise ValueError("hf_export: PR-MoE residual weights have no "
+                         "qwen2_moe representation")
+    if not getattr(cfg, "moe_shared_expert", 0):
+        # HF Qwen2Moe unconditionally builds the shared expert, and the
+        # importer expects its weights back
+        raise ValueError("hf_export: qwen2_moe checkpoints require a "
+                         "shared expert (moe_shared_expert > 0); export "
+                         "shared-expert-free MoE as model_type='mixtral'")
+    if not getattr(cfg, "qkv_bias", False):
+        raise ValueError("hf_export: qwen2_moe checkpoints carry q/k/v "
+                         "biases; retrain with qkv_bias=True (an absent "
+                         "bias would crash the qwen2_moe importer)")
+    host: Dict[str, np.ndarray] = {}
+    host["model.embed_tokens.weight"] = get(params["embed"]["tok"])
+    host["model.norm.weight"] = get(params["final_norm"]["scale"])
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["lm_head.weight"] = get(params["lm_head"]["w"]).T
+    layers = params["layers"]
+    _emit_stacked(host, get, layers["attn"], [
+        ("q_proj.weight", "wq", True), ("k_proj.weight", "wk", True),
+        ("v_proj.weight", "wv", True), ("o_proj.weight", "wo", True),
+        ("q_proj.bias", "bq", False), ("k_proj.bias", "bk", False),
+        ("v_proj.bias", "bv", False),
+    ], "model.layers.{i}.self_attn.{hf}")
+    _emit_stacked(host, get, layers["norm1"], [
+        ("weight", "scale", False)], "model.layers.{i}.input_layernorm.{hf}")
+    _emit_stacked(host, get, layers["norm2"], [
+        ("weight", "scale", False)],
+        "model.layers.{i}.post_attention_layernorm.{hf}")
+    mlp = layers["mlp"]
+    _emit_stacked(host, get, mlp, [
+        ("gate.weight", "router", True),
+        ("shared_expert.gate_proj.weight", "shared_w_gate", True),
+        ("shared_expert.up_proj.weight", "shared_w_up", True),
+        ("shared_expert.down_proj.weight", "shared_w_down", True),
+        ("shared_expert_gate.weight", "shared_gate", True),
+    ], "model.layers.{i}.mlp.{hf}")
+    for ours, theirs in {"w_gate": "gate_proj", "w_up": "up_proj",
+                         "w_down": "down_proj"}.items():
+        full = get(mlp[ours])  # [L, E, in, out]
+        for i in range(full.shape[0]):
+            for e in range(full.shape[1]):
+                host[f"model.layers.{i}.mlp.experts.{e}.{theirs}.weight"] = \
+                    np.asarray(full[i, e]).T
+    return host
+
+
 def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
     if model_type == "gpt2":
         return {"model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
@@ -442,6 +498,15 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
     if model_type == "mixtral":
         out["num_local_experts"] = cfg.moe_experts
         out["num_experts_per_tok"] = cfg.moe_top_k
+    if model_type == "qwen2_moe":
+        out["architectures"] = ["Qwen2MoeForCausalLM"]
+        out["num_experts"] = cfg.moe_experts
+        out["num_experts_per_tok"] = cfg.moe_top_k
+        out["moe_intermediate_size"] = cfg.ffn_size
+        out["shared_expert_intermediate_size"] = cfg.moe_shared_expert
+        out["norm_topk_prob"] = bool(cfg.moe_norm_topk)
+        out["decoder_sparse_step"] = 1
+        out["mlp_only_layers"] = []
     if model_type == "phi3":
         # Phi3Config's default pad_token_id (32000) would exceed a small
         # exported vocab and fail Embedding construction on load

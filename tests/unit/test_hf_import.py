@@ -742,3 +742,42 @@ def test_qwen2_moe_safetensors_parity(tmp_path):
         want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
     got = _logits_ours(cfg, params, ids)
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_export_qwen2_moe_roundtrip_and_transformers_load(tmp_path):
+    """qwen2_moe export: per-expert names, shared expert + sigmoid gate,
+    qkv biases; Qwen2MoeForCausalLM loads it and reproduces our logits;
+    re-import returns the identical tree."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+    from deepspeed_tpu.models.mixtral import mixtral_config, mixtral_model
+
+    cfg = mixtral_config("tiny", max_seq_len=64, attn_impl="xla",
+                         moe_drop_tokens=False, moe_shared_expert=56,
+                         moe_norm_topk=False, qkv_bias=True,
+                         intermediate_size=48, dtype=jnp.float32)
+    params = mixtral_model(config=cfg).init_params(jax.random.PRNGKey(15))
+    out = tmp_path / "export_q2moe"
+    save_hf_checkpoint(str(out), cfg, params, "qwen2_moe")
+
+    ids = np.random.RandomState(8).randint(0, cfg.vocab_size,
+                                           (2, 10)).astype(np.int32)
+    ours = _logits_ours(cfg, params, ids)
+    hf = AutoModelForCausalLM.from_pretrained(str(out)).eval()
+    assert type(hf).__name__ == "Qwen2MoeForCausalLM"
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=5e-3)
+
+    cfg2, params2 = load_hf_model(str(out), dtype=jnp.float32)
+    assert cfg2.moe_shared_expert == 56 and cfg2.moe_norm_topk is False
+    flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat2 = jax.tree_util.tree_flatten_with_path(params2)[0]
+    assert len(flat1) == len(flat2)
+    for (kp, a), (_, b) in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), atol=1e-6,
+                                   err_msg=jax.tree_util.keystr(kp))
