@@ -98,6 +98,11 @@ VARIANTS = {
     # config the 1b-mu16 bench rung runs if plain 1b OOMs
     "1b-bs8-mu16-fused": ("1b", 1024, 8, {"remat": True, "fused_opt": True,
                                           "mu_dtype": "bf16"}),
+    # remat policy tradeoff: keeping matmul outputs costs HBM but saves
+    # recompute FLOPs — worth an A/B at the 1b shape
+    "1b-bs8-remat-dots": ("1b", 1024, 8, {
+        "remat": True, "mu_dtype": "bf16", "fused_opt": True,
+        "remat_policy": "dots_with_no_batch_dims_saveable"}),
 }
 
 
