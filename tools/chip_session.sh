@@ -29,6 +29,9 @@ timeout 3600 python tools/tune_mfu.py 160m-bs16 160m-bwd256x256 \
 stamp "profile_step 160m bs16"
 timeout 1200 python tools/profile_step.py --size 160m --seq 1024 --bs 16 \
     --outdir /tmp/dstpu_trace_160m --top 25
+stamp "profile_step 160m bs16 zero3 (stage-3 gather/compute overlap trace)"
+timeout 1200 python tools/profile_step.py --size 160m --seq 1024 --bs 16 \
+    --stage 3 --outdir /tmp/dstpu_trace_160m_z3 --top 25
 
 # 3. the stage/offload/MoE/long-seq/serving rungs
 stamp "bench_sweep 160m-zero3"

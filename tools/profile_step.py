@@ -42,18 +42,26 @@ def main():
     ap.add_argument("--outdir", default="/tmp/dstpu_trace")
     ap.add_argument("--top", type=int, default=15)
     ap.add_argument("--platform", default=None, help="cpu | tpu (pin early)")
+    ap.add_argument("--stage", type=int, default=1,
+                    help="ZeRO stage — stage 3 captures the gather/compute "
+                         "overlap trace the prefetch bet needs")
+    ap.add_argument("--offload", action="store_true",
+                    help="host-offload optimizer states (boundary overlap)")
     args = ap.parse_args()
 
     import deepspeed_tpu
     from deepspeed_tpu.models.llama import llama_model
 
+    zero_cfg = {"stage": args.stage}
+    if args.offload:
+        zero_cfg["offload_optimizer"] = {"device": "cpu"}
     model = llama_model(args.size, max_seq_len=args.seq)
     engine, *_ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": args.bs,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
+        "zero_optimization": zero_cfg,
         "gradient_clipping": 1.0,
     })
     rng = np.random.RandomState(0)
