@@ -169,7 +169,9 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
         chunk = 0
     over = {}
     if remat:
-        over.update(remat=True, remat_policy="nothing_saveable")
+        over.update(remat=True,
+                    remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
+                                                "nothing_saveable"))
     if chunk:
         over["loss_chunk"] = chunk
     attn_impl = attn_impl or os.environ.get("DSTPU_BENCH_ATTN")
