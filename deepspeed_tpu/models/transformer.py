@@ -84,6 +84,9 @@ class TransformerConfig:
     #: normalize_gate_probabilities); qwen2-moe ships norm_topk_prob=false
     moe_norm_topk: bool = True
     moe_drop_tokens: bool = True  # False => dropless sort+grouped-matmul path
+    #: EP dispatch: "auto" = explicit all-to-all shard_map when the mesh
+    #: has an expert axis (moe/ep_dispatch.py); "spmd" = partitioner-driven
+    moe_ep_dispatch: str = "auto"
     # PR-MoE residual experts (reference moe/layer.py use_residual): a dense
     # MLP runs beside the MoE and a learned 2-way coefficient mixes them
     moe_use_residual: bool = False
@@ -413,7 +416,8 @@ def _ffn(cfg: TransformerConfig, layer, h, training: bool = True):
                             capacity_factor=cfg.moe_capacity_factor,
                             aux_loss_coef=cfg.moe_aux_coef,
                             drop_tokens=cfg.moe_drop_tokens,
-                            norm_topk=cfg.moe_norm_topk)
+                            norm_topk=cfg.moe_norm_topk,
+                            ep_dispatch=cfg.moe_ep_dispatch)
         moe_out, aux = moe_ffn(h, m["router"], m, moe_cfg,
                                activation=cfg.activation, training=training)
         if cfg.moe_shared_expert > 0:

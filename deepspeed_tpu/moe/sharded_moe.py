@@ -35,6 +35,15 @@ class MoEConfig:
     #: renormalize the kept top-k gate probs to sum 1 (reference
     #: normalize_gate_probabilities); qwen2-moe uses raw softmax values
     norm_topk: bool = True
+    #: expert-parallel dispatch: "auto" takes the explicit-all-to-all
+    #: shard_map path (ep_dispatch.py) whenever the topology has an expert
+    #: axis > 1; "spmd" keeps the einsum/sort formulation and leaves the
+    #: collectives to the SPMD partitioner
+    ep_dispatch: str = "auto"
+    #: dropless EP send-buffer capacity as a fraction of local assignments
+    #: (None = exact worst case, guaranteed dropless; e.g. 2.0 = balanced
+    #: load with 2x slack, overflow drops — see ep_dispatch.py)
+    ep_send_capacity_factor: Optional[float] = None
 
 
 def compute_capacity(tokens: int, cfg: MoEConfig, training: bool = True) -> int:
@@ -106,6 +115,36 @@ def _gate_and_aux(logits: jnp.ndarray, cfg: MoEConfig, rng=None):
     return gates, expert_idx, gate_k, aux
 
 
+def sort_pad_by_expert(key: jnp.ndarray, n_experts: int, block_rows: int):
+    """Sort rows by expert key and compute block-padded destinations for the
+    grouped matmul.  ``key`` values >= n_experts mark INVALID rows (they sort
+    to the end and get dest == n_rows — scatter them with mode='drop').
+
+    Returns (order, dest, n_rows, block_expert):
+      order        [N] sorted row order (stable)
+      dest         [N] padded-buffer row for each SORTED position
+      n_rows       static padded buffer size (worst case, whole blocks)
+      block_expert [n_rows/block_rows] expert of each row block
+    """
+    N = key.shape[0]
+    counts = jnp.bincount(jnp.minimum(key, n_experts),
+                          length=n_experts + 1)[:n_experts]
+    order = jnp.argsort(key, stable=True)
+    key_s = key[order]
+    starts_raw = jnp.cumsum(counts) - counts
+    padded = ((counts + block_rows - 1) // block_rows) * block_rows
+    starts_b = jnp.cumsum(padded) - padded
+    n_rows = (-(-N // block_rows) + n_experts) * block_rows
+    se = jnp.clip(key_s, 0, n_experts - 1)
+    dest = jnp.where(key_s < n_experts,
+                     starts_b[se] + (jnp.arange(N) - starts_raw[se]), n_rows)
+    block_starts = jnp.arange(n_rows // block_rows) * block_rows
+    block_expert = jnp.clip(
+        jnp.searchsorted(starts_b, block_starts, side="right") - 1,
+        0, n_experts - 1).astype(jnp.int32)
+    return order, dest, n_rows, block_expert
+
+
 def _expert_ffn_blocks(xs, experts, block_expert, activation, block_rows):
     """The three grouped matmuls of one FFN over sorted+padded tokens."""
     from ..ops.pallas.grouped_matmul import grouped_matmul
@@ -139,25 +178,10 @@ def moe_ffn_dropless(x: jnp.ndarray, gate_w: jnp.ndarray,
 
     flat_e = expert_idx.reshape(T * K)
     flat_g = gate_k.reshape(T * K)
-    order = jnp.argsort(flat_e, stable=True)
-    sorted_e = flat_e[order]
+    order, dest, n_rows, block_expert = sort_pad_by_expert(flat_e, E,
+                                                           block_rows)
     token_of = order // K
-
-    counts = jnp.bincount(flat_e, length=E)  # tokens per expert
-    starts_raw = jnp.cumsum(counts) - counts
-    padded = ((counts + block_rows - 1) // block_rows) * block_rows
-    starts = jnp.cumsum(padded) - padded  # block-aligned expert starts
-    rank_in_e = jnp.arange(T * K) - starts_raw[sorted_e]
-    dest = starts[sorted_e] + rank_in_e  # [T*K] rows in the padded buffer
-
-    # static worst case of sum(padded), rounded to whole blocks
-    P = (-(-(T * K) // block_rows) + E) * block_rows
-    xs = jnp.zeros((P, H), x.dtype).at[dest].set(xt[token_of])
-    block_starts = jnp.arange(P // block_rows) * block_rows
-    # expert of each block: the unique expert whose padded span covers it
-    # (blocks past the used region get expert 0 on zero rows -> zero output)
-    block_expert = jnp.searchsorted(starts, block_starts, side="right") - 1
-    block_expert = jnp.clip(block_expert, 0, E - 1).astype(jnp.int32)
+    xs = jnp.zeros((n_rows, H), x.dtype).at[dest].set(xt[token_of])
 
     ys = _expert_ffn_blocks(xs, experts, block_expert, activation, block_rows)
     contrib = ys[dest] * flat_g[order][:, None].astype(ys.dtype)
@@ -173,6 +197,13 @@ def moe_ffn(x: jnp.ndarray, gate_w: jnp.ndarray, experts: Dict[str, jnp.ndarray]
     experts: stacked weights {w_gate/w_up: [E, H, F], w_down: [E, F, H]}
     (w_gate only for swiglu).  Returns (out [B, S, H], aux_loss).
     """
+    from .ep_dispatch import ep_dispatch_active, moe_ffn_ep
+
+    if ep_dispatch_active(cfg):
+        out = moe_ffn_ep(x, gate_w, experts, cfg, activation=activation,
+                         rng=rng, training=training)
+        if out is not None:
+            return out
     if not cfg.drop_tokens:
         return moe_ffn_dropless(x, gate_w, experts, cfg, activation, rng)
     B, S, H = x.shape

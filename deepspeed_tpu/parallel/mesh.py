@@ -167,6 +167,13 @@ def get_topology() -> MeshTopology:
     return _TOPOLOGY
 
 
+def peek_topology() -> Optional[MeshTopology]:
+    """The initialized topology, or None — never creates one (safe to call
+    from library code at trace time without the side effect of building a
+    default mesh over all devices)."""
+    return _TOPOLOGY
+
+
 def reset_topology() -> None:
     global _TOPOLOGY
     _TOPOLOGY = None

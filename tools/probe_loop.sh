@@ -1,0 +1,35 @@
+#!/bin/bash
+# Patient chip-probe loop per the lease discipline:
+#   - ONE probe per cycle, generous budget (1500s), in a subprocess
+#   - >=45 min quiet between probes (never rapid kill-polling)
+#   - the moment the chip answers, chain straight into chip_session.sh
+# Run from repo root:  bash tools/probe_loop.sh >> docs/PROBE_LOOP.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+
+stamp() { echo "=== [$(date -u +%H:%M:%S)] $*"; }
+
+for attempt in 1 2 3 4 5 6 7 8 9 10 11 12; do
+  stamp "probe attempt $attempt start (budget 1500s)"
+  timeout 1500 python - <<'EOF'
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+devs = jax.devices()
+print("devices:", devs, flush=True)
+x = jnp.ones((512, 512), jnp.bfloat16)
+y = (x @ x).sum()
+print("probe ok: %s (%.1fs)" % (float(y), time.time() - t0), flush=True)
+EOF
+  rc=$?
+  stamp "probe attempt $attempt rc=$rc"
+  if [ $rc -eq 0 ]; then
+    stamp "chip healthy -> launching chip_session.sh"
+    bash tools/chip_session.sh >> docs/CHIP_SESSION.log 2>&1
+    stamp "chip_session.sh finished"
+    exit 0
+  fi
+  stamp "chip dark; sleeping 45 min before next probe"
+  sleep 2700
+done
+stamp "probe loop exhausted (12 attempts)"
+exit 1
