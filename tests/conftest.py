@@ -27,6 +27,26 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", _platform)
 
 
+# The <2-minute smoke tier for perf-round edit loops (README "Testing"):
+# engine/config/mesh cores in full plus one representative each from the
+# pipeline, MoE-EP and ZeRO-3 structural suites.  Run: pytest -m smoke
+_SMOKE = (
+    "unit/test_engine.py",
+    "unit/test_config.py",
+    "unit/test_mesh_and_comm.py",
+    "unit/test_pipeline.py::test_pipeline_loss_matches_dense",
+    "unit/test_pipeline.py::test_partition_balanced",
+    "unit/test_moe_ep.py::test_ep_dropless_matches_spmd_exactly",
+    "unit/test_zeropp.py::test_stage3_gathers_stay_inside_layer_loop",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(item.nodeid.startswith(p) for p in _SMOKE):
+            item.add_marker(pytest.mark.smoke)
+
+
 @pytest.fixture(autouse=True)
 def _reset_topology():
     """Each test builds its own mesh topology."""
