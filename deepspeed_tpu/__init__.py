@@ -106,6 +106,22 @@ def initialize(args: Any = None,
             f"{batch} micro={info['micro_batch_per_gpu']} "
             f"gas={info['gradient_accumulation_steps']}")
 
+    if model_parameters is not None and not callable(model_parameters):
+        # Reference signature parity: ``model_parameters`` is what the
+        # optimizer trains.  Functionally that means: start the engine
+        # from THIS pytree (e.g. a distilled student from
+        # compression.student_initialization, or imported HF weights)
+        # instead of the model's random init.  Shardings still come from
+        # the engine's plan; values are adopted leaf-for-leaf.
+        import copy as _copy
+
+        from .runtime.module import as_model_spec as _as_spec
+
+        model = _copy.copy(_as_spec(model, example_batch=example_batch,
+                                    loss_fn=loss_fn,
+                                    partition_rules=partition_rules))
+        model.init_params = lambda rng, _given=model_parameters: _given
+
     engine_cls = DeepSpeedTPUEngine
     if ds_config.hybrid_engine.enabled:
         from .runtime.hybrid_engine import DeepSpeedHybridEngine

@@ -42,6 +42,18 @@ class PruneConfig:
 
 
 @dataclasses.dataclass
+class LayerReductionConfig:
+    """Depth reduction for distillation (reference compression/compress.py
+    :100,:120,:192 ``student_initialization``): the student keeps
+    ``keep_number_layer`` layers, initialized from the teacher layers
+    listed in ``teacher_layer``."""
+
+    enabled: bool = False
+    keep_number_layer: int = 0
+    teacher_layer: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
 class StructuredPruneConfig:
     """Head / FFN-channel pruning (reference basic_layer.py
     HeadPruning_Compress / ChannelPruning_Compress)."""
@@ -106,6 +118,11 @@ class CompressionScheduler:
             enabled=cp.get("enabled", False),
             ratio=1.0 - float(cp.get("dense_ratio", 1.0 - cp.get("ratio", 0.25))),
             schedule_offset=int(cp.get("schedule_offset", 0)))
+        lr = config.get("layer_reduction", {})
+        self.layer_reduction = LayerReductionConfig(
+            enabled=lr.get("enabled", False),
+            keep_number_layer=int(lr.get("keep_number_layer", 0)),
+            teacher_layer=list(lr.get("teacher_layer", [])))
         self._masks: Optional[Any] = None
         self._head_keep: Optional[Any] = None  # [L, H_keep] kept head indices
         self._chan_keep: Optional[Any] = None  # [L, F_keep] kept channels
@@ -218,11 +235,81 @@ class CompressionScheduler:
         return out
 
 
+def student_initialization(student_params: Any, teacher_params: Any,
+                           lr_config: LayerReductionConfig) -> Any:
+    """Initialize a reduced-depth student from a teacher (reference
+    compression/compress.py:192 ``student_initialization``).
+
+    The reference walks module names and copies embeddings plus the
+    ``teacher_layer``-selected encoder layers into the student.  In the
+    stacked-layer layout used here (every ``layers`` leaf is [L, ...]),
+    the whole operation is ONE gather along the leading layer axis;
+    embeddings / final norm / lm head are taken from the teacher as-is.
+
+    ``student_params`` supplies the expected structure and shapes (its
+    values are discarded); a mismatch raises rather than silently
+    producing a student of the wrong geometry.
+    """
+    ids = list(lr_config.teacher_layer)
+    if lr_config.keep_number_layer and \
+            len(ids) != lr_config.keep_number_layer:
+        raise ValueError(
+            f"layer_reduction: teacher_layer {ids} does not match "
+            f"keep_number_layer={lr_config.keep_number_layer}")
+    t_layers = teacher_params["layers"]
+    s_layers = student_params["layers"]
+    idx = jnp.asarray(ids, jnp.int32)
+
+    def gather(path, t_leaf):
+        n_teacher = t_leaf.shape[0]
+        if any(i < 0 or i >= n_teacher for i in ids):
+            raise ValueError(f"layer_reduction: teacher_layer {ids} out of "
+                             f"range for {jax.tree_util.keystr(path)} with "
+                             f"{n_teacher} layers")
+        return t_leaf[idx]
+
+    new_layers = jax.tree_util.tree_map_with_path(gather, t_layers)
+    # shape contract against the student tree
+    chex = jax.tree_util.tree_map(
+        lambda s, n: s.shape == n.shape, s_layers, new_layers)
+    bad = [jax.tree_util.keystr(p) for p, ok
+           in jax.tree_util.tree_leaves_with_path(chex) if not ok]
+    if bad:
+        raise ValueError(f"layer_reduction: student/teacher layer shape "
+                         f"mismatch at {bad}")
+    out = {k: v for k, v in teacher_params.items() if k != "layers"}
+    out["layers"] = new_layers
+    return out
+
+
+def distillation_loss(student_logits: jnp.ndarray,
+                      teacher_logits: jnp.ndarray,
+                      temperature: float = 1.0) -> jnp.ndarray:
+    """Soft-target KD loss: T^2-scaled CROSS-ENTROPY of the student against
+    the teacher's softened distribution, averaged over tokens (the Hinton
+    formulation the reference's compression examples pair with
+    layer_reduction).  Same gradients as KL(teacher || student); the value
+    differs from KL by the constant teacher entropy, so it does not reach
+    zero at logit equality."""
+    t = temperature
+    sp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    tp = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    return -jnp.mean(jnp.sum(tp * sp, axis=-1)) * (t * t)
+
+
 def init_compression(params: Any, deepspeed_config: Dict[str, Any],
                      global_step: int = 0,
-                     n_heads: Optional[int] = None) -> Tuple[Any, CompressionScheduler]:
-    """Reference init_compression: returns (transformed params, scheduler)."""
+                     n_heads: Optional[int] = None,
+                     teacher_params: Any = None) -> Tuple[Any, CompressionScheduler]:
+    """Reference init_compression: returns (transformed params, scheduler).
+
+    With ``teacher_params`` and an enabled ``layer_reduction`` config,
+    ``params`` (the randomly-initialized student) is re-initialized from
+    the teacher's configured layers before the other transforms apply."""
     sched = CompressionScheduler(deepspeed_config.get("compression_training", {}))
+    if sched.layer_reduction.enabled and teacher_params is not None:
+        params = student_initialization(params, teacher_params,
+                                        sched.layer_reduction)
     return sched.transform_params(params, global_step, n_heads=n_heads), sched
 
 

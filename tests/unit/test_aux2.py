@@ -360,3 +360,84 @@ def test_bench_child_error_marker_contract():
     marker = _json.loads(proc.stdout.strip().splitlines()[-1])
     assert "ValueError" in marker["child_error"]
     assert "not-a-family" in marker["child_error"]
+
+
+def test_layer_reduction_student_init():
+    """Reference student_initialization (compression/compress.py:192): the
+    student's stacked layers are the teacher's configured layers; the
+    embeddings/head come from the teacher; bad maps raise."""
+    from deepspeed_tpu.compression.compress import init_compression
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.models.transformer import init_transformer_params
+
+    t_cfg = llama_config("tiny", max_seq_len=32)
+    t_cfg.n_layers = 4
+    s_cfg = llama_config("tiny", max_seq_len=32)
+    s_cfg.n_layers = 2
+    teacher = init_transformer_params(t_cfg, jax.random.PRNGKey(0))
+    student = init_transformer_params(s_cfg, jax.random.PRNGKey(1))
+
+    config = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2, "teacher_layer": [1, 3]}}}
+    out, _ = init_compression(student, config, teacher_params=teacher)
+
+    np.testing.assert_array_equal(np.asarray(out["layers"]["attn"]["wq"]),
+                                  np.asarray(teacher["layers"]["attn"]["wq"])[[1, 3]])
+    np.testing.assert_array_equal(np.asarray(out["embed"]["tok"]),
+                                  np.asarray(teacher["embed"]["tok"]))
+    # bad layer map raises
+    bad = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2, "teacher_layer": [1, 9]}}}
+    with pytest.raises(ValueError, match="out of range"):
+        init_compression(student, bad, teacher_params=teacher)
+    # wrong-depth student raises (3 layers vs keep 2)
+    s3 = llama_config("tiny", max_seq_len=32)
+    s3.n_layers = 3
+    with pytest.raises(ValueError, match="shape mismatch"):
+        init_compression(init_transformer_params(s3, jax.random.PRNGKey(2)),
+                         config, teacher_params=teacher)
+
+
+@pytest.mark.slow
+def test_layer_reduction_student_beats_random_init():
+    """A 2-layer student initialized from a trained 4-layer teacher starts
+    at a lower loss than a randomly initialized 2-layer student (the point
+    of the reference's student_initialization), and the KD loss against
+    the teacher's logits is differentiable."""
+    import deepspeed_tpu
+    from deepspeed_tpu.compression.compress import (distillation_loss,
+                                                    init_compression)
+    from deepspeed_tpu.models.llama import llama_model
+
+    teacher_model = llama_model("tiny", max_seq_len=32, n_layers=4)
+    config = {"train_micro_batch_size_per_gpu": 8,
+              "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+              "bf16": {"enabled": True}}
+    engine, *_ = deepspeed_tpu.initialize(model=teacher_model, config=config)
+    ids = np.random.RandomState(0).randint(0, 256, (1, 8, 32)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids)}
+    for _ in range(25):
+        engine.train_batch(batch)
+    teacher = jax.tree_util.tree_map(lambda x: x.astype(jnp.float32),
+                                     engine.state.params)
+
+    student_model = llama_model("tiny", max_seq_len=32, n_layers=2)
+    random_student = student_model.init_params(jax.random.PRNGKey(7))
+    kd_cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2, "teacher_layer": [0, 3]}}}
+    distilled, _ = init_compression(random_student, kd_cfg,
+                                    teacher_params=teacher)
+
+    b0 = jax.tree_util.tree_map(lambda x: x[0], batch)
+    l_rand = float(student_model.loss_fn(random_student, b0, None))
+    l_dist = float(student_model.loss_fn(distilled, b0, None))
+    assert l_dist < l_rand, (l_dist, l_rand)
+
+    # KD loss: finite, positive, and grads vanish at logit equality
+    r = np.random.RandomState(3)
+    t_logits = jnp.asarray(r.randn(8, 32, 256).astype(np.float32))
+    s_logits = jnp.asarray(r.randn(8, 32, 256).astype(np.float32))
+    kd = distillation_loss(s_logits, t_logits, temperature=2.0)
+    assert np.isfinite(float(kd)) and float(kd) > 0
+    g = jax.grad(lambda s: distillation_loss(s, t_logits))(t_logits)
+    assert float(jnp.max(jnp.abs(g))) < 1e-3  # cross-entropy min at s == t

@@ -327,3 +327,24 @@ def test_sanity_checks_tolerates_fp16_overflow_skip():
     # a finite loss resets the run counter
     engine._sanity_check_maybe(jnp.asarray(1.0), None)
     assert engine._sanity_skip_run == 0
+
+
+def test_initialize_adopts_model_parameters():
+    """Reference-signature parity: ``initialize(model_parameters=<pytree>)``
+    starts the engine from the given values (distilled students, imported
+    weights) rather than the model's random init."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import llama_model
+
+    model = llama_model("tiny", max_seq_len=16)
+    given = model.init_params(jax.random.PRNGKey(123))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=given,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    got = engine.state.params["layers"]["attn"]["wq"]
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(given["layers"]["attn"]["wq"],
+                                          np.float32), rtol=1e-2, atol=1e-2)
+    ids = {"input_ids": jnp.ones((1, 2, 16), jnp.int32)}
+    assert np.isfinite(float(engine.train_batch(ids)))
