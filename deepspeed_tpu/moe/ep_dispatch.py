@@ -234,6 +234,10 @@ def moe_ffn_ep(x: jnp.ndarray, gate_w: jnp.ndarray,
 
     wg = experts.get("w_gate") if activation == "swiglu" else None
     wu, wd = experts["w_up"], experts["w_down"]
+    if wu.shape[-1] % topo.model_parallel_size:
+        # the FFN dim cannot split evenly over the model axis; GSPMD's
+        # uneven-sharding support handles this — fall back to SPMD
+        return None
 
     if rng is None and cfg.noisy_gate_policy:
         # rng=None means NO gate noise (sharded_moe semantics); clear the

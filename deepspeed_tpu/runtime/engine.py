@@ -143,9 +143,14 @@ class DeepSpeedTPUEngine:
         off_cfg = config.zero_config.offload_optimizer
         zf_cfg = config.zero_config.zenflow
         if off_cfg.enabled or zf_cfg.enabled:
-            if self.fp16_enabled:
-                raise NotImplementedError("offload_optimizer with fp16 loss "
-                                          "scaling is not supported; use bf16")
+            if self.fp16_enabled and (zf_cfg.enabled or off_cfg.super_offload):
+                # plain ZeRO-Offload handles fp16 (unscale via the host
+                # denominator + host overflow skip, _apply_step_offload);
+                # the selective/async update paths do not thread the skip
+                raise NotImplementedError(
+                    "fp16 loss scaling is supported with plain "
+                    "offload_optimizer but not with zenflow/super_offload; "
+                    "use bf16 there")
             opt_cfg = {"type": config.optimizer.type,
                        "params": config.optimizer.params}
             if zf_cfg.enabled:
@@ -751,7 +756,35 @@ class DeepSpeedTPUEngine:
             if hasattr(g, "copy_to_host_async"):
                 g.copy_to_host_async()
         grads_flat = [np.asarray(jax.device_get(g)) for g in grad_leaves]
-        master, norm = self.offload_optimizer.apply_step(grads_flat, lr, gas)
+
+        denom = gas
+        new_loss_scale = state.loss_scale
+        if self.fp16_enabled:
+            # reference ZeRO-Offload fp16 path (zero/stage_1_and_2.py loss
+            # scaler + CPU-Adam): grads arrive scaled by cur_scale; the
+            # overflow check runs on the HOST copy (free — the bytes are
+            # already here for the C++ Adam), the unscale rides the
+            # denominator, and an overflow skips the whole host update
+            # before any master state is touched.
+            overflow = any(not np.isfinite(g).all() for g in grads_flat)
+            new_loss_scale = update_loss_scale(
+                state.loss_scale, jnp.asarray(overflow), self.config.fp16)
+            if overflow:
+                log_dist(f"offload fp16: overflow, skipping step; scale "
+                         f"{float(state.loss_scale.cur_scale):.0f} -> "
+                         f"{float(new_loss_scale.cur_scale):.0f}")
+                self.state = _dc.replace(
+                    state,
+                    grad_acc=self._zero_like_tree(state.grad_acc,
+                                                  force_device=True),
+                    micro_step=jnp.asarray(0, jnp.int32),
+                    loss_scale=new_loss_scale,
+                    skipped_steps=state.skipped_steps + 1,
+                    global_grad_norm=jnp.asarray(0.0, jnp.float32))
+                return
+            denom = gas * float(state.loss_scale.cur_scale)
+
+        master, norm = self.offload_optimizer.apply_step(grads_flat, lr, denom)
 
         leaves, treedef = jax.tree_util.tree_flatten(state.params)
         # Bucketed batched device_put: transfers within a bucket are issued
@@ -786,6 +819,7 @@ class DeepSpeedTPUEngine:
         self.state = _dc.replace(
             state, params=new_params, grad_acc=zero_acc,
             step=state.step + 1, micro_step=jnp.asarray(0, jnp.int32),
+            loss_scale=new_loss_scale,
             global_grad_norm=jnp.asarray(norm, jnp.float32))
 
     # ------------------------------------------------------------ public API

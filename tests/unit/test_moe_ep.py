@@ -134,3 +134,24 @@ def test_ep_dropless_stage2_no_involuntary_remat(devices8, capfd):
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
     err = capfd.readouterr().err
     assert "Involuntary full rematerialization" not in err
+
+
+def test_ep_uneven_tp_ffn_falls_back_to_spmd(devices8):
+    """EP + TP with an FFN dim that does not divide the model axis must
+    fall back to the SPMD path (GSPMD handles uneven shardings) instead of
+    failing shard_map spec validation."""
+    rng = np.random.RandomState(2)
+    Fo = 25  # not divisible by model=2
+    x = jnp.asarray(rng.randn(B, S, H).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(H, E).astype(np.float32) * 0.1)
+    experts = {k: jnp.asarray(rng.randn(E, H, Fo).astype(np.float32) * 0.1)
+               for k in ("w_gate", "w_up")}
+    experts["w_down"] = jnp.asarray(rng.randn(E, Fo, H).astype(np.float32) * 0.1)
+    cfg = MoEConfig(num_experts=E, top_k=2, drop_tokens=False)
+    reset_topology()
+    out_s, _ = moe_ffn(x, gate_w, experts,
+                       dataclasses.replace(cfg, ep_dispatch="spmd"))
+    initialize_topology(MeshConfig(expert=2, data=2, model=2), devices8)
+    out_e, _ = moe_ffn(x, gate_w, experts, cfg)  # must not raise
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_s),
+                               rtol=1e-5, atol=1e-5)

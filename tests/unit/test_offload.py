@@ -62,14 +62,25 @@ def test_offload_nvme_spills(tmp_path):
     assert any(f.startswith("m_") for f in spilled)
 
 
-def test_offload_fp16_rejected():
-    with pytest.raises(NotImplementedError):
+def test_offload_fp16_contract():
+    """Plain offload + fp16 is supported (host-side scaler); the selective/
+    async update paths (zenflow, super_offload) still reject fp16."""
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "fp16": {"enabled": True},
+                "zero_optimization": {"stage": 2,
+                                      "offload_optimizer": {"device": "cpu"}}})
+    assert engine.offload_optimizer is not None and engine.fp16_enabled
+    with pytest.raises(NotImplementedError, match="zenflow|super_offload"):
         deepspeed_tpu.initialize(
             model=simple_mlp_spec(),
             config={"train_micro_batch_size_per_gpu": 2,
                     "fp16": {"enabled": True},
-                    "zero_optimization": {"stage": 2,
-                                          "offload_optimizer": {"device": "cpu"}}})
+                    "zero_optimization": {
+                        "stage": 2,
+                        "offload_optimizer": {"device": "cpu",
+                                              "super_offload": True}}})
 
 
 def test_nvme_swap_is_pipelined(tmp_path, monkeypatch):
@@ -215,3 +226,45 @@ def test_superoffload_nvme_io_runs_concurrently(tmp_path, monkeypatch):
     opt.apply_step([g.copy() for g in gs], lr=1e-3, denom=1.0)  # fetch+step
     opt.shutdown()
     assert conc["peak"] >= 2, f"NVMe IO never overlapped: {conc}"
+
+
+def test_offload_fp16_dynamic_scaling_survives_overflow():
+    """fp16 + ZeRO-Offload (reference zero/stage_1_and_2.py loss scaler +
+    CPU-Adam): grads reach the host scaled, the unscale rides the
+    denominator, and an injected overflow SKIPS the host update (params
+    and step untouched), halves the scale past hysteresis, and training
+    resumes cleanly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    engine = _engine(**{"bf16": {"enabled": False},
+                        "fp16": {"enabled": True, "initial_scale_power": 10,
+                                 "hysteresis": 1}})
+    losses = [float(engine.train_batch(random_batch(batch_size=16,
+                                                    seed=i % 4, gas=1)))
+              for i in range(8)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    scale_before = float(engine.state.loss_scale.cur_scale)
+    step_before = int(engine.state.step)
+    params_before = jax.tree_util.tree_map(np.asarray, engine.state.params)
+
+    # inject an overflow into the accumulated grads at the boundary
+    engine.state = dataclasses.replace(
+        engine.state, grad_acc=jax.tree_util.tree_map(
+            lambda g: jnp.full_like(g, jnp.inf), engine.state.grad_acc),
+        micro_step=jnp.asarray(engine.config.gradient_accumulation_steps - 1, jnp.int32))
+    engine._apply_step_offload()
+
+    assert int(engine.state.step) == step_before  # skipped, not applied
+    assert int(engine.state.skipped_steps) >= 1
+    assert float(engine.state.loss_scale.cur_scale) < scale_before
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params_before),
+            jax.tree_util.tree_leaves_with_path(engine.state.params)):
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(pa))
+
+    # training resumes and the grad_acc was re-zeroed
+    l2 = [float(engine.train_batch(random_batch(batch_size=16, seed=i % 4,
+                                                gas=1))) for i in range(4)]
+    assert np.isfinite(l2).all()
