@@ -202,6 +202,9 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     zero_cfg = {"stage": stage}
     if os.environ.get("DSTPU_BENCH_OFFLOAD") == "1":
         zero_cfg["offload_optimizer"] = {"device": "cpu"}
+    if os.environ.get("DSTPU_BENCH_PREFETCH") == "1":
+        # stage-3 manual prefetch A/B (2x-unrolled layer scan)
+        zero_cfg["zero3_param_prefetch"] = True
     opt_params = {"lr": 1e-4, "weight_decay": 0.1}
     if os.environ.get("DSTPU_BENCH_MU_DTYPE"):
         # bf16 exp_avg: -2 bytes/param of optimizer HBM (helps the 1b

@@ -87,6 +87,13 @@ class TransformerConfig:
     #: EP dispatch: "auto" = explicit all-to-all shard_map when the mesh
     #: has an expert axis (moe/ep_dispatch.py); "spmd" = partitioner-driven
     moe_ep_dispatch: str = "auto"
+    #: stage-3 manual param prefetch (engine-set per trace, like qwz):
+    #: the layer scan runs 2x-unrolled, so each trip holds two
+    #: independent gather->compute chains and layer i+1's param
+    #: all-gather can overlap layer i's compute (the compiled analogue of
+    #: the reference's PartitionedParameterCoordinator prefetch,
+    #: partitioned_param_coordinator.py:285)
+    zero3_prefetch: bool = False
     # PR-MoE residual experts (reference moe/layer.py use_residual): a dense
     # MLP runs beside the MoE and a learned 2-way coefficient mixes them
     moe_use_residual: bool = False
@@ -521,7 +528,16 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
             y, aux = block(carry, layer)
             return y, aux
 
-        x, auxs = jax.lax.scan(scan_body, x, params["layers"])
+        # stage-3 manual prefetch (zero3_prefetch, engine-set per trace):
+        # unroll the layer scan 2x so each trip holds TWO independent
+        # gather->compute chains — layer i+1's param all-gather has no
+        # data dependence on layer i's compute and the latency-hiding
+        # scheduler overlaps them.  Unlike carrying gathered params across
+        # iterations (tried: the carry becomes a bwd residual and
+        # materializes EVERY gathered layer, defeating stage 3), unroll
+        # keeps residuals sharded and per-layer — same memory, real slack.
+        x, auxs = jax.lax.scan(scan_body, x, params["layers"],
+                               unroll=2 if cfg.zero3_prefetch else 1)
         aux = jnp.sum(auxs)
     else:
         aux = jnp.asarray(0.0, jnp.float32)

@@ -123,6 +123,13 @@ class ZeroConfig(ConfigModel):
     # launch cost, so persistence is purely an opt-in memory/latency trade.
     stage3_param_persistence_threshold: int = 0
     stage3_gather_16bit_weights_on_model_save: bool = False
+    #: MANUAL stage-3 prefetch: run the layer scan 2x-unrolled
+    #: (models/transformer.py) so consecutive layers' param gathers and
+    #: compute can overlap, instead of leaving scheduling slack entirely
+    #: to XLA.  Off by default — A/B on hardware (bench STAGE=3
+    #: PREFETCH=1) decides; the reference's analogue is the
+    #: PartitionedParameterCoordinator prefetch.
+    zero3_param_prefetch: bool = False
     # ZeRO++ style knobs: quantized weight gather / hierarchical partition
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False

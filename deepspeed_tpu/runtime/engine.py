@@ -246,6 +246,21 @@ class DeepSpeedTPUEngine:
                     "zero_quantized_weights needs stage 3, a models/* "
                     "transformer (qwZ gather points), and no pipeline "
                     "parallelism; ignoring")
+        self._zero3_prefetch = False
+        if zc.zero3_param_prefetch:
+            model_cfg = getattr(self.model, "config", None)
+            if zc.stage == 3 and model_cfg is not None \
+                    and hasattr(model_cfg, "zero3_prefetch") \
+                    and getattr(model_cfg, "scan_layers", False) \
+                    and self.topology.pipe_parallel_size == 1:
+                self._zero3_prefetch = True
+                log_dist("stage-3 manual param prefetch: 2x-unrolled layer "
+                         "scan (per-layer gathers overlap compute)")
+            else:
+                logger.warning(
+                    "zero3_param_prefetch needs stage 3, a models/* "
+                    "transformer with scan_layers, and no pipeline "
+                    "parallelism; ignoring")
         if zc.zero_quantized_gradients:
             from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, REPL_AXIS,
                                          SEQ_AXIS)
@@ -329,18 +344,27 @@ class DeepSpeedTPUEngine:
 
     # ------------------------------------------------------------- programs
     def _model_loss(self, p, batch, rng):
-        """model.loss_fn with the engine's qwZ flag applied for the duration
-        of the trace (not a permanent config mutation — engines may share a
-        model object)."""
+        """model.loss_fn with the engine's qwZ / stage-3-prefetch flags
+        applied for the duration of the trace (not a permanent config
+        mutation — engines may share a model object)."""
         mc = getattr(self.model, "config", None)
-        if mc is None or not hasattr(mc, "qwz"):
+        has_q = mc is not None and hasattr(mc, "qwz")
+        has_pf = mc is not None and hasattr(mc, "zero3_prefetch")
+        if not (has_q or has_pf):
             return self.model.loss_fn(p, batch, rng)
-        old = mc.qwz
-        mc.qwz = self._qwz
+        old_q = mc.qwz if has_q else None
+        old_pf = mc.zero3_prefetch if has_pf else None
+        if has_q:
+            mc.qwz = self._qwz
+        if has_pf:
+            mc.zero3_prefetch = getattr(self, "_zero3_prefetch", False)
         try:
             return self.model.loss_fn(p, batch, rng)
         finally:
-            mc.qwz = old
+            if has_q:
+                mc.qwz = old_q
+            if has_pf:
+                mc.zero3_prefetch = old_pf
 
     def _fetch_params(self, master_params):
         """Host-offloaded masters (offload_param): stream them into device

@@ -40,6 +40,13 @@ RUNGS = {
     "160m-zero3": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
                    "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
                    "DSTPU_BENCH_STAGE": "3"},
+    # the A/B for the manual prefetch (2x-unrolled layer scan): compare
+    # against 160m-zero3 — if XLA already overlaps, the delta is ~0
+    "160m-zero3-prefetch": {"DSTPU_BENCH_SIZE": "160m",
+                            "DSTPU_BENCH_SEQ": "1024",
+                            "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
+                            "DSTPU_BENCH_STAGE": "3",
+                            "DSTPU_BENCH_PREFETCH": "1"},
     # optimizer offload boundary cost on hardware
     "160m-offload": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
                      "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "10",

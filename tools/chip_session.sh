@@ -36,6 +36,8 @@ timeout 1200 python tools/profile_step.py --size 160m --seq 1024 --bs 16 \
 # 3. the stage/offload/MoE/long-seq/serving rungs
 stamp "bench_sweep 160m-zero3"
 timeout 2000 python tools/bench_sweep.py 160m-zero3
+stamp "bench_sweep 160m-zero3-prefetch (manual prefetch A/B)"
+timeout 2000 python tools/bench_sweep.py 160m-zero3-prefetch
 stamp "bench_sweep 160m-offload"
 timeout 2000 python tools/bench_sweep.py 160m-offload
 stamp "bench_sweep moe-8x160m"
