@@ -14,8 +14,8 @@ import jax.numpy as jnp
 
 from ..runtime.module import ModelSpec
 from .transformer import (TransformerConfig, flops_per_token,
-                          init_transformer_params, transformer_forward,
-                          transformer_partition_rules)
+                          init_transformer_params, nll_pick,
+                          transformer_forward, transformer_partition_rules)
 
 SIZES = {
     "tiny": (64, 2, 4, 128, 256),
@@ -71,7 +71,7 @@ def mlm_loss(cfg: TransformerConfig, params, batch, rng=None):
     logits = mlm_logits(cfg, params, hidden)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     safe = jnp.maximum(labels, 0)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    nll = nll_pick(logp, safe)  # scatter-free bwd under seq sharding
     sel = (labels >= 0).astype(jnp.float32)
     return jnp.sum(nll * sel) / jnp.maximum(jnp.sum(sel), 1.0) + aux
 

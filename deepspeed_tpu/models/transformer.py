@@ -598,10 +598,21 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
 
     logits = logits_fn(cfg, params, hidden)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = nll_pick(logp, targets)
     if m is not None:
         return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0) + aux
     return jnp.mean(nll) + aux
+
+
+def nll_pick(logp: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """-logp[target] as a one-hot contraction, NOT take_along_axis: the
+    gather's transpose is a vocab-dim scatter-add the SPMD partitioner can
+    only reshard by full rematerialization under sequence sharding
+    (docs/PERF_NOTES.md); the contraction transposes to a broadcast
+    multiply, which shards cleanly.  XLA fuses the one-hot (iota+compare)
+    into the reduction — no materialized [.., V] buffer."""
+    onehot = jax.nn.one_hot(targets, logp.shape[-1], dtype=logp.dtype)
+    return -jnp.sum(logp * onehot, axis=-1)
 
 
 def _tiled_nll(cfg: TransformerConfig, params, hidden, targets, mask, chunk: int):
@@ -616,8 +627,7 @@ def _tiled_nll(cfg: TransformerConfig, params, hidden, targets, mask, chunk: int
     def chunk_nll(h, t, m):
         logits = logits_fn(cfg, params, h)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, t[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * m), jnp.sum(m)
+        return jnp.sum(nll_pick(logp, t) * m), jnp.sum(m)
 
     def body(carry, xs):
         s, c = carry

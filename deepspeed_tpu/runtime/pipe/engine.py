@@ -92,7 +92,7 @@ def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
         return x
 
     def head_loss(x, tok_labels):
-        from ...models.transformer import logits_fn
+        from ...models.transformer import logits_fn, nll_pick
 
         h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
                   cfg.norm, cfg.norm_eps)
@@ -101,8 +101,7 @@ def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
         logits = logits_fn(cfg, params, h)[:, :-1]
         targets = tok_labels[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        return jnp.mean(nll_pick(logp, targets))
 
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 

