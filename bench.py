@@ -141,22 +141,24 @@ def _peak_for(device) -> float:
     return PEAK_BF16_FLOPS["cpu"]
 
 
-def _run(size: str, seq: int, micro_bs: int, steps: int,
-         attn_impl=None) -> dict:
-    import jax
-    import jax.numpy as jnp
 
-    import deepspeed_tpu
-    from deepspeed_tpu.models.llama import llama_model
-    from deepspeed_tpu.models.transformer import flops_per_token
+def build_model_and_config(size: str, seq: int, micro_bs: int, env=None,
+                           attn_impl=None, scan_layers=None):
+    """Model + ds-config for a bench rung — the SINGLE source of truth,
+    shared with tools/bench_estimate.py (an estimate must compile the same
+    program the bench runs; a drifted copy estimates the wrong rung).
 
+    ``env``: mapping of DSTPU_BENCH_* knobs (default os.environ).
+    ``scan_layers``: estimator override (cost analysis is while-loop
+    trip-count-unaware, so estimates compile unrolled layers)."""
+    env = os.environ if env is None else env
     # big models need remat + bf16 grad accumulation + tiled loss to fit
     # one chip's HBM; 160m runs leaner without them (see docs/PERF_NOTES.md)
     big = size in ("1b", "7b", "13b", "70b")
-    remat = os.environ.get("DSTPU_BENCH_REMAT", "1" if big else "0") == "1"
-    acc = os.environ.get("DSTPU_BENCH_ACC", "bf16" if big else "fp32")
-    if os.environ.get("DSTPU_BENCH_LOSS_CHUNK"):
-        chunk = int(os.environ["DSTPU_BENCH_LOSS_CHUNK"])
+    remat = env.get("DSTPU_BENCH_REMAT", "1" if big else "0") == "1"
+    acc = env.get("DSTPU_BENCH_ACC", "bf16" if big else "fp32")
+    if env.get("DSTPU_BENCH_LOSS_CHUNK"):
+        chunk = int(env["DSTPU_BENCH_LOSS_CHUNK"])
     elif big and seq > 2:
         # largest divisor of seq-1 (the shifted-label length) up to 512;
         # a near-prime seq-1 would degenerate into thousands of tiny
@@ -168,19 +170,21 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     else:
         chunk = 0
     over = {}
+    if scan_layers is not None:
+        over["scan_layers"] = scan_layers
     if remat:
         over.update(remat=True,
-                    remat_policy=os.environ.get("DSTPU_BENCH_REMAT_POLICY",
-                                                "nothing_saveable"))
+                    remat_policy=env.get("DSTPU_BENCH_REMAT_POLICY",
+                                         "nothing_saveable"))
     if chunk:
         over["loss_chunk"] = chunk
-    attn_impl = attn_impl or os.environ.get("DSTPU_BENCH_ATTN")
+    attn_impl = attn_impl or env.get("DSTPU_BENCH_ATTN")
     if attn_impl:
         over["attn_impl"] = attn_impl
     # family knob (VERDICT r3 weak #3: MoE perf must be measurable on the
     # same harness): mixtral routes tokens through the dropless MoE path;
     # flops_per_token counts only the active (top-k) experts
-    family = os.environ.get("DSTPU_BENCH_MODEL", "llama")
+    family = env.get("DSTPU_BENCH_MODEL", "llama")
     if family == "mixtral":
         from deepspeed_tpu.models.mixtral import mixtral_model
 
@@ -190,6 +194,8 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
         model = mixtral_model(size, max_seq_len=seq, moe_drop_tokens=False,
                               **over)
     elif family == "llama":
+        from deepspeed_tpu.models.llama import llama_model
+
         model = llama_model(size, max_seq_len=seq, **over)
     else:
         # the family name is interpolated into the published metric — a
@@ -198,19 +204,19 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     # stage/offload rungs are env-selectable (VERDICT r3 next #2): stage-3
     # and the offload boundary must be measurable on the same model/chip,
     # not hardcoded out of the artifact
-    stage = _int_env("DSTPU_BENCH_STAGE", 1)
+    stage = int(env.get("DSTPU_BENCH_STAGE", "1") or 1)
     zero_cfg = {"stage": stage}
-    if os.environ.get("DSTPU_BENCH_OFFLOAD") == "1":
+    if env.get("DSTPU_BENCH_OFFLOAD") == "1":
         zero_cfg["offload_optimizer"] = {"device": "cpu"}
-    if os.environ.get("DSTPU_BENCH_PREFETCH") == "1":
+    if env.get("DSTPU_BENCH_PREFETCH") == "1":
         # stage-3 manual prefetch A/B (2x-unrolled layer scan)
         zero_cfg["zero3_param_prefetch"] = True
     opt_params = {"lr": 1e-4, "weight_decay": 0.1}
-    if os.environ.get("DSTPU_BENCH_MU_DTYPE"):
+    if env.get("DSTPU_BENCH_MU_DTYPE"):
         # bf16 exp_avg: -2 bytes/param of optimizer HBM (helps the 1b
         # model fit one chip without offload)
-        opt_params["mu_dtype"] = os.environ["DSTPU_BENCH_MU_DTYPE"]
-    if os.environ.get("DSTPU_BENCH_FUSED_OPT") == "1":
+        opt_params["mu_dtype"] = env["DSTPU_BENCH_MU_DTYPE"]
+    if env.get("DSTPU_BENCH_FUSED_OPT") == "1":
         opt_params["fused_kernel"] = True
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
@@ -221,6 +227,22 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
         "gradient_clipping": 1.0,
         "data_types": {"grad_accum_dtype": acc},
     }
+    return model, config, {"family": family, "stage": stage,
+                           "zero_cfg": zero_cfg}
+
+
+def _run(size: str, seq: int, micro_bs: int, steps: int,
+         attn_impl=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.models.transformer import flops_per_token
+
+    model, config, _meta = build_model_and_config(
+        size, seq, micro_bs, attn_impl=attn_impl)
+    family, stage, zero_cfg = _meta["family"], _meta["stage"], _meta["zero_cfg"]
     engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
     dp = engine.topology.dp_world_size
     n_chips = engine.topology.world_size

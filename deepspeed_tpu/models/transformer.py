@@ -720,6 +720,24 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache,
     return logits, new_cache
 
 
+def param_count(cfg: TransformerConfig) -> int:
+    """Total STORED parameter count: embeddings (tied or not), attention,
+    and ALL experts' MLPs — what weight-bytes math needs.
+    ``flops_per_token`` instead prices only the ACTIVE (top-k) params."""
+    mlp = cfg.hidden_size * cfg.ffn_size * (3 if cfg.activation == "swiglu" else 2)
+    if cfg.moe_experts > 0:
+        mlp = mlp * cfg.moe_experts + cfg.hidden_size * cfg.moe_experts
+        if cfg.moe_use_residual:
+            mlp += 2 * cfg.hidden_size * cfg.ffn_size + 2 * cfg.hidden_size
+        if cfg.moe_shared_expert > 0:
+            mlp += 3 * cfg.hidden_size * cfg.moe_shared_expert + cfg.hidden_size
+    return (cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_embeddings else 2)
+            + cfg.n_layers * (
+                cfg.hidden_size * cfg.head_dim * (cfg.n_heads + 2 * cfg.kv_heads)
+                + cfg.n_heads * cfg.head_dim * cfg.hidden_size
+                + mlp))
+
+
 def flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
     """6*N_active + attention flops per token (training fwd+bwd).
 

@@ -1,0 +1,238 @@
+"""CPU-mesh contingency sweep: compiled-HLO cost-model MFU ESTIMATES.
+
+When the tunneled chip is dark for a whole round (rounds 3 and 4), the
+driver artifact records a CPU fallback and every perf question stays
+open.  This tool compiles each training rung's REAL step program (same
+model, config and shapes as tools/bench_sweep.py) for a single CPU
+device, reads XLA's cost analysis (flops + bytes accessed), and converts
+to a v5e-one-chip time estimate via a two-term roofline:
+
+    t_est = max(hw_flops / (PEAK * mxu_eff), bytes / (HBM_BW * bw_eff))
+    mfu_est = model_flops / (t_est * PEAK)
+
+EVERY number this tool emits is an ESTIMATE (method field says so):
+XLA's CPU fusion differs from TPU, cost analysis counts post-fusion
+bytes approximately, and the efficiency factors are assumptions
+(defaults: mxu_eff 0.6 — between the round-2 measured 0.445 fwd+bwd and
+the 0.54 reference comparator; bw_eff 0.8).  The point is to rank rungs
+and bound expectations for round 6, not to claim hardware results.
+
+Serving rungs are estimated analytically (decode is bandwidth-bound:
+tok/s <= HBM_BW * bw_eff / bytes-touched-per-token).
+
+Usage:  python tools/bench_estimate.py [rung ...]   (default: all)
+Writes docs/BENCH_ESTIMATE.json incrementally, one entry per rung.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK = 197e12       # v5e bf16 (bench.py PEAK_BF16_FLOPS)
+HBM_BW = 819e9      # v5e HBM bytes/s
+MXU_EFF = float(os.environ.get("DSTPU_EST_MXU_EFF", "0.6"))
+BW_EFF = float(os.environ.get("DSTPU_EST_BW_EFF", "0.8"))
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "docs", "BENCH_ESTIMATE.json")
+
+_CHILD = """
+import json, os, sys
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+sys.path.insert(0, %(root)r)
+env = %(env)r
+for k, v in env.items():
+    os.environ[k] = v
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import flops_per_token
+from bench import build_model_and_config
+
+size = env.get("DSTPU_BENCH_SIZE", "160m")
+seq = int(env.get("DSTPU_BENCH_SEQ", "1024"))
+bs = int(env.get("DSTPU_BENCH_BS", "16"))
+# scan_layers=False: XLA cost analysis is while-loop trip-count-unaware —
+# a scanned program's per-layer flops/bytes would be counted ONCE
+# (estimate-only variant; the bench itself runs the scanned program)
+model, config, _meta = build_model_and_config(size, seq, bs, env=env,
+                                              scan_layers=False)
+engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
+ids = jnp.asarray(np.random.RandomState(0).randint(
+    0, model.config.vocab_size, (1, bs, seq)), jnp.int32)
+batch = {"input_ids": ids}
+fn = engine._train_batch
+lowered = fn.lower(engine.state, batch, jax.random.PRNGKey(0))
+cost = lowered.compile().cost_analysis()
+if isinstance(cost, list):
+    cost = cost[0]
+tokens = bs * seq
+print(json.dumps({
+    "hlo_flops": float(cost.get("flops", -1)),
+    "hlo_bytes": float(cost.get("bytes accessed", -1)),
+    "model_flops": float(flops_per_token(model.config, seq)) * tokens,
+    "tokens": tokens,
+    "n_params": int(sum(x.size for x in jax.tree_util.tree_leaves(
+        engine.state.params))),
+}))
+"""
+
+
+def _load():
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            return json.load(f)
+    return {}
+
+
+def _save(data):
+    with open(OUT, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def estimate_training(name: str, env: dict) -> dict:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = subprocess.run(
+        [sys.executable, "-c", _CHILD % {"root": root, "env": env}],
+        capture_output=True, text=True,
+        timeout=int(os.environ.get("DSTPU_EST_TIMEOUT", "1800")))
+    line = child.stdout.strip().splitlines()[-1] if child.stdout.strip() else ""
+    if child.returncode != 0 or not line.startswith("{"):
+        return {"rung": name, "error": (child.stderr or "no output")[-500:]}
+    c = json.loads(line)
+    model_flops = c["model_flops"]
+    # hw flops: XLA's own count of what the compiled program executes
+    # (includes remat recompute); fall back to model flops if unreported
+    hw_flops = c["hlo_flops"] if c["hlo_flops"] > 0 else model_flops
+    ideal_bytes = c["hlo_bytes"]
+    t_flops = hw_flops / (PEAK * MXU_EFF)
+    t_bytes = ideal_bytes / (HBM_BW * BW_EFF) if ideal_bytes > 0 else 0.0
+    return {
+        "rung": name,
+        "method": "ESTIMATE: XLA CPU-compiled cost analysis + v5e roofline "
+                  f"(peak {PEAK:.3g} flops/s, bw {HBM_BW:.3g} B/s, "
+                  f"mxu_eff {MXU_EFF}, bw_eff {BW_EFF}) — NOT a hardware "
+                  "measurement.  The calibrated fields anchor to the ONE "
+                  "on-chip measurement that exists (round-2 flagship, MFU "
+                  "0.384 => 0.198 s/step) and transfer cross-rung by "
+                  "relative compiled flops; byte counts come from the CPU "
+                  "backend's fusion and overstate TPU traffic.",
+        "model_flops_per_step": model_flops,
+        "hw_flops_per_step_hlo": hw_flops,
+        "bytes_per_step_hlo": ideal_bytes,
+        "tokens_per_step": c["tokens"],
+        "n_params": c["n_params"],
+        "bound_hint": "memory" if t_bytes > t_flops else "compute",
+        "est_step_seconds_flops_roofline": t_flops,
+        "est_step_seconds_bytes_roofline": t_bytes,
+    }
+
+
+# the one hardware anchor: round-2 on-chip flagship (docs/PERF_NOTES.md)
+ANCHOR_RUNG = "flagship"
+ANCHOR_MEASURED_STEP_S = 0.198  # 160m seq1024 bs16, MFU 0.384 on v5e
+
+
+def _calibrate(data: dict) -> None:
+    anchor = data.get(ANCHOR_RUNG)
+    if not anchor or "est_step_seconds_flops_roofline" not in anchor:
+        return
+    k = ANCHOR_MEASURED_STEP_S / anchor["est_step_seconds_flops_roofline"]
+    data["_calibration"] = {
+        "anchor_rung": ANCHOR_RUNG,
+        "anchor_measured_step_seconds": ANCHOR_MEASURED_STEP_S,
+        "scale_vs_flops_roofline": k,
+        "note": "calibrated fields = flops-roofline time scaled so the "
+                "anchor matches its round-2 on-chip measurement; offload/"
+                "host-bound rungs will be optimistic (the anchor embeds "
+                "no host traffic)",
+    }
+    for name, entry in data.items():
+        if isinstance(entry, dict) and \
+                "est_step_seconds_flops_roofline" in entry:
+            t = entry["est_step_seconds_flops_roofline"] * k
+            entry["est_step_seconds_calibrated"] = t
+            entry["est_tokens_per_second_calibrated"] = \
+                entry["tokens_per_step"] / t
+            entry["est_mfu_calibrated"] = \
+                entry["model_flops_per_step"] / (t * PEAK)
+
+
+def estimate_serving(name: str, env: dict) -> dict:
+    """Decode is memory-bound: every batched decode step streams the
+    weights plus the live slots' KV pages; tok/s/chip <= batch * BW /
+    bytes-per-step."""
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.models.transformer import param_count
+
+    size = env.get("DSTPU_IBENCH_SIZE", "160m")
+    cfg = llama_config(size, max_seq_len=4096)
+    n_params = param_count(cfg)
+    wq = env.get("DSTPU_IBENCH_WQ")
+    if wq and wq not in ("4", "8"):
+        return {"rung": name, "error": f"unsupported DSTPU_IBENCH_WQ {wq!r}"}
+    wbytes = int(wq) / 8 if wq else 2
+    kv_el = 1 if env.get("DSTPU_IBENCH_KVQ") == "1" else 2
+    ctx = int(env.get("DSTPU_IBENCH_PROMPT", "512")) + \
+        int(env.get("DSTPU_IBENCH_GEN", "128")) // 2
+    nreq = int(env.get("DSTPU_IBENCH_NREQ", "32"))
+    # bench_inference decodes DSTPU_IBENCH_SLOTS concurrent slots (its
+    # default 8), not the whole request queue
+    batch = min(int(env.get("DSTPU_IBENCH_SLOTS", "8")), nreq)
+    kv_bytes = (2 * cfg.n_layers * cfg.kv_heads * cfg.head_dim
+                * ctx * kv_el) * batch
+    per_step = n_params * wbytes + kv_bytes  # one batched decode step
+    t_step = per_step / (HBM_BW * BW_EFF)
+    return {
+        "rung": name,
+        "method": "ESTIMATE: analytic bandwidth roofline for batched "
+                  f"decode (bw {HBM_BW:.3g} * {BW_EFF}) — NOT a hardware "
+                  "measurement",
+        "batch": batch,
+        "weight_bytes": n_params * wbytes,
+        "kv_bytes_at_mid_gen": kv_bytes,
+        "est_decode_steps_per_second": 1.0 / t_step,
+        "est_tokens_per_second": batch / t_step,
+        "bound": "memory",
+    }
+
+
+def main() -> int:
+    from tools.bench_sweep import RUNGS
+
+    names = sys.argv[1:] or list(RUNGS)
+    data = _load()
+    # the anchor rung is always computed (calibration needs it); a stored
+    # FAILED anchor (error entry) is re-queued, not kept forever
+    anchor_ok = "est_step_seconds_flops_roofline" in data.get(ANCHOR_RUNG, {})
+    if ANCHOR_RUNG not in names and not anchor_ok:
+        names = [ANCHOR_RUNG] + names
+    for name in names:
+        if name not in RUNGS:
+            print(f"unknown rung {name}", file=sys.stderr)
+            continue
+        env = {k: v for k, v in RUNGS[name].items() if not k.startswith("_")}
+        print(f"[bench_estimate] {name} ...", flush=True)
+        try:
+            if RUNGS[name].get("_tool") == "bench_inference":
+                entry = estimate_serving(name, env)
+            else:
+                entry = estimate_training(name, env)
+        except subprocess.TimeoutExpired:
+            entry = {"rung": name, "error": "compile timeout"}
+        data[name] = entry
+        _calibrate(data)
+        _save(data)
+        print(json.dumps(entry), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
