@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import shlex
 import subprocess
 import sys
 import threading
@@ -182,21 +183,39 @@ class ResourceManager:
         return cap if self.max_parallel is None else min(cap, self.max_parallel)
 
 
+#: hosts treated as "this machine" — no launcher prefix needed
+_LOCAL_HOSTS = ("", "localhost", "127.0.0.1")
+
+
 class SubprocessTrialRunner:
     """Run one experiment as a subprocess of ``user_script`` (the reference
     run_experiment contract, scheduler.py:410): the candidate config is
     written to ``<results_dir>/<name>/exp.json``, the script is invoked with
     ``--exp_config <path>`` plus ``user_args``, chip slots are passed via
     env, and the LAST line of stdout that parses as JSON must carry
-    ``{"throughput": <float>}``.  stderr is saved next to the config."""
+    ``{"throughput": <float>}``.  stderr is saved next to the config.
+
+    Cross-host dispatch (reference ResourceManager runs trials on the
+    RESERVED node, scheduler.py:32, via its pdsh/ssh launcher): when the
+    reservation's host is not local, the command is prefixed with
+    ``launcher`` — a template whose elements may contain ``{host}``
+    (default: ssh).  Trial env rides as explicit ``env K=V`` tokens so it
+    crosses the launcher; paths are absolute, assuming the shared
+    filesystem the reference's multi-node autotuning assumes too.
+    (Distinct from launcher/runner.py's ``build_launch_commands``, which
+    ssh-launches one COORDINATED rank per host of a single training job;
+    a trial here is a self-contained experiment on one reserved host.)"""
 
     def __init__(self, user_script: str, user_args: Optional[List[str]] = None,
                  results_dir: str = "autotuning_results",
-                 timeout_s: float = 600.0):
-        self.user_script = user_script
+                 timeout_s: float = 600.0,
+                 launcher: Optional[List[str]] = None):
+        self.user_script = os.path.abspath(user_script)
         self.user_args = list(user_args or [])
-        self.results_dir = results_dir
+        self.results_dir = os.path.abspath(results_dir)
         self.timeout_s = timeout_s
+        self.launcher = (launcher if launcher is not None
+                         else ["ssh", "-o", "BatchMode=yes", "{host}"])
 
     def __call__(self, exp: Dict[str, Any], res: Reservation) -> Optional[float]:
         exp_dir = os.path.join(self.results_dir, str(exp["name"]).replace("/", "_"))
@@ -205,12 +224,25 @@ class SubprocessTrialRunner:
         with open(cfg_path, "w") as f:
             json.dump(exp.get("config", {}), f)
         env = dict(os.environ)
-        env["DSTPU_TRIAL_SLOTS"] = str(res.n_slots)
-        env["DSTPU_TRIAL_HOST"] = res.node.host
+        trial_env = {"DSTPU_TRIAL_SLOTS": str(res.n_slots),
+                     "DSTPU_TRIAL_HOST": res.node.host}
+        env.update(trial_env)
+        cmd = [sys.executable, self.user_script, "--exp_config", cfg_path,
+               *self.user_args]
+        if res.node.host not in _LOCAL_HOSTS:
+            prefix = [a.format(host=res.node.host) for a in self.launcher]
+            # ssh space-joins its trailing args into ONE remote shell
+            # command: quote every token (like launcher/runner.py:96) and
+            # hand ssh a single string.  env= does not cross ssh — the
+            # trial env rides as env(1) tokens; `timeout` runs REMOTELY so
+            # a local ssh kill cannot orphan a trial that still holds the
+            # reserved chips.
+            remote = ["env", *[f"{k}={v}" for k, v in trial_env.items()],
+                      "timeout", str(int(self.timeout_s)), *cmd]
+            cmd = prefix + [" ".join(shlex.quote(t) for t in remote)]
         proc = subprocess.run(
-            [sys.executable, self.user_script, "--exp_config", cfg_path,
-             *self.user_args],
-            capture_output=True, text=True, timeout=self.timeout_s, env=env)
+            cmd, capture_output=True, text=True, timeout=self.timeout_s,
+            env=env)
         with open(os.path.join(exp_dir, "stderr.log"), "w") as f:
             f.write(proc.stderr)
         if proc.returncode != 0:

@@ -398,3 +398,49 @@ def test_autotuner_tunes_fused_kernel():
     assert cfg_on["optimizer"]["params"]["lr"] == 1e-3  # params merged
     result = tuner.tune()
     assert result["best"] is not None and len(result["trials"]) == 2
+
+
+def test_trial_runner_cross_host_launcher(tmp_path):
+    """Cross-host dispatch (reference ResourceManager + pdsh/ssh launcher,
+    autotuning/scheduler.py:32): a trial reserved on a remote node is
+    launched through the launcher template with the trial env crossing as
+    env(1) tokens; local nodes bypass the launcher."""
+    import os
+    import sys
+
+    from deepspeed_tpu.autotuning.scheduler import (Node, Reservation,
+                                                    SubprocessTrialRunner)
+
+    fake_ssh = tmp_path / "fake_ssh.py"
+    # mirror REAL ssh semantics: the trailing args are space-joined into
+    # ONE string interpreted by the remote shell — this is what catches
+    # unquoted paths/metachars (json-derived exp names contain both)
+    fake_ssh.write_text(
+        "import os, sys\n"
+        "open(os.environ['FAKE_SSH_LOG'], 'a').write(sys.argv[1] + '\\n')\n"
+        "os.execvp('/bin/sh', ['/bin/sh', '-c', ' '.join(sys.argv[2:])])\n")
+    trial = tmp_path / "trial.py"
+    trial.write_text(
+        "import json, os, sys\n"
+        "cfg = json.load(open(sys.argv[sys.argv.index('--exp_config') + 1]))\n"
+        "print(json.dumps({'throughput': cfg['bs'] * 10.0,"
+        " 'host': os.environ['DSTPU_TRIAL_HOST'],"
+        " 'slots': os.environ['DSTPU_TRIAL_SLOTS']}))\n")
+    log = tmp_path / "hosts.log"
+    os.environ["FAKE_SSH_LOG"] = str(log)
+    try:
+        runner = SubprocessTrialRunner(
+            str(trial), results_dir=str(tmp_path / "results"),
+            launcher=[sys.executable, str(fake_ssh), "{host}"])
+        # a default exp name is json.dumps(config): spaces AND quotes must
+        # survive the remote shell (the repo quoting contract)
+        remote = runner({"name": '{"bs": 4}', "config": {"bs": 4}},
+                        Reservation(Node("worker-7", 4), 2))
+        assert remote == 40.0
+        assert log.read_text().splitlines() == ["worker-7"]
+        local = runner({"name": "e2", "config": {"bs": 2}},
+                       Reservation(Node("localhost", 4), 1))
+        assert local == 20.0
+        assert log.read_text().splitlines() == ["worker-7"]  # no new entry
+    finally:
+        os.environ.pop("FAKE_SSH_LOG", None)
