@@ -2,33 +2,79 @@
 
 from __future__ import annotations
 
+import json
+import subprocess
 import sys
 
 
-def main() -> int:
-    import jax
+def _probe_devices(timeout_s: float) -> dict:
+    """Backend/device info from a SUBPROCESS with a hard timeout: jax
+    backend init happens inside an uninterruptible C call, and a wedged
+    accelerator tunnel must hang a report tool for ``timeout_s``, not
+    forever (same contract as bench.py's probe).
+
+    When JAX_PLATFORMS pins an explicit platform, the child RE-PINS it via
+    jax.config too — a site PJRT plugin may have already pinned another
+    platform through jax.config, which the env var alone does not override
+    (bench.py _pin_cpu) — so a CPU-pinned run (e.g. the test suite) never
+    touches, or kill-probes, a tunneled accelerator."""
+    import os
+
+    code = ("import json, os, jax\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p:\n"
+            "    jax.config.update('jax_platforms', p)\n"
+            "d = jax.devices()\n"
+            "print(json.dumps({'backend': jax.default_backend(), "
+            "'n': len(d), 'kind': d[0].device_kind if d else '-', "
+            "'procs': jax.process_count()}))")
+    if not os.environ.get("JAX_PLATFORMS"):
+        print(f"(probing accelerator backend, up to {timeout_s:.0f}s — "
+              "NOTE: killing a mid-init client can wedge a tunneled "
+              "lease; raise --device-timeout if init is merely slow)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        if proc.returncode == 0:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        return {"error": proc.stderr.strip()[-200:] or "probe failed"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"backend init hung > {timeout_s:.0f}s "
+                         "(wedged accelerator lease?)"}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import importlib.metadata as md
+
+    ap = argparse.ArgumentParser(
+        "dstpu-report", description=__doc__)
+    ap.add_argument("--device-timeout", type=float, default=240.0,
+                    help="seconds to wait for accelerator backend init "
+                         "(bench.py's probe budget; killing a mid-init "
+                         "client can wedge a tunneled lease)")
+    args = ap.parse_args(argv)
+
+    def version(pkg):
+        try:
+            return md.version(pkg)
+        except md.PackageNotFoundError:
+            return "MISSING"
 
     print("-" * 60)
     print("DeepSpeed-TPU environment report")
     print("-" * 60)
     print(f"python ................ {sys.version.split()[0]}")
-    print(f"jax ................... {jax.__version__}")
-    try:
-        import flax
-
-        print(f"flax .................. {flax.__version__}")
-    except ImportError:
-        print("flax .................. MISSING")
-    try:
-        import optax
-
-        print(f"optax ................. {optax.__version__}")
-    except ImportError:
-        print("optax ................. MISSING")
-    print(f"backend ............... {jax.default_backend()}")
-    devs = jax.devices()
-    print(f"devices ............... {len(devs)} x {devs[0].device_kind if devs else '-'}")
-    print(f"process count ......... {jax.process_count()}")
+    for pkg in ("jax", "flax", "optax"):
+        print(f"{pkg} {'.' * (22 - len(pkg))} {version(pkg)}")
+    dev = _probe_devices(args.device_timeout)
+    if "error" in dev:
+        print(f"backend ............... UNREACHABLE: {dev['error']}")
+    else:
+        print(f"backend ............... {dev['backend']}")
+        print(f"devices ............... {dev['n']} x {dev['kind']}")
+        print(f"process count ......... {dev['procs']}")
     print("-" * 60)
     print("native ops:")
     from .ops.op_builder import BUILDERS

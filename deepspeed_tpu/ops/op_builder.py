@@ -20,7 +20,19 @@ from typing import Dict, List, Optional
 
 from ..utils.logging import logger
 
-CSRC = Path(__file__).resolve().parent.parent.parent / "csrc"
+def _find_csrc() -> Path:
+    """C++ sources: the DSTPU_CSRC env override, else the source-tree
+    layout (repo root /csrc — what ``pip install -e .``, the documented
+    install, sees).  Non-editable installs don't ship csrc; point
+    DSTPU_CSRC at a checkout's csrc/ to enable native ops there (the
+    missing-path error surfaces at load())."""
+    env = os.environ.get("DSTPU_CSRC")
+    if env:
+        return Path(env)
+    return Path(__file__).resolve().parent.parent.parent / "csrc"
+
+
+CSRC = _find_csrc()
 CACHE = Path(os.environ.get("DSTPU_OP_CACHE",
                             os.path.expanduser("~/.cache/deepspeed_tpu"))) / "ops"
 

@@ -121,7 +121,7 @@ def test_flops_profiler_reports_through_engine():
 def test_env_report_runs():
     from deepspeed_tpu.env_report import main
 
-    assert main() == 0
+    assert main([]) == 0
 
 
 def test_per_module_flops_breakdown():
