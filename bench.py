@@ -512,6 +512,11 @@ if __name__ == "__main__":
             _pin_cpu()
             main()
         elif backend == "cpu":
+            # the probe short-circuits on JAX_PLATFORMS=cpu, but a site
+            # PJRT plugin may have pinned another platform via jax.config
+            # (env var alone does not override) — pin for real or main()
+            # hangs on the very backend the probe promised to avoid
+            _pin_cpu()
             main()  # no accelerator: in-process, nothing can wedge
         else:
             sys.exit(_parent_ladder())

@@ -30,7 +30,7 @@ from ...models.transformer import TransformerConfig
 from ...runtime.config_utils import ConfigModel
 from ...runtime.precision import cast_tree
 from ...utils.logging import logger
-from .model_runner import paged_decode, paged_prefill
+from .model_runner import paged_decode, paged_prefill, paged_prefill_chunk
 from .ragged import BlockAllocator, KVBlockConfig, PagedKVCache, SequenceState
 
 
@@ -42,6 +42,11 @@ class RaggedInferenceConfig(ConfigModel):
     max_seqs: int = 8
     max_pages_per_seq: int = 16
     min_prefill_bucket: int = 16
+    #: chunked prefill (FastGen Dynamic SplitFuse): process prompts in
+    #: chunks of this many tokens (rounded up to page_size) so decode
+    #: steps interleave between chunks — bounded per-step latency for
+    #: running streams.  0 = whole-prompt prefill.
+    prefill_chunk: int = 0
     # weight-only quantization (reference inference/quantization/): 0 = off
     quant_bits: int = 0
     quant_group: int = 128
@@ -154,6 +159,11 @@ class InferenceEngineV2:
         self._decode = jax.jit(_decode_and_sample, donate_argnums=(1,))
         self._prefill = jax.jit(
             lambda *a: paged_prefill(cfg, *a), donate_argnums=(1,))
+        self._prefill_chunk = jax.jit(
+            lambda *a: paged_prefill_chunk(cfg, *a), donate_argnums=(1,))
+        ps = self.block.page_size
+        self._chunk = (-(-self.config.prefill_chunk // ps) * ps
+                       if self.config.prefill_chunk > 0 else 0)
         self._sample_key = jax.random.PRNGKey(seed)
         self._decode_steps = 0
 
@@ -198,7 +208,7 @@ class InferenceEngineV2:
         self.allocator.free(seq.pages)
         self._page_table[seq.slot, :] = self.block.trash_page
         self._slots[seq.slot] = None
-        seq.slot, seq.pages = -1, []
+        seq.slot, seq.pages, seq.prefilled = -1, [], 0
         self._queue.insert(0, seq)
 
     def _admit(self) -> List[SequenceState]:
@@ -220,6 +230,23 @@ class InferenceEngineV2:
             admitted.append(seq)
             self._slots[i] = seq
         return admitted
+
+    def _emit_sampled(self, seq: SequenceState, logits, out) -> None:
+        """Sample off prefix-end logits, append, record, maybe retire —
+        shared by the whole-prompt and final-chunk prefill paths."""
+        tok = self._sample(seq, np.asarray(logits, np.float32))
+        seq.tokens.append(tok)
+        out[seq.uid] = {"tokens": [tok], "done": False}
+        self._maybe_finish(seq, tok)
+        if seq.done:
+            out[seq.uid]["done"] = True
+
+    @staticmethod
+    def _ready_to_decode(seq: SequenceState) -> bool:
+        """KV written for tokens[0:length-1] AND a token has been sampled
+        off the prefix end — mid-chunked-prefill sequences (and preempted
+        ones re-prefilling their prefix) must not enter the decode batch."""
+        return seq.generated > 0 and seq.prefilled >= seq.length - 1
 
     def _sample(self, seq: SequenceState, logits: np.ndarray) -> int:
         if seq.temperature <= 0.0:
@@ -251,26 +278,58 @@ class InferenceEngineV2:
         out: Dict[int, Dict[str, Any]] = {}
         ps = self.block.page_size
 
-        for seq in self._admit():
-            # seq.length, not prompt_len: a preempted sequence re-prefills its
-            # whole prefix (prompt + tokens generated before eviction)
-            n = seq.length
-            bucket = self._bucket(n)
-            ids = np.zeros((bucket,), np.int32)
-            ids[:n] = seq.tokens
-            rows = np.full((bucket // ps,), self.block.trash_page, np.int32)
-            rows[:len(seq.pages)] = seq.pages
-            logits, self._pools = self._prefill(
-                self.params, self._pools,
-                jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
-            tok = self._sample(seq, np.asarray(logits, np.float32))
-            seq.tokens.append(tok)
-            out[seq.uid] = {"tokens": [tok], "done": False}
-            self._maybe_finish(seq, tok)
-            if seq.done:
-                out[seq.uid]["done"] = True
+        admitted = self._admit()
+        if self._chunk:
+            # Dynamic-SplitFuse-style chunked prefill: ONE chunk per
+            # pending-prefill sequence per step; decode for ready
+            # sequences runs below in the SAME step, between chunks
+            pending = [s for s in self._slots if s is not None
+                       and not self._ready_to_decode(s)]
+            for seq in pending:
+                start = seq.prefilled  # page-aligned: chunk % ps == 0
+                c_n = min(self._chunk, seq.length - start)
+                ids = np.zeros((self._chunk,), np.int32)
+                ids[:c_n] = seq.tokens[start:start + c_n]
+                rows = np.full((self._chunk // ps,), self.block.trash_page,
+                               np.int32)
+                npg = -(-c_n // ps)
+                rows[:npg] = seq.pages[start // ps:start // ps + npg]
+                # bucket the PREVIOUS-pages window (power-of-two page
+                # counts): early chunks of a long prompt must not gather
+                # the full max window; few shapes -> few compiles
+                used = -(-start // ps)
+                b = 1
+                while b < max(used, 1):
+                    b *= 2
+                prev = self._page_table[seq.slot][:min(
+                    b, self.block.max_pages_per_seq)]
+                logits, self._pools = self._prefill_chunk(
+                    self.params, self._pools, jnp.asarray(ids),
+                    jnp.asarray(rows), jnp.asarray(prev),
+                    jnp.int32(start), jnp.int32(c_n))
+                seq.prefilled = start + c_n
+                if seq.prefilled >= seq.length:
+                    self._emit_sampled(seq, logits, out)
+        else:
+            for seq in admitted:
+                # seq.length, not prompt_len: a preempted sequence
+                # re-prefills its whole prefix (prompt + tokens generated
+                # before eviction)
+                n = seq.length
+                bucket = self._bucket(n)
+                ids = np.zeros((bucket,), np.int32)
+                ids[:n] = seq.tokens
+                rows = np.full((bucket // ps,), self.block.trash_page,
+                               np.int32)
+                rows[:len(seq.pages)] = seq.pages
+                logits, self._pools = self._prefill(
+                    self.params, self._pools,
+                    jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
+                seq.prefilled = n
+                self._emit_sampled(seq, logits, out)
 
-        active = [s for s in self._slots if s is not None]
+        active = [s for s in self._slots
+                  if s is not None and self._ready_to_decode(s)]
         if not active:
             return out
 
@@ -298,7 +357,8 @@ class InferenceEngineV2:
                 page = self.allocator.alloc(1)[0]
                 seq.pages.append(page)
                 self._page_table[seq.slot, len(seq.pages) - 1] = page
-        active = [s for s in self._slots if s is not None]
+        active = [s for s in self._slots
+                  if s is not None and self._ready_to_decode(s)]
         if not active:
             return out
 
@@ -325,6 +385,8 @@ class InferenceEngineV2:
         for seq in active:
             tok = int(tokens[seq.slot])
             seq.tokens.append(tok)
+            # the decode step wrote KV for the token it consumed
+            seq.prefilled = seq.length - 1
             rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
             rec["tokens"].append(tok)
             self._maybe_finish(seq, tok)

@@ -67,6 +67,28 @@ def _ffn(cfg: TransformerConfig, layer, x):
     return out
 
 
+def _attn_out(cfg: TransformerConfig, layer, x, attn):
+    """Output projection + residual/parallel-block epilogue shared by the
+    prefill/chunk/decode scan bodies."""
+    attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
+                  + (layer["attn"]["bo"] if cfg.use_bias else 0))
+    if cfg.parallel_block:
+        return _ffn(cfg, layer, x) + attn_delta
+    return _ffn(cfg, layer, x + attn_delta)
+
+
+def _write_pages(quant, rows, k_pages, v_pages, k_c, v_c, ks_c, vs_c):
+    """Scatter whole pages of fresh K/V into the pools (quantizing when
+    the pool is int8) — shared by whole-prompt and chunked prefill."""
+    if quant:
+        kq, ksc = _kv_quantize(k_pages)
+        vq, vsc = _kv_quantize(v_pages)
+        return (k_c.at[rows].set(kq), v_c.at[rows].set(vq),
+                ks_c.at[rows].set(ksc), vs_c.at[rows].set(vsc))
+    return (k_c.at[rows].set(k_pages.astype(k_c.dtype)),
+            v_c.at[rows].set(v_pages.astype(v_c.dtype)), ks_c, vs_c)
+
+
 def paged_prefill(cfg: TransformerConfig, params, pools,
                   ids, page_rows, length) -> Tuple[jnp.ndarray, Any]:
     """Prefill one prompt.
@@ -94,18 +116,9 @@ def paged_prefill(cfg: TransformerConfig, params, pools,
     def body(x, inputs):
         layer, k_c, v_c, ks_c, vs_c = inputs  # k_c: [P+1, ps, KVH, D]
         q, k, v = attn_qkv(cfg, layer, x, positions)
-        k_pages = k[0].reshape(S // ps, ps, *k.shape[2:])
-        v_pages = v[0].reshape(S // ps, ps, *v.shape[2:])
-        if quant:
-            kq, ksc = _kv_quantize(k_pages)
-            vq, vsc = _kv_quantize(v_pages)
-            k_c = k_c.at[page_rows].set(kq)
-            v_c = v_c.at[page_rows].set(vq)
-            ks_c = ks_c.at[page_rows].set(ksc)
-            vs_c = vs_c.at[page_rows].set(vsc)
-        else:
-            k_c = k_c.at[page_rows].set(k_pages.astype(k_c.dtype))
-            v_c = v_c.at[page_rows].set(v_pages.astype(v_c.dtype))
+        k_c, v_c, ks_c, vs_c = _write_pages(
+            quant, page_rows, k[0].reshape(S // ps, ps, *k.shape[2:]),
+            v[0].reshape(S // ps, ps, *v.shape[2:]), k_c, v_c, ks_c, vs_c)
         if use_flash:
             # GQA-native flash kernel: no [S, S] score materialization.
             # Pad tokens past ``length`` see only earlier slots (causal)
@@ -122,16 +135,82 @@ def paged_prefill(cfg: TransformerConfig, params, pools,
             scores = jnp.where(causal, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, S, -1)
-        attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
-                      + (layer["attn"]["bo"] if cfg.use_bias else 0))
-        if cfg.parallel_block:
-            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c, ks_c, vs_c)
-        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c, ks_c, vs_c)
+        return _attn_out(cfg, layer, x, attn), (k_c, v_c, ks_c, vs_c)
 
     ops = (params["layers"],) + _pools_per_layer(pools)
     x, new_pools = jax.lax.scan(body, x, ops)
     out_pools = _pools_from_scan(new_pools)
     hidden = _norm(x[:, length - 1], params["final_norm"]["scale"],
+                   params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    logits = logits_fn(cfg, params, hidden[:, None])[0, 0]
+    return logits, out_pools
+
+
+def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
+                        ids, chunk_rows, prev_table, start, n
+                        ) -> Tuple[jnp.ndarray, Any]:
+    """Prefill ONE CHUNK of a prompt (FastGen Dynamic-SplitFuse-style
+    chunked prefill, reference inference/v2 scheduler + blogs/deepspeed-
+    fastgen): long prompts are processed in fixed-size chunks so decode
+    steps for other sequences interleave between chunks, bounding
+    per-step latency instead of stalling every running stream for a full
+    prompt.
+
+    ids: [C] chunk tokens (C fixed, multiple of page_size);
+    chunk_rows: [C // ps] pages receiving this chunk's K/V;
+    prev_table: [MPb] pages of EARLIER chunks — the caller buckets its
+    length (power-of-two page counts) so early chunks don't gather the
+    full max window; start: global position of ids[0]; n: valid tokens.
+    Chunk queries attend to all previously-written positions (< start,
+    via the page pool) plus causally within the chunk.  Returns (logits
+    of token start+n-1 — meaningful on the FINAL chunk — and pools)."""
+    quant = "k_scale" in pools
+    C = ids.shape[0]
+    ps = pools["k"].shape[2]
+    S_prev = prev_table.shape[0] * ps
+    x = params["embed"]["tok"][ids][None]  # [1, C, H]
+    positions = start + jnp.arange(C)[None]
+    if cfg.position == "learned":
+        pos_idx = jnp.minimum(positions[0],
+                              params["embed"]["pos"].shape[0] - 1)
+        x = x + params["embed"]["pos"][pos_idx][None]
+
+    # visibility of pooled (previous-chunk) slots: strictly before start
+    prev_vis = jnp.arange(S_prev)[None, :] < start  # [1, S_prev]
+    causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]  # [C(q), C(k)]
+
+    def body(x, inputs):
+        layer, k_c, v_c, ks_c, vs_c = inputs
+        q, k, v = attn_qkv(cfg, layer, x, positions)
+        k_c, v_c, ks_c, vs_c = _write_pages(
+            quant, chunk_rows, k[0].reshape(C // ps, ps, *k.shape[2:]),
+            v[0].reshape(C // ps, ps, *v.shape[2:]), k_c, v_c, ks_c, vs_c)
+        kp = k_c[prev_table].reshape(S_prev, *k_c.shape[2:])
+        vp = v_c[prev_table].reshape(S_prev, *v_c.shape[2:])
+        if quant:
+            kp = (kp.astype(jnp.float32)
+                  * ks_c[prev_table].reshape(S_prev, -1)[..., None])
+            vp = (vp.astype(jnp.float32)
+                  * vs_c[prev_table].reshape(S_prev, -1)[..., None])
+        # keys = [previous pooled slots | this chunk]; the pooled half is
+        # masked to < start, the chunk half causally within the chunk
+        kk = jnp.concatenate([kp.astype(x.dtype)[None], k], axis=1)
+        vv = jnp.concatenate([vp.astype(x.dtype)[None], v], axis=1)
+        kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
+        vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
+        scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
+        scores = scores / math.sqrt(cfg.head_dim)
+        mask = jnp.concatenate(
+            [jnp.broadcast_to(prev_vis, (C, S_prev)), causal], axis=1)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(1, C, -1)
+        return _attn_out(cfg, layer, x, attn), (k_c, v_c, ks_c, vs_c)
+
+    ops = (params["layers"],) + _pools_per_layer(pools)
+    x, new_pools = jax.lax.scan(body, x, ops)
+    out_pools = _pools_from_scan(new_pools)
+    hidden = _norm(x[:, n - 1], params["final_norm"]["scale"],
                    params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     logits = logits_fn(cfg, params, hidden[:, None])[0, 0]
     return logits, out_pools
@@ -203,11 +282,7 @@ def paged_decode(cfg: TransformerConfig, params, pools,
             scores = jnp.where(vis[:, None, None, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
-        attn_delta = (_mm(cfg, attn, layer["attn"]["wo"], MODEL_AXIS, None)
-                      + (layer["attn"]["bo"] if cfg.use_bias else 0))
-        if cfg.parallel_block:
-            return _ffn(cfg, layer, x) + attn_delta, (k_c, v_c, ks_c, vs_c)
-        return _ffn(cfg, layer, x + attn_delta), (k_c, v_c, ks_c, vs_c)
+        return _attn_out(cfg, layer, x, attn), (k_c, v_c, ks_c, vs_c)
 
     ops = (params["layers"],) + _pools_per_layer(pools)
     x, new_pools = jax.lax.scan(body, x, ops)

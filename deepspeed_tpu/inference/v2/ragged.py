@@ -102,6 +102,9 @@ class SequenceState:
     pages: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     admit_order: int = -1  # monotonic admission stamp (preemption policy)
+    #: tokens of the prefix already prefilled (chunked prefill); a
+    #: sequence decodes only once prefilled == length at chunk end
+    prefilled: int = 0
 
     @property
     def length(self) -> int:

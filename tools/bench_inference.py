@@ -14,6 +14,7 @@ Prints ONE JSON line.  Knobs (env):
     DSTPU_IBENCH_SLOTS  concurrent decode slots (default 8)
     DSTPU_IBENCH_KVQ    1 = int8 KV pages
     DSTPU_IBENCH_WQ     weight-only bits (4/8; 0 = off)
+    DSTPU_IBENCH_CHUNK  chunked-prefill tokens per step (0 = whole prompt)
 """
 
 from __future__ import annotations
@@ -52,7 +53,8 @@ def main() -> None:
         num_pages=pages_per_seq * slots + slots,  # full pool + slack
         max_seqs=slots,
         kv_quant=os.environ.get("DSTPU_IBENCH_KVQ") == "1",
-        quant_bits=_int("DSTPU_IBENCH_WQ", 0))
+        quant_bits=_int("DSTPU_IBENCH_WQ", 0),
+        prefill_chunk=_int("DSTPU_IBENCH_CHUNK", 0))
     model = llama_model(size, max_seq_len=prompt + gen + page)
     engine = InferenceEngineV2(model, cfg)
 
@@ -81,7 +83,8 @@ def main() -> None:
     result = {
         "metric": f"llama-{size} serving decode tok/s "
                   f"(prompt={prompt}, gen={gen}, nreq={nreq}, slots={slots}, "
-                  f"kvq={int(cfg.kv_quant)}, wq={cfg.quant_bits})",
+                  f"kvq={int(cfg.kv_quant)}, wq={cfg.quant_bits}, "
+                  f"chunk={cfg.prefill_chunk})",
         "value": round(out_tokens / dt, 1),
         "unit": "tokens/s",
         "ms_per_token": round(1000.0 * dt * slots / out_tokens, 2),
@@ -104,5 +107,11 @@ if __name__ == "__main__":
         usable, reason, _backend = _backend_usable()
         if not usable:
             os.environ["DSTPU_BENCH_FALLBACK_REASON"] = reason
+            _pin_cpu()
+        elif _backend == "cpu":
+            # the probe short-circuits on JAX_PLATFORMS=cpu, but a site
+            # PJRT plugin may have pinned another platform via jax.config
+            # (env var alone does not override) — pin for real or main()
+            # hangs on the very backend the probe promised to avoid
             _pin_cpu()
     main()

@@ -75,6 +75,14 @@ RUNGS = {
                           "DSTPU_IBENCH_GEN": "128",
                           "DSTPU_IBENCH_NREQ": "32",
                           "DSTPU_IBENCH_KVQ": "1", "DSTPU_IBENCH_WQ": "8"},
+    # chunked prefill (Dynamic SplitFuse): same load, 128-token chunks —
+    # compare per-step latency tail vs serving-160m
+    "serving-160m-chunked": {"_tool": "bench_inference",
+                             "DSTPU_IBENCH_SIZE": "160m",
+                             "DSTPU_IBENCH_PROMPT": "512",
+                             "DSTPU_IBENCH_GEN": "128",
+                             "DSTPU_IBENCH_NREQ": "32",
+                             "DSTPU_IBENCH_CHUNK": "128"},
 }
 
 
