@@ -73,6 +73,10 @@ def export_hf_state(cfg, params: Dict[str, Any],
         return _export_phi(cfg, params, get)
     if model_type == "falcon":
         return _export_falcon(cfg, params, get)
+    if model_type == "bloom":
+        return _export_bloom(cfg, params, get)
+    if model_type == "gpt_neox":
+        return _export_gpt_neox(cfg, params, get)
     if model_type == "qwen2_moe":
         return _export_qwen2_moe(cfg, params, get)
     if model_type == "phi3":
@@ -467,6 +471,31 @@ def hf_config_dict(cfg, model_type: str = "llama") -> Dict[str, Any]:
                 "layer_norm_eps": cfg.norm_eps,
                 "rope_theta": cfg.rope_theta,
                 "tie_word_embeddings": bool(cfg.tie_embeddings)}
+    if model_type == "bloom":
+        return {"model_type": "bloom",
+                "architectures": ["BloomForCausalLM"],
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "n_layer": cfg.n_layers, "n_head": cfg.n_heads,
+                "seq_length": cfg.max_seq_len,
+                "layer_norm_epsilon": cfg.norm_eps,
+                "tie_word_embeddings": bool(cfg.tie_embeddings)}
+    if model_type == "gpt_neox":
+        return {"model_type": "gpt_neox",
+                "architectures": ["GPTNeoXForCausalLM"],
+                "vocab_size": cfg.vocab_size,
+                "hidden_size": cfg.hidden_size,
+                "num_hidden_layers": cfg.n_layers,
+                "num_attention_heads": cfg.n_heads,
+                "intermediate_size": cfg.ffn_size,
+                "max_position_embeddings": cfg.max_seq_len,
+                "rotary_pct": cfg.rotary_pct,
+                "rotary_emb_base": cfg.rope_theta,
+                "use_parallel_residual": True,
+                "hidden_act": ("gelu" if cfg.activation == "gelu_exact"
+                               else "gelu_new"),
+                "layer_norm_eps": cfg.norm_eps,
+                "tie_word_embeddings": bool(cfg.tie_embeddings)}
     if model_type == "falcon":
         return {"model_type": "falcon",
                 "architectures": ["FalconForCausalLM"],
@@ -616,3 +645,70 @@ def save_hf_checkpoint(model_dir: str, cfg, params: Dict[str, Any],
     n = sum(v.size for v in state.values())
     logger.info(f"hf_export: wrote {n / 1e6:.1f}M params "
                 f"({model_type}) to {model_dir}")
+
+
+def _fuse_qkv_per_head(wq, wk, wv, bq, bk, bv, NH, D):
+    """Inverse of hf_import._split_fused_qkv_per_head: [in, NH*D] weights
+    (and [NH*D] biases) -> per-head-interleaved fused [(NH*3*D), in]."""
+    win = wq.shape[0]
+    g = np.stack([np.asarray(w).T.reshape(NH, D, win)
+                  for w in (wq, wk, wv)], axis=1)  # [NH, 3, D, in]
+    fused_w = g.reshape(NH * 3 * D, win)
+    fused_b = np.stack([np.asarray(b).reshape(NH, D)
+                        for b in (bq, bk, bv)], axis=1).reshape(NH * 3 * D)
+    return fused_w, fused_b
+
+
+def _export_neox_style_layers(cfg, params, get, host, layer_fmt, attn):
+    """Shared bloom/gpt-neox layer exporter (inverse of
+    hf_import._import_neox_style)."""
+    L, NH, D = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    lay = params["layers"]
+    a, m = lay["attn"], lay["mlp"]
+    for i in range(L):
+        pre = layer_fmt.format(i=i)
+        fw, fb = _fuse_qkv_per_head(
+            get(a["wq"][i]), get(a["wk"][i]), get(a["wv"][i]),
+            get(a["bq"][i]), get(a["bk"][i]), get(a["bv"][i]), NH, D)
+        host[f"{pre}{attn}.query_key_value.weight"] = fw
+        host[f"{pre}{attn}.query_key_value.bias"] = fb
+        host[f"{pre}{attn}.dense.weight"] = get(a["wo"][i]).T
+        host[f"{pre}{attn}.dense.bias"] = get(a["bo"][i])
+        host[f"{pre}mlp.dense_h_to_4h.weight"] = get(m["w_up"][i]).T
+        host[f"{pre}mlp.dense_h_to_4h.bias"] = get(m["b_up"][i])
+        host[f"{pre}mlp.dense_4h_to_h.weight"] = get(m["w_down"][i]).T
+        host[f"{pre}mlp.dense_4h_to_h.bias"] = get(m["b_down"][i])
+        for ours, theirs in (("norm1", "input_layernorm"),
+                             ("norm2", "post_attention_layernorm")):
+            host[f"{pre}{theirs}.weight"] = get(lay[ours]["scale"][i])
+            host[f"{pre}{theirs}.bias"] = get(lay[ours]["bias"][i])
+    return host
+
+
+def _export_bloom(cfg, params, get) -> Dict[str, np.ndarray]:
+    emb = params["embed"]
+    host = {
+        "transformer.word_embeddings.weight": get(emb["tok"]),
+        "transformer.word_embeddings_layernorm.weight": get(emb["norm"]["scale"]),
+        "transformer.word_embeddings_layernorm.bias": get(emb["norm"]["bias"]),
+        "transformer.ln_f.weight": get(params["final_norm"]["scale"]),
+        "transformer.ln_f.bias": get(params["final_norm"]["bias"]),
+    }
+    host = _export_neox_style_layers(cfg, params, get, host,
+                                     "transformer.h.{i}.", "self_attention")
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["lm_head.weight"] = get(params["lm_head"]["w"]).T
+    return host
+
+
+def _export_gpt_neox(cfg, params, get) -> Dict[str, np.ndarray]:
+    host = {
+        "gpt_neox.embed_in.weight": get(params["embed"]["tok"]),
+        "gpt_neox.final_layer_norm.weight": get(params["final_norm"]["scale"]),
+        "gpt_neox.final_layer_norm.bias": get(params["final_norm"]["bias"]),
+    }
+    host = _export_neox_style_layers(cfg, params, get, host,
+                                     "gpt_neox.layers.{i}.", "attention")
+    if not cfg.tie_embeddings and "lm_head" in params:
+        host["embed_out.weight"] = get(params["lm_head"]["w"]).T
+    return host

@@ -14,7 +14,8 @@ header + raw little-endian buffer; no external dependency) and
 both flavors are followed.
 
 Families: llama / mistral / qwen2 / qwen2-moe / mixtral / gpt2 / opt /
-phi / phi3 / falcon / bert — all with logit parity against ``transformers`` (bert rides the
+phi / phi3 / falcon / bloom / gpt-neox / bert — all with logit parity
+against ``transformers`` (bert rides the
 transformer core's post-norm mode: norm after each residual add,
 embeddings LayerNorm, segment embeddings, full MLM prediction head).
 
@@ -207,6 +208,44 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             tie_embeddings=True, post_norm=True,
             type_vocab_size=c.get("type_vocab_size", 2),
             norm_eps=c.get("layer_norm_eps", 1e-12))
+    if mtype == "bloom":
+        if c.get("apply_residual_connection_post_layernorm"):
+            raise ValueError(
+                "hf_import: bloom variants with "
+                "apply_residual_connection_post_layernorm are not "
+                "supported — the runtime's residual reads the raw stream")
+        h = c["hidden_size"]
+        return TransformerConfig(
+            vocab_size=c["vocab_size"], hidden_size=h,
+            n_layers=c["n_layer"], n_heads=c["n_head"],
+            intermediate_size=4 * h,
+            max_seq_len=c.get("seq_length", 2048),  # ALiBi: no pos table
+            norm="layernorm", activation="gelu",  # BloomGelu = tanh approx
+            position="alibi", causal=True, use_bias=True, embed_norm=True,
+            tie_embeddings=True,
+            norm_eps=c.get("layer_norm_epsilon", 1e-5))
+    if mtype == "gpt_neox":
+        if not c.get("use_parallel_residual", True):
+            raise ValueError("hf_import: gpt_neox with "
+                             "use_parallel_residual=false (sequential "
+                             "residual) is not supported by the "
+                             "parallel-block runtime")
+        return TransformerConfig(
+            vocab_size=c["vocab_size"], hidden_size=c["hidden_size"],
+            n_layers=c["num_hidden_layers"],
+            n_heads=c["num_attention_heads"],
+            intermediate_size=c["intermediate_size"],
+            max_seq_len=c.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation={"gelu": "gelu_exact", "gelu_new": "gelu",
+                        "gelu_fast": "gelu"}.get(
+                c.get("hidden_act", "gelu"), "gelu_exact"),
+            position="rope", rotary_pct=float(c.get("rotary_pct", 0.25)),
+            rope_theta=float(c.get("rotary_emb_base", 10000.0)),
+            causal=True, use_bias=True, parallel_block=True,
+            parallel_norms=2,
+            tie_embeddings=bool(c.get("tie_word_embeddings", False)),
+            norm_eps=c.get("layer_norm_eps", 1e-5))
     if mtype == "falcon":
         if not c.get("parallel_attn", True):
             raise ValueError("hf_import: sequential-attention falcon "
@@ -303,6 +342,10 @@ def import_hf_params(cfg, state: Dict[str, np.ndarray],
         return _import_phi(cfg, state)
     if model_type == "falcon":
         return _import_falcon(cfg, state)
+    if model_type == "bloom":
+        return _import_bloom(cfg, state)
+    if model_type == "gpt_neox":
+        return _import_gpt_neox(cfg, state)
     if model_type == "bert":
         return _import_bert(cfg, state)
     if model_type == "phi3":
@@ -710,3 +753,95 @@ def load_hf_model(model_dir: str, dtype=None) -> Tuple[Any, Dict[str, Any]]:
     logger.info(f"hf_import: loaded {n / 1e6:.1f}M params "
                 f"({raw.get('model_type', 'llama')}) from {model_dir}")
     return cfg, params
+
+
+def _split_fused_qkv_per_head(w, b, NH, D):
+    """HF bloom/gpt-neox fused ``query_key_value``: rows are PER-HEAD
+    [q_h, k_h, v_h] triples — layout (NH, 3, D, in).  Returns transposed
+    ([in, NH*D]) weights and [NH*D] biases for q/k/v."""
+    win = w.shape[-1]
+    g = np.asarray(w).reshape(NH, 3, D, win)
+    ws = [g[:, j].reshape(NH * D, win).T for j in range(3)]
+    bs = [None] * 3
+    if b is not None:
+        gb = np.asarray(b).reshape(NH, 3, D)
+        bs = [gb[:, j].reshape(NH * D) for j in range(3)]
+    return ws, bs
+
+
+def _import_neox_style(cfg, state, layer_fmt: str, attn: str):
+    """Shared bloom/gpt-neox layer importer: per-head fused QKV split,
+    dense_h_to_4h/dense_4h_to_h MLP, input/post-attention layernorms.
+    ``layer_fmt``: e.g. "transformer.h.{i}."; ``attn``: the attention
+    module name ("self_attention" / "attention")."""
+    L, NH, D = cfg.n_layers, cfg.n_heads, cfg.head_dim
+    wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
+    for i in range(L):
+        pre = layer_fmt.format(i=i) + attn + ".query_key_value"
+        ws, bs = _split_fused_qkv_per_head(
+            state[f"{pre}.weight"], state.get(f"{pre}.bias"), NH, D)
+        wq.append(ws[0]); wk.append(ws[1]); wv.append(ws[2])
+        bq.append(bs[0]); bk.append(bs[1]); bv.append(bs[2])
+    h = layer_fmt
+    return {
+        "attn": {
+            "wq": np.stack(wq), "wk": np.stack(wk), "wv": np.stack(wv),
+            "bq": np.stack(bq), "bk": np.stack(bk), "bv": np.stack(bv),
+            "wo": _stack(state, h + attn + ".dense.weight", L),
+            "bo": _stack(state, h + attn + ".dense.bias", L,
+                         transpose=False),
+        },
+        "mlp": {
+            "w_up": _stack(state, h + "mlp.dense_h_to_4h.weight", L),
+            "b_up": _stack(state, h + "mlp.dense_h_to_4h.bias", L,
+                           transpose=False),
+            "w_down": _stack(state, h + "mlp.dense_4h_to_h.weight", L),
+            "b_down": _stack(state, h + "mlp.dense_4h_to_h.bias", L,
+                             transpose=False),
+        },
+        "norm1": {
+            "scale": _stack(state, h + "input_layernorm.weight", L,
+                            transpose=False),
+            "bias": _stack(state, h + "input_layernorm.bias", L,
+                           transpose=False)},
+        "norm2": {
+            "scale": _stack(state, h + "post_attention_layernorm.weight",
+                            L, transpose=False),
+            "bias": _stack(state, h + "post_attention_layernorm.bias", L,
+                           transpose=False)},
+    }
+
+
+def _import_bloom(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """BloomForCausalLM: ALiBi (no position table), per-head-fused QKV,
+    word_embeddings_layernorm, biases everywhere, tied head."""
+    return {
+        "embed": {
+            "tok": np.asarray(state["transformer.word_embeddings.weight"]),
+            "norm": {
+                "scale": np.asarray(
+                    state["transformer.word_embeddings_layernorm.weight"]),
+                "bias": np.asarray(
+                    state["transformer.word_embeddings_layernorm.bias"])},
+        },
+        "final_norm": {"scale": np.asarray(state["transformer.ln_f.weight"]),
+                       "bias": np.asarray(state["transformer.ln_f.bias"])},
+        "layers": _import_neox_style(cfg, state, "transformer.h.{i}.",
+                                     "self_attention"),
+    }
+
+
+def _import_gpt_neox(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """GPTNeoXForCausalLM: per-head-fused QKV, partial rotary, parallel
+    residual with separate input/post-attention norms, untied embed_out."""
+    p = {
+        "embed": {"tok": np.asarray(state["gpt_neox.embed_in.weight"])},
+        "final_norm": {
+            "scale": np.asarray(state["gpt_neox.final_layer_norm.weight"]),
+            "bias": np.asarray(state["gpt_neox.final_layer_norm.bias"])},
+        "layers": _import_neox_style(cfg, state, "gpt_neox.layers.{i}.",
+                                     "attention"),
+    }
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"w": np.asarray(state["embed_out.weight"]).T}
+    return p

@@ -109,6 +109,9 @@ def paged_prefill(cfg: TransformerConfig, params, pools,
         # real-token outputs under the causal mask)
         pos_idx = jnp.minimum(jnp.arange(S), params["embed"]["pos"].shape[0] - 1)
         x = x + params["embed"]["pos"][pos_idx][None]
+    if "norm" in params["embed"]:  # bloom-style word_embeddings_layernorm
+        x = _norm(x, params["embed"]["norm"]["scale"],
+                  params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
     positions = jnp.arange(S)[None]
 
     use_flash = _use_paged_kernel()
@@ -174,6 +177,9 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
         pos_idx = jnp.minimum(positions[0],
                               params["embed"]["pos"].shape[0] - 1)
         x = x + params["embed"]["pos"][pos_idx][None]
+    if "norm" in params["embed"]:
+        x = _norm(x, params["embed"]["norm"]["scale"],
+                  params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
 
     # visibility of pooled (previous-chunk) slots: strictly before start
     prev_vis = jnp.arange(S_prev)[None, :] < start  # [1, S_prev]
@@ -233,6 +239,9 @@ def paged_decode(cfg: TransformerConfig, params, pools,
     x = params["embed"]["tok"][last_tokens][:, None]  # [B, 1, H]
     if cfg.position == "learned":
         x = x + params["embed"]["pos"][positions][:, None]
+    if "norm" in params["embed"]:
+        x = _norm(x, params["embed"]["norm"]["scale"],
+                  params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
 
     page_idx = jnp.where(active,
                          page_table[jnp.arange(B), positions // ps], trash)

@@ -1,5 +1,7 @@
 from .bert import bert_config, bert_model
-from .families import (falcon_config, falcon_model, mistral_config,
+from .families import (bloom_config, bloom_model, falcon_config,
+                       falcon_model, gpt_neox_config, gpt_neox_model,
+                       mistral_config,
                        mistral_model, opt_config, opt_model, phi_config,
                        phi_model, qwen_config, qwen_model)
 from .gpt2 import gpt2_config, gpt2_model
@@ -11,4 +13,5 @@ __all__ = ["bert_config", "bert_model", "gpt2_config", "gpt2_model",
            "llama_config", "llama_model", "mixtral_config", "mixtral_model",
            "mistral_config", "mistral_model", "qwen_config", "qwen_model",
            "phi_config", "phi_model", "opt_config", "opt_model",
-           "falcon_config", "falcon_model", "TransformerConfig"]
+           "falcon_config", "falcon_model", "bloom_config", "bloom_model",
+           "gpt_neox_config", "gpt_neox_model", "TransformerConfig"]

@@ -166,3 +166,61 @@ def falcon_model(size: str = "7b", max_seq_len: int = 2048,
                  config: Optional[TransformerConfig] = None,
                  **overrides) -> ModelSpec:
     return _spec(config or falcon_config(size, max_seq_len, **overrides))
+
+
+# --------------------------------------------------------------- bloom
+# reference parity: module_inject/containers/bloom.py + the BLOOM policy —
+# ALiBi position bias, MHA, layernorm + gelu + biases everywhere, bloom's
+# word_embeddings_layernorm, tied head
+BLOOM_SIZES = {
+    # name: (hidden, layers, heads, vocab)
+    "tiny": (64, 2, 4, 256),
+    "560m": (1024, 24, 16, 250880),
+    "7b1": (4096, 30, 32, 250880),
+    "176b": (14336, 70, 112, 250880),
+}
+
+
+def bloom_config(size: str = "560m", max_seq_len: int = 2048,
+                 **overrides) -> TransformerConfig:
+    h, l, nh, vocab = BLOOM_SIZES[size]
+    return _apply(TransformerConfig(
+        vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh,
+        intermediate_size=4 * h, max_seq_len=max_seq_len,
+        norm="layernorm", activation="gelu", position="alibi",
+        use_bias=True, embed_norm=True, tie_embeddings=True,
+        norm_eps=1e-5), overrides)
+
+
+def bloom_model(size: str = "560m", max_seq_len: int = 2048,
+                config: Optional[TransformerConfig] = None,
+                **overrides) -> ModelSpec:
+    return _spec(config or bloom_config(size, max_seq_len, **overrides))
+
+
+# --------------------------------------------------------------- gpt-neox
+# reference parity: module_inject/containers/gptneox.py — partial rotary
+# (rotary_pct), parallel attention+MLP residual with SEPARATE input/
+# post-attention norms, layernorm + gelu + biases, untied embed_out
+NEOX_SIZES = {
+    # name: (hidden, layers, heads, ffn, vocab)
+    "tiny": (64, 2, 4, 128, 256),
+    "20b": (6144, 44, 64, 24576, 50432),
+}
+
+
+def gpt_neox_config(size: str = "20b", max_seq_len: int = 2048,
+                    **overrides) -> TransformerConfig:
+    h, l, nh, ffn, vocab = NEOX_SIZES[size]
+    return _apply(TransformerConfig(
+        vocab_size=vocab, hidden_size=h, n_layers=l, n_heads=nh,
+        intermediate_size=ffn, max_seq_len=max_seq_len,
+        norm="layernorm", activation="gelu_exact", position="rope",
+        rotary_pct=0.25, use_bias=True, parallel_block=True,
+        parallel_norms=2, norm_eps=1e-5), overrides)
+
+
+def gpt_neox_model(size: str = "20b", max_seq_len: int = 2048,
+                   config: Optional[TransformerConfig] = None,
+                   **overrides) -> ModelSpec:
+    return _spec(config or gpt_neox_config(size, max_seq_len, **overrides))

@@ -46,8 +46,11 @@ class TransformerConfig:
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | layernorm
     activation: str = "swiglu"  # swiglu | gelu
-    position: str = "rope"  # rope | learned | none
+    position: str = "rope"  # rope | learned | alibi | none
     causal: bool = True
+    #: bloom-style word_embeddings_layernorm on a PRE-norm model (post_norm
+    #: models get an embedding norm implicitly)
+    embed_norm: bool = False
     tie_embeddings: bool = False
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
@@ -151,6 +154,10 @@ def init_transformer_params(cfg: TransformerConfig, rng) -> Dict[str, Any]:
         p["final_norm"] = {"scale": jnp.ones((H,), dt)}
         if cfg.norm == "layernorm":
             p["final_norm"]["bias"] = jnp.zeros((H,), dt)
+        if cfg.embed_norm:  # bloom word_embeddings_layernorm
+            p["embed"]["norm"] = {"scale": jnp.ones((H,), dt)}
+            if cfg.norm == "layernorm":
+                p["embed"]["norm"]["bias"] = jnp.zeros((H,), dt)
     else:
         # post-norm models norm the EMBEDDINGS instead of the final hidden
         p["embed"]["norm"] = {"scale": jnp.ones((H,), dt)}
@@ -313,10 +320,26 @@ def _rope(x, theta: float, positions, pct: float = 1.0):
     return out if d == d_full else jnp.concatenate([out, x_pass], axis=-1)
 
 
-def xla_attention(q, k, v, causal: bool, mask=None):
-    """Plain attention in XLA: [B, S, NH, D].  fp32 softmax."""
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (Press et al.; numerically matches HF bloom's
+    build_alibi_tensor): geometric 2^(-8/p) powers for the closest power
+    of two p, plus interpolated odd-index slopes for the extra heads."""
+    p = 2 ** math.floor(math.log2(n_heads))
+    base = [2 ** (-(2 ** -(math.log2(p) - 3)) * (i + 1)) for i in range(p)]
+    if p < n_heads:
+        base += [2 ** (-(2 ** -(math.log2(2 * p) - 3)) * (i + 1))
+                 for i in range(0, 2 * (n_heads - p), 2)]
+    return jnp.asarray(base, jnp.float32)
+
+
+def xla_attention(q, k, v, causal: bool, mask=None, bias=None):
+    """Plain attention in XLA: [B, S, NH, D].  fp32 softmax.  ``bias``:
+    additive pre-softmax scores bias (e.g. ALiBi), broadcastable to
+    [B, NH, S_q, S_k]."""
     d = q.shape[-1]
     scores = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) / math.sqrt(d)
+    if bias is not None:
+        scores = scores + bias
     if causal:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         cmask = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
@@ -335,6 +358,15 @@ def _repeat_kv(k, n_rep: int):
 
 def _pick_attn(cfg: TransformerConfig) -> Callable:
     impl = cfg.attn_impl
+    if cfg.position == "alibi":
+        # the additive per-head bias runs on the XLA path (flash/ulysses/
+        # ring kernels carry no score-bias input); _block feeds the bias
+        if impl not in ("auto", "xla"):
+            from ..utils.logging import warning_once
+
+            warning_once(f"attn_impl={impl!r} has no ALiBi bias input; "
+                         "using the XLA attention path")
+        return xla_attention
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "flash":
@@ -482,7 +514,16 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
         # index map; everyone else gets the materialized repeat
         k = _repeat_kv(k, NH // KVH)
         v = _repeat_kv(v, NH // KVH)
-    attn = attn_fn(q, k, v, cfg.causal, mask)
+    if cfg.position == "alibi":
+        # score(i, j) += -slope_h * (i - j): linear distance penalty
+        # (softmax-equivalent to HF bloom's key-indexed formulation,
+        # which differs only by a per-row constant)
+        rel = (positions[:, None, :, None]
+               - positions[:, None, None, :]).astype(jnp.float32)
+        attn = attn_fn(q, k, v, cfg.causal, mask,
+                       bias=-alibi_slopes(NH)[None, :, None, None] * rel)
+    else:
+        attn = attn_fn(q, k, v, cfg.causal, mask)
     attn = attn.reshape(B, S, NH * D)
     attn_delta = _mm(cfg, attn, a["wo"], MODEL_AXIS, None) \
         + (a["bo"] if cfg.use_bias else 0)
@@ -678,6 +719,9 @@ def _block_decode(cfg: TransformerConfig, x, layer, k_cache, v_cache, position):
     # causal vs cache: token t may see cache slots <= position + t
     limit = (position[:, None, None, None] + jnp.arange(T)[None, None, :, None])
     slot = jnp.arange(S)[None, None, None, :]
+    if cfg.position == "alibi":
+        scores = scores - alibi_slopes(NH)[None, :, None, None] \
+            * (limit - slot).astype(jnp.float32)
     scores = jnp.where(slot <= limit, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, T, NH * D)
@@ -704,6 +748,9 @@ def forward_with_cache(cfg: TransformerConfig, params, input_ids, cache,
     if cfg.position == "learned":
         pos_idx = position[0] + jnp.arange(T)
         x = x + jnp.take(params["embed"]["pos"], pos_idx, axis=0)[None]
+    if "norm" in params["embed"]:  # bloom word_embeddings_layernorm
+        x = _norm(x, params["embed"]["norm"]["scale"],
+                  params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
 
     def scan_body(carry, inputs):
         x = carry

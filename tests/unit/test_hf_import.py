@@ -17,6 +17,8 @@ pytestmark = pytest.mark.slow
 jnp = pytest.importorskip("jax.numpy")
 transformers = pytest.importorskip("transformers")
 
+import dataclasses  # noqa: E402
+
 import jax  # noqa: E402
 
 
@@ -781,3 +783,78 @@ def test_export_qwen2_moe_roundtrip_and_transformers_load(tmp_path):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32), atol=1e-6,
                                    err_msg=jax.tree_util.keystr(kp))
+
+
+def test_bloom_parity(tmp_path):
+    """Bloom: ALiBi bias (softmax-equivalent formulation), per-head fused
+    QKV split, word_embeddings_layernorm, tied head."""
+    import torch
+    from transformers import BloomConfig, BloomForCausalLM
+
+    hf_cfg = BloomConfig(vocab_size=90, hidden_size=32, n_layer=2,
+                         n_head=4, layer_norm_epsilon=1e-5)
+    torch.manual_seed(11)
+    m = BloomForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.position == "alibi" and cfg.embed_norm
+    ids = np.random.RandomState(12).randint(0, 90, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_gpt_neox_parity(tmp_path):
+    """GPT-NeoX: per-head fused QKV, partial rotary (rotary_pct), parallel
+    residual with separate norms, untied embed_out."""
+    import torch
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(vocab_size=96, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           intermediate_size=64, rotary_pct=0.25,
+                           use_parallel_residual=True,
+                           max_position_embeddings=64)
+    torch.manual_seed(13)
+    m = GPTNeoXForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert cfg.parallel_block and cfg.parallel_norms == 2
+    assert cfg.rotary_pct == 0.25 and not cfg.tie_embeddings
+    cfg.attn_impl = "xla"
+    ids = np.random.RandomState(14).randint(0, 96, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
+def test_export_bloom_neox_transformers_load(tmp_path):
+    """Export roundtrip: native bloom/neox trees -> HF directory ->
+    transformers.from_pretrained logit parity."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    from deepspeed_tpu.checkpoint.hf_export import save_hf_checkpoint
+    from deepspeed_tpu.models import bloom_model, gpt_neox_model
+
+    for name, fam in (("bloom", bloom_model), ("gpt_neox", gpt_neox_model)):
+        model = fam("tiny", max_seq_len=64)
+        params = model.init_params(jax.random.PRNGKey(3))
+        out = tmp_path / name
+        save_hf_checkpoint(str(out), model.config, params, model_type=name)
+        hf = AutoModelForCausalLM.from_pretrained(str(out)).eval()
+        ids = np.random.RandomState(15).randint(0, 250, (2, 8)).astype(np.int32)
+        with torch.no_grad():
+            want = hf(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+        cfg = dataclasses.replace(model.config, attn_impl="xla", dtype=jnp.float32)
+        got = _logits_ours(cfg, jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.float32), params), ids)
+        np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3, err_msg=name)

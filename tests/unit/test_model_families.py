@@ -7,11 +7,13 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu
-from deepspeed_tpu.models import (falcon_model, mistral_model, opt_model,
+from deepspeed_tpu.models import (bloom_model, falcon_model,
+                                  gpt_neox_model, mistral_model, opt_model,
                                   phi_model, qwen_model)
 
 SEQ = 32
-FAMILIES = [mistral_model, qwen_model, phi_model, opt_model, falcon_model]
+FAMILIES = [mistral_model, qwen_model, phi_model, opt_model,
+            falcon_model, bloom_model, gpt_neox_model]
 
 
 def _batch(vocab, seed=0, bs=2):
@@ -93,3 +95,33 @@ def test_parallel_block_shares_single_norm():
         loss = model.loss_fn(
             params, {"input_ids": jnp.zeros((2, 16), jnp.int32)}, None)
         assert jnp.isfinite(loss)
+
+
+def test_alibi_distance_penalty_and_v1_decode():
+    """ALiBi (bloom): more distant keys get linearly more negative scores
+    per-head; dense cached decode (v1 path) matches the full forward."""
+    from deepspeed_tpu.models.transformer import (alibi_slopes,
+                                                  forward_with_cache,
+                                                  logits_fn,
+                                                  transformer_forward)
+
+    s = np.asarray(alibi_slopes(4))
+    assert (s > 0).all() and (np.diff(s) < 0).all()  # decreasing, positive
+    s8 = np.asarray(alibi_slopes(8))
+    np.testing.assert_allclose(s8[0], 2 ** -1.0, rtol=1e-6)
+
+    model = bloom_model("tiny", max_seq_len=64)
+    cfg = model.config
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = np.random.RandomState(4).randint(0, 256, (2, 12)).astype(np.int32)
+    hidden, _ = transformer_forward(cfg, params, jnp.asarray(ids))
+    full = np.asarray(logits_fn(cfg, params, hidden), np.float32)
+
+    import dataclasses
+
+    from deepspeed_tpu.models.transformer import init_kv_cache
+    cache = init_kv_cache(cfg, 2, 32, jnp.float32)
+    step, cache = forward_with_cache(cfg, params, jnp.asarray(ids), cache,
+                                     jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(step, np.float32), full,
+                               atol=2e-4, rtol=2e-3)

@@ -48,6 +48,8 @@ stamp "bench_sweep serving-160m"
 timeout 2400 python tools/bench_sweep.py serving-160m
 stamp "bench_sweep serving-160m-int8"
 timeout 2400 python tools/bench_sweep.py serving-160m-int8
+stamp "bench_sweep serving-160m-chunked"
+timeout 2400 python tools/bench_sweep.py serving-160m-chunked
 
 # 4. remaining tune variants (bs ladder, loss chunking, stock-kernel ref)
 stamp "tune_mfu remainder"
