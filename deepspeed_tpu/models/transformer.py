@@ -359,9 +359,27 @@ def _repeat_kv(k, n_rep: int):
 def _pick_attn(cfg: TransformerConfig) -> Callable:
     impl = cfg.attn_impl
     if cfg.position == "alibi":
-        # the additive per-head bias runs on the XLA path (flash/ulysses/
-        # ring kernels carry no score-bias input); _block feeds the bias
-        if impl not in ("auto", "xla"):
+        # the flash kernels build the ALiBi bias from block indices (no
+        # [S, S] materialization); ulysses/ring carry no bias input
+        if impl == "flash" or (impl == "auto"
+                               and jax.default_backend() == "tpu"):
+            try:
+                from ..ops.pallas.flash_attention import flash_attention
+
+                fn = lambda q, k, v, causal, mask=None, alibi=None: \
+                    flash_attention(q, k, v, causal=causal,  # noqa: E731
+                                    segment_mask=mask, alibi_slopes=alibi)
+                fn.handles_gqa = True
+                fn.handles_alibi = True
+                return fn
+            except Exception:
+                from ..utils.logging import warning_once
+
+                warning_once(
+                    "flash attention unavailable; ALiBi falls back to the "
+                    "XLA path, which MATERIALIZES the [B, NH, S, S] bias — "
+                    "expect much higher memory at long context")
+        if impl not in ("auto", "xla", "flash"):
             from ..utils.logging import warning_once
 
             warning_once(f"attn_impl={impl!r} has no ALiBi bias input; "
@@ -518,10 +536,15 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
         # score(i, j) += -slope_h * (i - j): linear distance penalty
         # (softmax-equivalent to HF bloom's key-indexed formulation,
         # which differs only by a per-row constant)
-        rel = (positions[:, None, :, None]
-               - positions[:, None, None, :]).astype(jnp.float32)
-        attn = attn_fn(q, k, v, cfg.causal, mask,
-                       bias=-alibi_slopes(NH)[None, :, None, None] * rel)
+        if getattr(attn_fn, "handles_alibi", False):
+            # flash: bias built in-kernel from block indices
+            attn = attn_fn(q, k, v, cfg.causal, mask,
+                           alibi=alibi_slopes(NH))
+        else:
+            rel = (positions[:, None, :, None]
+                   - positions[:, None, None, :]).astype(jnp.float32)
+            attn = attn_fn(q, k, v, cfg.causal, mask,
+                           bias=-alibi_slopes(NH)[None, :, None, None] * rel)
     else:
         attn = attn_fn(q, k, v, cfg.causal, mask)
     attn = attn.reshape(B, S, NH * D)

@@ -35,12 +35,13 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k, seq_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, sl_ref, o_ref, lse_ref, *, sm_scale,
+                causal, block_k, seq_k, alibi):
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, D]
     bq, d = q.shape
     iq = pl.program_id(1)
     q_start = iq * bq
+    slope = sl_ref[0, 0] if alibi else 0.0
 
     nk = pl.cdiv(seq_k, block_k)
     if causal:
@@ -54,6 +55,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         s = q @ k_blk.T  # [bq, bk]
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        if alibi:
+            # ALiBi from block indices: no [S, S] bias materialization
+            s = s - slope * (rows - cols).astype(jnp.float32)
         valid = cols < seq_k  # last k block may be padded
         if causal:
             valid = valid & (rows >= cols)
@@ -76,8 +80,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)  # [bq, 1]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q=None, valid_k=None,
-         q_per_kv=1):
+def _fwd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
+         valid_q=None, valid_k=None, q_per_kv=1, alibi=False):
     """q: [B*NH, Sq, D]; k/v: [B*KVH, Sk, D] with NH = KVH * q_per_kv —
     GQA reads each kv head once via the index map instead of materializing
     the repeat (the reference's kv-replication copy)."""
@@ -90,12 +94,13 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q=None, valid_k=None
     g = q_per_kv
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=bk, seq_k=valid_k),
+                          block_k=bk, seq_k=valid_k, alibi=alibi),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
+            pl.BlockSpec((1, 1), lambda b, i: (b, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
@@ -106,15 +111,15 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q=None, valid_k=None
             jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(q, k, v, alibi_arr)
     return out, lse
 
 
 # ---------------------------------------------------------------------------
 # backward kernels (recompute p from q,k + lse)
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   sm_scale, causal, block_k, seq_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sl_ref,
+                   dq_ref, *, sm_scale, causal, block_k, seq_k, alibi):
     q = q_ref[0].astype(jnp.float32)  # [bq, D]
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]  # [bq, 1]
@@ -123,6 +128,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     iq = pl.program_id(1)
     q_start = iq * bq
     nk = pl.cdiv(q_start + bq, block_k) if causal else pl.cdiv(seq_k, block_k)
+    slope = sl_ref[0, 0] if alibi else 0.0
 
     def body(j, dq):
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -130,6 +136,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = (q @ k_blk.T) * sm_scale
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        if alibi:
+            s = s - slope * (rows - cols).astype(jnp.float32)
         valid = cols < seq_k
         if causal:
             valid = valid & (rows >= cols)
@@ -143,9 +151,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, sl_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                    block_q, seq_q, seq_k, q_per_kv):
+                    block_q, seq_q, seq_k, q_per_kv, alibi):
     """Grid (B*KVH, nk, q_per_kv) — group index fastest, so the dk/dv
     output block (indexed (bkv, jk), ignoring the group axis) is revisited
     consecutively; each grouped q head's contribution accumulates in fp32
@@ -159,6 +167,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = jk * bk
     k_valid_until = seq_k
     nq = pl.cdiv(seq_q, block_q)
+    slope = sl_ref[0, 0] if alibi else 0.0
 
     def body(i, carry):
         dk, dv = carry
@@ -169,6 +178,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = (q @ k_blk.T) * sm_scale  # [bq, bk]
         rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        if alibi:
+            s = s - slope * (rows - cols).astype(jnp.float32)
         # guard padded q rows (garbage q/lse) and padded k cols
         valid = (rows < seq_q) & (cols < k_valid_until)
         if causal:
@@ -204,8 +215,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
-         bwd_block_q, bwd_block_k, res, do):
-    q, k, v, out, lse = res
+         bwd_block_q, bwd_block_k, alibi, res, do):
+    q, k, v, alibi_arr, out, lse = res
     bh, seq_q, d = q.shape
     bkv = k.shape[0]
     seq_k = k.shape[1]
@@ -223,7 +234,7 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=bk, seq_k=valid_k),
+                          block_k=bk, seq_k=valid_k, alibi=alibi),
         grid=(bh, pl.cdiv(seq_q, bq)),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
@@ -232,16 +243,17 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1), lambda b, i: (b, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, alibi_arr)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, seq_q=valid_q, seq_k=valid_k,
-                          q_per_kv=g),
+                          q_per_kv=g, alibi=alibi),
         grid=(bkv, pl.cdiv(seq_k, bk), g),
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -254,6 +266,7 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
             pl.BlockSpec((1, seq_q, d), lambda b, j, gi: (b * g + gi, 0, 0)),
             pl.BlockSpec((1, seq_q, 1), lambda b, j, gi: (b * g + gi, 0, 0)),
             pl.BlockSpec((1, seq_q, 1), lambda b, j, gi: (b * g + gi, 0, 0)),
+            pl.BlockSpec((1, 1), lambda b, j, gi: (b * g + gi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, gi: (b, j, 0)),
@@ -264,30 +277,32 @@ def _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k, q_per_kv,
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(q, k, v, do, lse, delta, alibi_arr)
+    # alibi slopes are fixed constants: zero cotangent
+    return dq, dk, dv, jnp.zeros_like(alibi_arr)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10,
-                                                    11))
-def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, valid_q, valid_k,
-                q_per_kv, bwd_block_q, bwd_block_k):
-    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
-                  valid_k, q_per_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10,
+                                                    11, 12, 13))
+def _flash_bhsd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
+                valid_q, valid_k, q_per_kv, bwd_block_q, bwd_block_k, alibi):
+    out, _ = _fwd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
+                  valid_q, valid_k, q_per_kv, alibi=alibi)
     return out
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
-                    valid_k, q_per_kv, bwd_block_q, bwd_block_k):
-    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, valid_q,
-                    valid_k, q_per_kv)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
+                    valid_q, valid_k, q_per_kv, bwd_block_q, bwd_block_k,
+                    alibi):
+    out, lse = _fwd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
+                    valid_q, valid_k, q_per_kv, alibi=alibi)
+    return out, (q, k, v, alibi_arr, out, lse)
 
 
 def _flash_bwd_rule(sm_scale, causal, block_q, block_k, valid_q, valid_k,
-                    q_per_kv, bwd_block_q, bwd_block_k, res, do):
+                    q_per_kv, bwd_block_q, bwd_block_k, alibi, res, do):
     return _bwd(sm_scale, causal, block_q, block_k, valid_q, valid_k,
-                q_per_kv, bwd_block_q, bwd_block_k, res, do)
+                q_per_kv, bwd_block_q, bwd_block_k, alibi, res, do)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -296,7 +311,8 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
                     sm_scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512, impl: str = "pallas",
-                    bwd_block_q: int = 0, bwd_block_k: int = 0):
+                    bwd_block_q: int = 0, bwd_block_k: int = 0,
+                    alibi_slopes=None):
     """Public API on [B, S, NH, D] (matching models/transformer.py).
 
     GQA-native: k/v may carry KVH < NH heads (NH % KVH == 0) — each kv
@@ -309,19 +325,38 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
 
     ``segment_mask``: optional [B, S_k] padding mask (1 = keep); falls back
     to the XLA path when given (masked flash variant: future work).
+
+    ``alibi_slopes``: optional [NH] per-head ALiBi slopes — the bias is
+    built INSIDE the kernels from block indices (score -= slope*(i-j)),
+    never materializing [S, S] (bloom-family long-context training).
+    Assumes absolute in-kernel indices == token positions (unsharded or
+    Ulysses-regathered sequence, same contract as causal).
     """
     B, Sq, NH, D = q.shape
     KVH = k.shape[2]
     if segment_mask is not None:
         from ...models.transformer import _repeat_kv, xla_attention
 
+        bias = None
+        if alibi_slopes is not None:
+            # END-align queries like xla_attention's causal mask (tril with
+            # k=Sk-Sq): query i sits at absolute position Sk-Sq+i, so a
+            # decode-style Sq < Sk call penalizes distance correctly
+            Sk_ = k.shape[1]
+            rel = ((Sk_ - Sq + jnp.arange(Sq))[:, None]
+                   - jnp.arange(Sk_)[None, :]).astype(jnp.float32)
+            bias = -jnp.asarray(alibi_slopes)[None, :, None, None] * rel
         return xla_attention(q, _repeat_kv(k, NH // KVH),
-                             _repeat_kv(v, NH // KVH), causal, segment_mask)
+                             _repeat_kv(v, NH // KVH), causal, segment_mask,
+                             bias=bias)
     Sk = k.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
     if NH % KVH != 0:
         raise ValueError(f"n_heads {NH} not a multiple of kv heads {KVH}")
     q_per_kv = NH // KVH
+    if impl == "jax" and alibi_slopes is not None:
+        raise ValueError("impl='jax' (stock kernel) has no ALiBi input; "
+                         "use the default pallas impl")
     if impl == "jax":  # stock kernel for comparison
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as jax_fa)
@@ -350,7 +385,12 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
         qh = jnp.pad(qh, ((0, 0), (0, pad_q), (0, 0)))
         kh = jnp.pad(kh, ((0, 0), (0, pad_k), (0, 0)))
         vh = jnp.pad(vh, ((0, 0), (0, pad_k), (0, 0)))
-    out = _flash_bhsd(qh, kh, vh, scale, causal, block_q, block_k, Sq, Sk,
-                      q_per_kv, bwd_block_q, bwd_block_k)
+    if alibi_slopes is not None:
+        sl = jnp.tile(jnp.asarray(alibi_slopes, jnp.float32), B)[:, None]
+    else:
+        sl = jnp.zeros((B * NH, 1), jnp.float32)
+    out = _flash_bhsd(qh, kh, vh, sl, scale, causal, block_q, block_k, Sq, Sk,
+                      q_per_kv, bwd_block_q, bwd_block_k,
+                      alibi_slopes is not None)
     out = out[:, :Sq]
     return out.reshape(B, NH, Sq, D).transpose(0, 2, 1, 3)

@@ -165,3 +165,44 @@ def test_gradients_with_independent_bwd_blocks(bwd_bq, bwd_bk):
     for a, b, name in zip(g_out, g_ref, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
                                    rtol=1e-3, err_msg=f"d{name}")
+
+
+def test_flash_alibi_matches_xla_bias_fwd_bwd():
+    """In-kernel ALiBi (bias from block indices, never materializing
+    [S, S]) must match the XLA additive-bias formulation in outputs AND
+    q/k/v gradients, across GQA and multi-block shapes."""
+    from deepspeed_tpu.models.transformer import (_repeat_kv, alibi_slopes,
+                                                  xla_attention)
+
+    rng = np.random.RandomState(7)
+    B, S, NH, KVH, D = 2, 96, 4, 2, 16  # multi-block at block 32, GQA 2x
+    q = jnp.asarray(rng.randn(B, S, NH, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, S, KVH, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, S, KVH, D).astype(np.float32)) * 0.3
+    slopes = alibi_slopes(NH)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                            alibi_slopes=slopes)
+        return jnp.sum(o * o)
+
+    def loss_xla(q, k, v):
+        rel = (jnp.arange(S)[:, None] - jnp.arange(S)[None, :]).astype(
+            jnp.float32)
+        bias = -slopes[None, :, None, None] * rel
+        o = xla_attention(q, _repeat_kv(k, NH // KVH),
+                          _repeat_kv(v, NH // KVH), True, bias=bias)
+        return jnp.sum(o * o)
+
+    lf, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    lx, gx = jax.value_and_grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(lf), float(lx), rtol=1e-5)
+    for a, b, name in zip(gf, gx, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-4, err_msg=name)
+    # without slopes the default path is untouched (regression guard)
+    o_plain = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    o_xla = xla_attention(q, _repeat_kv(k, NH // KVH),
+                          _repeat_kv(v, NH // KVH), True)
+    np.testing.assert_allclose(np.asarray(o_plain), np.asarray(o_xla),
+                               atol=2e-5, rtol=2e-4)
