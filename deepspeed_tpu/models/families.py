@@ -16,6 +16,9 @@ Family-specific structure carried by the config:
             layernorm + gelu + biases
   opt     — learned positions, relu MLP, layernorm, biases
   falcon  — multi-query attention (kv_heads=1), parallel block, rope
+  bloom   — ALiBi attention bias, word_embeddings_layernorm, tied head
+  gpt-neox— partial rotary, parallel residual with separate norms,
+            untied embed_out
 """
 
 from __future__ import annotations
