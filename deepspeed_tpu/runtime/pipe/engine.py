@@ -92,7 +92,7 @@ def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
         return x
 
     def head_loss(x, tok_labels):
-        from ...models.transformer import logits_fn, nll_pick
+        from ...models.transformer import logits_fn
 
         h = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
                   cfg.norm, cfg.norm_eps)
@@ -101,7 +101,18 @@ def _pipe_body(params, ids, labels, *, cfg: TransformerConfig, num_micro: int,
         logits = logits_fn(cfg, params, h)[:, :-1]
         targets = tok_labels[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return jnp.mean(nll_pick(logp, targets))
+        # take_along_axis, NOT nll_pick: the one-hot contraction's
+        # transpose ABORTS XLA's CPU backend inside this partial-manual
+        # (pipe shard_map) region — same crash class as bf16 all-reduce
+        # promotion there.  The gather's scatter-add backward is safe
+        # here, and sequence sharding (nll_pick's reason to exist) does
+        # not compose into the pipe loss stage.
+        # clamp + mask (bert.py convention): take_along_axis would CLAMP
+        # a -100 ignore-index to vocab 0 and backprop garbage there
+        safe = jnp.maximum(targets, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        sel = (targets >= 0).astype(jnp.float32)
+        return jnp.sum(nll * sel) / jnp.maximum(jnp.sum(sel), 1.0)
 
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
