@@ -105,11 +105,6 @@ class InferenceEngineV2:
             raise NotImplementedError(
                 "InferenceEngineV2 serves causal decoders; post_norm "
                 "(BERT-style encoder) models have no generative path")
-        if self.cfg.position == "alibi":
-            raise NotImplementedError(
-                "InferenceEngineV2's paged programs carry no ALiBi score "
-                "bias yet — serve bloom-family models through the v1 "
-                "InferenceEngine (dense KV cache, ALiBi-aware)")
         block = self.config.block
         if block.num_pages < block.max_pages_per_seq:
             raise ValueError(

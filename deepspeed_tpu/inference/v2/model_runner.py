@@ -25,8 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from ...models.transformer import (MODEL_AXIS, TransformerConfig, _mm,
-                                   _norm, _repeat_kv, attn_qkv, logits_fn,
-                                   mlp_block)
+                                   _norm, _repeat_kv, alibi_slopes,
+                                   attn_qkv, logits_fn, mlp_block)
 
 
 def _use_paged_kernel() -> bool:
@@ -65,6 +65,14 @@ def _ffn(cfg: TransformerConfig, layer, x):
     """mlp_block shared with the training forward; inference drops aux loss."""
     out, _aux = mlp_block(cfg, layer, x, training=False)
     return out
+
+
+def _alibi_bias(cfg: TransformerConfig, qpos, kpos):
+    """ALiBi score bias: qpos [..., Q], kpos [..., K] (leading dims
+    broadcastable against batch) -> [..., NH, Q, K].  One definition for
+    all three paged programs so the formulations cannot diverge."""
+    rel = (qpos[..., :, None] - kpos[..., None, :]).astype(jnp.float32)
+    return -alibi_slopes(cfg.n_heads)[:, None, None] * rel[..., None, :, :]
 
 
 def _attn_out(cfg: TransformerConfig, layer, x, attn):
@@ -128,12 +136,19 @@ def paged_prefill(cfg: TransformerConfig, params, pools,
             # and their outputs are discarded; real tokens see real slots.
             from ...ops.pallas.flash_attention import flash_attention
 
-            attn = flash_attention(q, k, v, causal=True).reshape(1, S, -1)
+            attn = flash_attention(
+                q, k, v, causal=True,
+                alibi_slopes=(alibi_slopes(cfg.n_heads)
+                              if cfg.position == "alibi" else None)
+            ).reshape(1, S, -1)
         else:
             kk = _repeat_kv(k, cfg.n_heads // cfg.kv_heads)
             vv = _repeat_kv(v, cfg.n_heads // cfg.kv_heads)
             scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
             scores = scores / math.sqrt(cfg.head_dim)
+            if cfg.position == "alibi":
+                scores = scores + _alibi_bias(cfg, jnp.arange(S),
+                                              jnp.arange(S))
             causal = jnp.arange(S)[None, None, :, None] >= jnp.arange(S)[None, None, None, :]
             scores = jnp.where(causal, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
@@ -206,6 +221,14 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
         vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
         scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
         scores = scores / math.sqrt(cfg.head_dim)
+        if cfg.position == "alibi":
+            # query i sits at global start+i; prev slots at their pool
+            # index (page tables are position-ordered), chunk keys at
+            # start+j
+            scores = scores + _alibi_bias(
+                cfg, start + jnp.arange(C),
+                jnp.concatenate([jnp.arange(S_prev),
+                                 start + jnp.arange(C)]))
         mask = jnp.concatenate(
             [jnp.broadcast_to(prev_vis, (C, S_prev)), causal], axis=1)
         scores = jnp.where(mask[None, None], scores, -1e30)
@@ -273,7 +296,10 @@ def paged_decode(cfg: TransformerConfig, params, pools,
 
             attn = paged_decode_attention(
                 q[:, 0], k_c, v_c, page_table, positions,
-                k_scale=ks_c, v_scale=vs_c).reshape(B, 1, -1)
+                k_scale=ks_c, v_scale=vs_c,
+                alibi_slopes=(alibi_slopes(cfg.n_heads)
+                              if cfg.position == "alibi" else None)
+            ).reshape(B, 1, -1)
         else:
             kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
             vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
@@ -288,6 +314,11 @@ def paged_decode(cfg: TransformerConfig, params, pools,
             vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
             scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
             scores = scores / math.sqrt(cfg.head_dim)
+            if cfg.position == "alibi":
+                rel = (positions[:, None].astype(jnp.float32)
+                       - slot_pos.astype(jnp.float32))  # [B, S]
+                scores = scores - alibi_slopes(cfg.n_heads)[None, :, None,
+                                                            None]                     * rel[:, None, None, :]
             scores = jnp.where(vis[:, None, None, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)

@@ -33,7 +33,9 @@ def _interpret() -> bool:
 
 
 def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
-                   ps, scale, n_pages, quant):
+                   ps, scale, n_pages, quant, alibi):
+    rest = list(rest)
+    sl_ref = rest.pop(0) if alibi else None
     if quant:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -58,6 +60,9 @@ def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
     s = q @ k.T                                      # [G, ps]
     pos = pos_ref[b]
     slots = jp * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    if alibi:
+        # ALiBi distance penalty from page-slot indices (bloom decode)
+        s = s - sl_ref[0] * (pos - slots).astype(jnp.float32)
     s = jnp.where(slots <= pos, s, NEG_INF)
 
     m_prev, l_prev = m_scr[...], l_scr[...]
@@ -77,10 +82,11 @@ def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_table, positions,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None, alibi_slopes=None):
     """q: [B, NH, D]; pools: [P, ps, KVH, D] (int8 codes when ``k_scale``/
     ``v_scale`` [P, ps, KVH] given); page_table: [B, MP] int32;
-    positions: [B] int32.  Returns [B, NH, D]."""
+    positions: [B] int32; ``alibi_slopes``: optional [NH] per-head ALiBi
+    slopes (bias built in-kernel from slot indices).  Returns [B, NH, D]."""
     B, NH, D = q.shape
     P, ps, KVH, Dk = k_pool.shape
     MP = page_table.shape[1]
@@ -90,6 +96,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, positions,
     scale = 1.0 / math.sqrt(D)
     qg = q.reshape(B, KVH, G, D)
 
+    alibi = alibi_slopes is not None
     in_specs = [
         pl.BlockSpec((1, 1, G, D),
                      lambda b, h, jp, pt, pos: (b, h, 0, 0)),
@@ -100,6 +107,12 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, positions,
                      lambda b, h, jp, pt, pos: (pt[b, jp], 0, h, 0)),
     ]
     args = [qg, k_pool, v_pool]
+    if alibi:
+        # rides right after k/v so the kernel pops it off *rest first
+        in_specs.append(pl.BlockSpec(
+            (1, G, 1), lambda b, h, jp, pt, pos: (h, 0, 0)))
+        args.append(jnp.asarray(alibi_slopes, jnp.float32)
+                    .reshape(KVH, G, 1))
     if quant:
         in_specs += [
             pl.BlockSpec((1, ps, 1, 1),
@@ -112,7 +125,7 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, positions,
     grid = (B, KVH, MP)
     kernel = pl.pallas_call(
         functools.partial(_decode_kernel, ps=ps, scale=scale, n_pages=MP,
-                          quant=quant),
+                          quant=quant, alibi=alibi),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,

@@ -125,3 +125,32 @@ def test_alibi_distance_penalty_and_v1_decode():
                                      jnp.zeros((2,), jnp.int32))
     np.testing.assert_allclose(np.asarray(step, np.float32), full,
                                atol=2e-4, rtol=2e-3)
+
+
+def test_bloom_paged_inference_matches_dense(monkeypatch):
+    """ALiBi through the v2 paged engine: whole-prompt and chunked
+    prefill, XLA fallback AND Pallas kernels (interpret mode), must all
+    reproduce the dense cached decode."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig,
+                                            RaggedRequest)
+    from tests.unit.test_inference_v2 import _dense_greedy
+
+    model = bloom_model("tiny", max_seq_len=256)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = list(np.random.RandomState(8).randint(
+        0, model.config.vocab_size, 21))
+    want = _dense_greedy(model, params, prompt, 6)
+
+    for kernel in ("0", "1"):
+        monkeypatch.setenv("DSTPU_PAGED_KERNEL", kernel)
+        # quant rides along so the kernel's alibi+int8 operand ordering
+        # (slopes popped from *rest before the scales) stays covered
+        for chunk, quant in ((0, False), (16, False), (0, True), (16, True)):
+            eng = InferenceEngineV2(model, RaggedInferenceConfig(
+                dtype="fp32", page_size=8, num_pages=32, max_seqs=2,
+                max_pages_per_seq=8, prefill_chunk=chunk,
+                kv_quant=quant), params=params)
+            got = eng.generate_all(
+                [RaggedRequest(prompt_ids=prompt, max_new_tokens=6)])
+            assert got[0] == want, (kernel, chunk, quant, got[0], want)
