@@ -315,10 +315,8 @@ def paged_decode(cfg: TransformerConfig, params, pools,
             scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
             scores = scores / math.sqrt(cfg.head_dim)
             if cfg.position == "alibi":
-                rel = (positions[:, None].astype(jnp.float32)
-                       - slot_pos.astype(jnp.float32))  # [B, S]
-                scores = scores - alibi_slopes(cfg.n_heads)[None, :, None,
-                                                            None]                     * rel[:, None, None, :]
+                scores = scores + _alibi_bias(cfg, positions[:, None],
+                                              slot_pos)
             scores = jnp.where(vis[:, None, None, :], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)

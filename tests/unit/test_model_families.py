@@ -45,7 +45,8 @@ def test_family_structure_flags():
     assert mistral_model("tiny").config.kv_heads == 2  # GQA
 
 
-@pytest.mark.parametrize("family", [phi_model, falcon_model, qwen_model],
+@pytest.mark.parametrize("family", [phi_model, falcon_model, qwen_model,
+                                    gpt_neox_model],
                          ids=lambda f: f.__name__)
 def test_family_paged_inference_matches_dense(family):
     """The paged (inference v2) path must agree with the dense cached
