@@ -294,10 +294,12 @@ class InferenceEngineV2:
                                np.int32)
                 npg = -(-c_n // ps)
                 rows[:npg] = seq.pages[start // ps:start // ps + npg]
-                # bucket the PREVIOUS-pages window (power-of-two page
-                # counts): early chunks of a long prompt must not gather
-                # the full max window; few shapes -> few compiles
-                used = -(-start // ps)
+                # bucket the window THROUGH this chunk (power-of-two
+                # page counts): early chunks of a long prompt must not
+                # gather the full max window, and the kernel path needs
+                # the chunk's own pages in the table (pool-slot index ==
+                # global position); few shapes -> few compiles
+                used = -(-(start + c_n) // ps)
                 b = 1
                 while b < max(used, 1):
                     b *= 2

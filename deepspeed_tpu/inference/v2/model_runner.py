@@ -176,9 +176,11 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
 
     ids: [C] chunk tokens (C fixed, multiple of page_size);
     chunk_rows: [C // ps] pages receiving this chunk's K/V;
-    prev_table: [MPb] pages of EARLIER chunks — the caller buckets its
-    length (power-of-two page counts) so early chunks don't gather the
-    full max window; start: global position of ids[0]; n: valid tokens.
+    prev_table: [MPb] the sequence's page-table prefix covering the
+    window THROUGH this chunk (the kernel path reads the chunk's own
+    keys from the pool; the caller buckets the length to power-of-two
+    page counts so early chunks don't gather the full max window);
+    start: global position of ids[0]; n: valid tokens.
     Chunk queries attend to all previously-written positions (< start,
     via the page pool) plus causally within the chunk.  Returns (logits
     of token start+n-1 — meaningful on the FINAL chunk — and pools)."""
@@ -200,6 +202,12 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
     prev_vis = jnp.arange(S_prev)[None, :] < start  # [1, S_prev]
     causal = jnp.arange(C)[:, None] >= jnp.arange(C)[None, :]  # [C(q), C(k)]
 
+    # quant + chunked stays on the XLA path: the kernel window would put
+    # the chunk's OWN keys through the int8 round-trip while the fallback
+    # (and whole-prompt prefill) attend fresh in-chunk keys — keeping the
+    # chunked/whole divergence limited to the inherent cross-chunk case
+    use_flash = _use_paged_kernel() and not quant
+
     def body(x, inputs):
         layer, k_c, v_c, ks_c, vs_c = inputs
         q, k, v = attn_qkv(cfg, layer, x, positions)
@@ -213,6 +221,22 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
                   * ks_c[prev_table].reshape(S_prev, -1)[..., None])
             vp = (vp.astype(jnp.float32)
                   * vs_c[prev_table].reshape(S_prev, -1)[..., None])
+        if use_flash:
+            # the table covers the window THROUGH this chunk (engine
+            # buckets it to >= start + C), and pool-slot index == global
+            # position — offset-flash's causal mask handles previous
+            # chunks, in-chunk causality, AND trash/pad slots (they sit
+            # at positions > every query) in one kernel, with no
+            # [C, S_win] fp32 score materialization
+            from ...ops.pallas.flash_attention import flash_attention
+
+            attn = flash_attention(
+                q, kp.astype(x.dtype)[None], vp.astype(x.dtype)[None],
+                causal=True, q_offset=start,
+                alibi_slopes=(alibi_slopes(cfg.n_heads)
+                              if cfg.position == "alibi" else None)
+            ).reshape(1, C, -1)
+            return _attn_out(cfg, layer, x, attn), (k_c, v_c, ks_c, vs_c)
         # keys = [previous pooled slots | this chunk]; the pooled half is
         # masked to < start, the chunk half causally within the chunk
         kk = jnp.concatenate([kp.astype(x.dtype)[None], k], axis=1)

@@ -35,18 +35,24 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, sl_ref, o_ref, lse_ref, *, sm_scale,
-                causal, block_k, seq_k, alibi):
+def _fwd_kernel(q_ref, k_ref, v_ref, sl_ref, off_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_k, seq_k, alibi, offset):
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, D]
     bq, d = q.shape
     iq = pl.program_id(1)
     q_start = iq * bq
     slope = sl_ref[0, 0] if alibi else 0.0
+    if offset:
+        # chunked prefill: query i is GLOBAL position off + q_start + i
+        # (keys are pool slots at their global positions); the causal
+        # k-block bound stays the full window — the offset is runtime
+        # data, and callers pass a window bucketed near off + seq_q
+        q_start = q_start + off_ref[0]
 
     nk = pl.cdiv(seq_k, block_k)
-    if causal:
+    if causal and not offset:
         # only k blocks whose start is <= last q row
-        nk = pl.cdiv(q_start + bq, block_k)
+        nk = pl.cdiv(iq * bq + bq, block_k)
 
     def body(j, carry):
         acc, m_prev, l_prev = carry
@@ -81,7 +87,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sl_ref, o_ref, lse_ref, *, sm_scale,
 
 
 def _fwd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
-         valid_q=None, valid_k=None, q_per_kv=1, alibi=False):
+         valid_q=None, valid_k=None, q_per_kv=1, alibi=False,
+         offset_arr=None, offset=False):
     """q: [B*NH, Sq, D]; k/v: [B*KVH, Sk, D] with NH = KVH * q_per_kv —
     GQA reads each kv head once via the index map instead of materializing
     the repeat (the reference's kv-replication copy)."""
@@ -92,15 +99,19 @@ def _fwd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
     bk = min(block_k, seq_k)
     grid = (bh, pl.cdiv(seq_q, bq))
     g = q_per_kv
+    if offset_arr is None:
+        offset_arr = jnp.zeros((1,), jnp.int32)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=bk, seq_k=valid_k, alibi=alibi),
+                          block_k=bk, seq_k=valid_k, alibi=alibi,
+                          offset=offset),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b // g, 0, 0)),
             pl.BlockSpec((1, 1), lambda b, i: (b, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
@@ -111,7 +122,7 @@ def _fwd(q, k, v, alibi_arr, sm_scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((bh, seq_q, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, alibi_arr)
+    )(q, k, v, alibi_arr, offset_arr)
     return out, lse
 
 
@@ -312,7 +323,7 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
                     sm_scale: Optional[float] = None,
                     block_q: int = 512, block_k: int = 512, impl: str = "pallas",
                     bwd_block_q: int = 0, bwd_block_k: int = 0,
-                    alibi_slopes=None):
+                    alibi_slopes=None, q_offset=None):
     """Public API on [B, S, NH, D] (matching models/transformer.py).
 
     GQA-native: k/v may carry KVH < NH heads (NH % KVH == 0) — each kv
@@ -331,6 +342,12 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
     never materializing [S, S] (bloom-family long-context training).
     Assumes absolute in-kernel indices == token positions (unsharded or
     Ulysses-regathered sequence, same contract as causal).
+
+    ``q_offset``: optional RUNTIME scalar — query i sits at absolute
+    position ``q_offset + i`` while keys keep their buffer index as
+    their position (chunked prefill over a position-ordered KV window).
+    FORWARD-ONLY: the offset is not threaded through the backward
+    kernels, so this path defines no VJP.
     """
     B, Sq, NH, D = q.shape
     KVH = k.shape[2]
@@ -389,8 +406,17 @@ def flash_attention(q, k, v, causal: bool = True, segment_mask=None,
         sl = jnp.tile(jnp.asarray(alibi_slopes, jnp.float32), B)[:, None]
     else:
         sl = jnp.zeros((B * NH, 1), jnp.float32)
-    out = _flash_bhsd(qh, kh, vh, sl, scale, causal, block_q, block_k, Sq, Sk,
-                      q_per_kv, bwd_block_q, bwd_block_k,
-                      alibi_slopes is not None)
+    if q_offset is not None:
+        # forward-only inference path (no custom VJP)
+        out, _ = _fwd(qh, kh, vh, sl, scale, causal, block_q, block_k,
+                      Sq, Sk, q_per_kv, alibi=alibi_slopes is not None,
+                      offset_arr=jnp.asarray(q_offset,
+                                             jnp.int32).reshape(1),
+                      offset=True)
+    else:
+        out = _flash_bhsd(qh, kh, vh, sl, scale, causal, block_q, block_k,
+                          Sq, Sk, q_per_kv, bwd_block_q, bwd_block_k,
+                          alibi_slopes is not None)
     out = out[:, :Sq]
     return out.reshape(B, NH, Sq, D).transpose(0, 2, 1, 3)
+

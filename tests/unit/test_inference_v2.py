@@ -327,7 +327,9 @@ def test_on_device_temperature_sampling_reproducible():
     assert a != c or len(set(a)) == 1
 
 
-def test_chunked_prefill_matches_whole_prompt():
+@pytest.mark.parametrize("kernel", ["0", "1"])
+def test_chunked_prefill_matches_whole_prompt(kernel, monkeypatch):
+    monkeypatch.setenv("DSTPU_PAGED_KERNEL", kernel)
     """Dynamic-SplitFuse-style chunked prefill (prefill_chunk > 0): long
     prompts processed in page-aligned chunks, decode interleaving between
     chunks — generations must equal the whole-prompt path exactly, and
