@@ -237,7 +237,6 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     import jax.numpy as jnp
 
     import deepspeed_tpu
-    from deepspeed_tpu.models.llama import llama_model
     from deepspeed_tpu.models.transformer import flops_per_token
 
     model, config, _meta = build_model_and_config(

@@ -214,8 +214,12 @@ class SubprocessTrialRunner:
         self.user_args = list(user_args or [])
         self.results_dir = os.path.abspath(results_dir)
         self.timeout_s = timeout_s
+        # ConnectTimeout bounds ssh setup: the remote `timeout` only
+        # starts after connect, so an unbounded connect would let the
+        # local timer (timeout_s + 30) win the race it exists to lose
         self.launcher = (launcher if launcher is not None
-                         else ["ssh", "-o", "BatchMode=yes", "{host}"])
+                         else ["ssh", "-o", "BatchMode=yes",
+                               "-o", "ConnectTimeout=15", "{host}"])
 
     def __call__(self, exp: Dict[str, Any], res: Reservation) -> Optional[float]:
         exp_dir = os.path.join(self.results_dir, str(exp["name"]).replace("/", "_"))
@@ -229,6 +233,7 @@ class SubprocessTrialRunner:
         env.update(trial_env)
         cmd = [sys.executable, self.user_script, "--exp_config", cfg_path,
                *self.user_args]
+        local_timeout = self.timeout_s
         if res.node.host not in _LOCAL_HOSTS:
             prefix = [a.format(host=res.node.host) for a in self.launcher]
             # ssh space-joins its trailing args into ONE remote shell
@@ -238,10 +243,19 @@ class SubprocessTrialRunner:
             # a local ssh kill cannot orphan a trial that still holds the
             # reserved chips.
             remote = ["env", *[f"{k}={v}" for k, v in trial_env.items()],
-                      "timeout", str(int(self.timeout_s)), *cmd]
+                      # -k: escalate to SIGKILL — a trial wedged in
+                      # uninterruptible TPU backend init ignores SIGTERM,
+                      # and an unkilled remote is exactly the orphaned-
+                      # chips failure the remote timer exists to prevent
+                      "timeout", "-k", "10", str(int(self.timeout_s)), *cmd]
             cmd = prefix + [" ".join(shlex.quote(t) for t in remote)]
+            # give the REMOTE `timeout` slack to fire first: if the local
+            # timer raced it, the ssh kill orphaned a trial that still
+            # held the reserved chips — local expiry is only the backstop
+            # for a hung ssh transport
+            local_timeout = self.timeout_s + 30
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=self.timeout_s,
+            cmd, capture_output=True, text=True, timeout=local_timeout,
             env=env)
         with open(os.path.join(exp_dir, "stderr.log"), "w") as f:
             f.write(proc.stderr)

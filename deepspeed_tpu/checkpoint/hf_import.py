@@ -222,7 +222,9 @@ def config_from_hf(model_dir_or_cfg) -> "TransformerConfig":
             max_seq_len=c.get("seq_length", 2048),  # ALiBi: no pos table
             norm="layernorm", activation="gelu",  # BloomGelu = tanh approx
             position="alibi", causal=True, use_bias=True, embed_norm=True,
-            tie_embeddings=True,
+            # HF bloom defaults to a tied head but honors the flag; a
+            # hardcoded True silently dropped untied lm_head weights
+            tie_embeddings=bool(c.get("tie_word_embeddings", True)),
             norm_eps=c.get("layer_norm_epsilon", 1e-5))
     if mtype == "gpt_neox":
         if not c.get("use_parallel_residual", True):
@@ -814,8 +816,9 @@ def _import_neox_style(cfg, state, layer_fmt: str, attn: str):
 
 def _import_bloom(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
     """BloomForCausalLM: ALiBi (no position table), per-head-fused QKV,
-    word_embeddings_layernorm, biases everywhere, tied head."""
-    return {
+    word_embeddings_layernorm, biases everywhere, head tied by default
+    (untied variants carry their own lm_head.weight)."""
+    p = {
         "embed": {
             "tok": np.asarray(state["transformer.word_embeddings.weight"]),
             "norm": {
@@ -829,6 +832,9 @@ def _import_bloom(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:
         "layers": _import_neox_style(cfg, state, "transformer.h.{i}.",
                                      "self_attention"),
     }
+    if not cfg.tie_embeddings:
+        p["lm_head"] = {"w": np.asarray(state["lm_head.weight"]).T}
+    return p
 
 
 def _import_gpt_neox(cfg, state: Dict[str, np.ndarray]) -> Dict[str, Any]:

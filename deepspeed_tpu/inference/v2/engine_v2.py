@@ -30,8 +30,10 @@ from ...models.transformer import TransformerConfig
 from ...runtime.config_utils import ConfigModel
 from ...runtime.precision import cast_tree
 from ...utils.logging import logger
-from .model_runner import paged_decode, paged_prefill, paged_prefill_chunk
-from .ragged import BlockAllocator, KVBlockConfig, PagedKVCache, SequenceState
+from .model_runner import (paged_copy_page, paged_decode, paged_prefill,
+                           paged_prefill_chunk)
+from .ragged import (BlockAllocator, KVBlockConfig, PagedKVCache, PrefixCache,
+                     SequenceState)
 
 
 @dataclasses.dataclass
@@ -53,6 +55,21 @@ class RaggedInferenceConfig(ConfigModel):
     quant_min_size: int = 1 << 14  # per-matrix eligibility floor
     #: int8 KV pages + per-(page,slot,head) scales: half the KV pool HBM
     kv_quant: bool = False
+    #: automatic prefix caching: retired/preempted sequences leave their
+    #: full KV pages in a content-hash index; new requests map the longest
+    #: cached page-aligned prefix straight into their page table and
+    #: prefill only the uncached suffix.  GREEDY decoding is bit-exact
+    #: vs. cache-off, EXCEPT under kv_quant (the suffix attends
+    #: dequantized cached pages where a whole-prompt prefill attends
+    #: fresh full-precision keys — the same inherent cross-chunk
+    #: approximation chunked prefill has).  Temperature sampling stays
+    #: distributionally correct but not stream-identical: a fully-cached
+    #: prompt samples its first token on the device RNG (decode entry)
+    #: instead of the host RNG
+    enable_prefix_cache: bool = False
+    #: cap on cached-but-UNREFERENCED pages retained for reuse (LRU);
+    #: 0 = bounded only by the pool itself
+    prefix_cache_pages: int = 0
 
     @property
     def jnp_dtype(self):
@@ -131,7 +148,17 @@ class InferenceEngineV2:
         # A learned-position model cannot attend past its position table; cap
         # the paged window to the model's trained context.
         self.max_seq_len = min(block.max_seq_len, self.cfg.max_seq_len)
-        self.allocator = BlockAllocator(block.num_pages)
+        self.allocator = BlockAllocator(
+            block.num_pages,
+            cache_pages=(self.config.prefix_cache_pages
+                         if self.config.enable_prefix_cache else 0))
+        self.prefix_cache = (PrefixCache(block.page_size, self.allocator)
+                             if self.config.enable_prefix_cache else None)
+        # serving counters (cache_stats / publish_metrics): token-level
+        # admission vs. computation, so hit_rate is FLOP-meaningful
+        self._stats = {"prefill_admitted_tokens": 0,
+                       "prefill_computed_tokens": 0,
+                       "prefix_hit_tokens": 0}
         self._uid = itertools.count()
         self._admit_counter = itertools.count()
         self._rng = np.random.RandomState(seed)
@@ -161,6 +188,7 @@ class InferenceEngineV2:
             lambda *a: paged_prefill(cfg, *a), donate_argnums=(1,))
         self._prefill_chunk = jax.jit(
             lambda *a: paged_prefill_chunk(cfg, *a), donate_argnums=(1,))
+        self._copy_page = jax.jit(paged_copy_page, donate_argnums=(0,))
         ps = self.block.page_size
         self._chunk = (-(-self.config.prefill_chunk // ps) * ps
                        if self.config.prefill_chunk > 0 else 0)
@@ -203,12 +231,15 @@ class InferenceEngineV2:
 
     def _preempt(self, seq: SequenceState) -> None:
         """Evict a running sequence to the queue head; it will re-prefill its
-        whole prefix (recompute, the reference scheduler's KV-pressure relief)
-        when pages free up."""
+        prefix (recompute, the reference scheduler's KV-pressure relief)
+        when pages free up — hitting the prefix cache it just populated,
+        so with caching on the "recompute" is mostly a table lookup."""
         self.allocator.free(seq.pages)
         self._page_table[seq.slot, :] = self.block.trash_page
         self._slots[seq.slot] = None
         seq.slot, seq.pages, seq.prefilled = -1, [], 0
+        seq.page_keys, seq.registered_upto, seq.decode_entry = [], 0, False
+        seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
         self._queue.insert(0, seq)
 
     def _admit(self) -> List[SequenceState]:
@@ -219,17 +250,89 @@ class InferenceEngineV2:
                 break
             if slot is not None:
                 continue
-            need = -(-self._queue[0].length // ps)
-            if need > self.allocator.free_pages:
+            seq = self._queue[0]
+            shared: List[int] = []
+            keys: List[Any] = []
+            if self.prefix_cache is not None:
+                # memoized while the registry is unchanged: a blocked
+                # head of queue must not re-hash its prompt every step.
+                # Registrations only EXTEND a valid match, so unless an
+                # eviction happened the walk resumes from the memo's end
+                if seq.match_gen != self.allocator.generation:
+                    resume = (seq.cached_match
+                              if seq.match_evict_gen
+                              == self.allocator.evict_generation else None)
+                    seq.cached_match = self.prefix_cache.match(
+                        seq.tokens, resume=resume)
+                    seq.match_gen = self.allocator.generation
+                    seq.match_evict_gen = self.allocator.evict_generation
+                shared, keys = seq.cached_match
+            n_total = -(-seq.length // ps)
+            m = len(shared)
+            # fully-cached prompt (page-aligned): the last cached page is
+            # replaced by a private COPY-ON-WRITE duplicate — the decode
+            # program recomputes only the final prompt token and writes
+            # its KV into the copy, never into the shared page
+            full_hit = m > 0 and m * ps >= seq.length
+            need_new = n_total - m + (1 if full_hit else 0)
+            # exact admission check WITHOUT touching the LRU: matched
+            # pages at refcount 0 are counted in free_pages but will be
+            # claimed by share(), not alloc() — exclude them so a blocked
+            # head of queue doesn't churn pages through the LRU each step
+            lru_matched = sum(1 for p in shared
+                              if self.allocator.refcount(p) == 0)
+            if need_new > self.allocator.free_pages - lru_matched:
                 break  # head-of-line blocking, like the reference's FCFS
-            seq = self._queue.pop(0)
-            seq.slot, seq.pages = i, self.allocator.alloc(need)
+            # protect matched pages from LRU eviction before allocating
+            for p in shared:
+                self.allocator.share(p)
+            self._queue.pop(0)
+            seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
+            fresh = self.allocator.alloc(need_new)
+            if full_hit:
+                src, dst = shared[-1], fresh[-1]
+                self._pools = self._copy_page(self._pools, jnp.int32(src),
+                                              jnp.int32(dst))
+                self.allocator.free([src])  # drop our ref on the original
+                seq.pages = shared[:-1] + [dst]
+                seq.prefilled = seq.length - 1
+                seq.decode_entry = True
+            else:
+                seq.pages = shared + fresh
+                seq.prefilled = m * ps
+            seq.page_keys = keys
+            # matched pages are already registered; the CoW copy stays
+            # private (the original remains the canonical cached page)
+            seq.registered_upto = n_total if full_hit else m
+            if self.prefix_cache is not None:
+                self.prefix_cache.count(m, seq.length // ps)
+            self._stats["prefill_admitted_tokens"] += seq.length
+            self._stats["prefix_hit_tokens"] += seq.prefilled
+            self._stats["prefill_computed_tokens"] += seq.length - seq.prefilled
+            seq.slot = i
             seq.admit_order = next(self._admit_counter)
             self._page_table[i, :] = self.block.trash_page
-            self._page_table[i, :need] = seq.pages
+            self._page_table[i, :len(seq.pages)] = seq.pages
             admitted.append(seq)
             self._slots[i] = seq
         return admitted
+
+    def _register_pages(self, seq: SequenceState) -> None:
+        """Offer every fully-written, not-yet-registered page of ``seq``
+        to the prefix-cache registry (first writer wins).  Called after
+        each KV-writing program, BEFORE any retire can free the pages —
+        a registered page freed later parks in the LRU with its content
+        intact."""
+        if self.prefix_cache is None:
+            return
+        full = seq.prefilled // self.block.page_size
+        if full <= seq.registered_upto:
+            return
+        seq.page_keys = self.prefix_cache.page_keys(seq.tokens, full,
+                                                    seq.page_keys)
+        for j in range(seq.registered_upto, full):
+            self.allocator.register(seq.pages[j], seq.page_keys[j])
+        seq.registered_upto = full
 
     def _emit_sampled(self, seq: SequenceState, logits, out) -> None:
         """Sample off prefix-end logits, append, record, maybe retire —
@@ -245,8 +348,12 @@ class InferenceEngineV2:
     def _ready_to_decode(seq: SequenceState) -> bool:
         """KV written for tokens[0:length-1] AND a token has been sampled
         off the prefix end — mid-chunked-prefill sequences (and preempted
-        ones re-prefilling their prefix) must not enter the decode batch."""
-        return seq.generated > 0 and seq.prefilled >= seq.length - 1
+        ones re-prefilling their prefix) must not enter the decode batch.
+        Exception: a fully-cached prompt (decode_entry) starts decoding
+        immediately — its first decode step recomputes the final prompt
+        token's KV (into its CoW page) and samples the first token."""
+        return ((seq.generated > 0 or seq.decode_entry)
+                and seq.prefilled >= seq.length - 1)
 
     def _sample(self, seq: SequenceState, logits: np.ndarray) -> int:
         if seq.temperature <= 0.0:
@@ -269,6 +376,37 @@ class InferenceEngineV2:
                 or seq.length >= self.max_seq_len):
             self._retire(seq)
 
+    def _run_prefill_chunk(self, seq: SequenceState, start: int, c_n: int,
+                           C: int):
+        """One start-offset prefill call covering tokens
+        [start, start+c_n) in a C-token program (C a page multiple) —
+        shared by chunked prefill and the cached-prefix suffix path.
+        Returns the logits of token start+c_n-1."""
+        ps = self.block.page_size
+        ids = np.zeros((C,), np.int32)
+        ids[:c_n] = seq.tokens[start:start + c_n]
+        rows = np.full((C // ps,), self.block.trash_page, np.int32)
+        npg = -(-c_n // ps)
+        rows[:npg] = seq.pages[start // ps:start // ps + npg]
+        # bucket the window THROUGH this chunk (power-of-two
+        # page counts): early chunks of a long prompt must not
+        # gather the full max window, and the kernel path needs
+        # the chunk's own pages in the table (pool-slot index ==
+        # global position); few shapes -> few compiles
+        used = -(-(start + c_n) // ps)
+        b = 1
+        while b < max(used, 1):
+            b *= 2
+        prev = self._page_table[seq.slot][:min(
+            b, self.block.max_pages_per_seq)]
+        logits, self._pools = self._prefill_chunk(
+            self.params, self._pools, jnp.asarray(ids),
+            jnp.asarray(rows), jnp.asarray(prev),
+            jnp.int32(start), jnp.int32(c_n))
+        seq.prefilled = start + c_n
+        self._register_pages(seq)
+        return logits
+
     # -- the engine step -----------------------------------------------------
     def step(self) -> Dict[int, Dict[str, Any]]:
         """Admit + prefill new sequences, decode one token for running ones.
@@ -282,38 +420,31 @@ class InferenceEngineV2:
         if self._chunk:
             # Dynamic-SplitFuse-style chunked prefill: ONE chunk per
             # pending-prefill sequence per step; decode for ready
-            # sequences runs below in the SAME step, between chunks
+            # sequences runs below in the SAME step, between chunks.
+            # A cached-prefix admission starts mid-prompt: seq.prefilled
+            # was set to the mapped prefix end, so the first chunk is
+            # already suffix-only.
             pending = [s for s in self._slots if s is not None
                        and not self._ready_to_decode(s)]
             for seq in pending:
                 start = seq.prefilled  # page-aligned: chunk % ps == 0
                 c_n = min(self._chunk, seq.length - start)
-                ids = np.zeros((self._chunk,), np.int32)
-                ids[:c_n] = seq.tokens[start:start + c_n]
-                rows = np.full((self._chunk // ps,), self.block.trash_page,
-                               np.int32)
-                npg = -(-c_n // ps)
-                rows[:npg] = seq.pages[start // ps:start // ps + npg]
-                # bucket the window THROUGH this chunk (power-of-two
-                # page counts): early chunks of a long prompt must not
-                # gather the full max window, and the kernel path needs
-                # the chunk's own pages in the table (pool-slot index ==
-                # global position); few shapes -> few compiles
-                used = -(-(start + c_n) // ps)
-                b = 1
-                while b < max(used, 1):
-                    b *= 2
-                prev = self._page_table[seq.slot][:min(
-                    b, self.block.max_pages_per_seq)]
-                logits, self._pools = self._prefill_chunk(
-                    self.params, self._pools, jnp.asarray(ids),
-                    jnp.asarray(rows), jnp.asarray(prev),
-                    jnp.int32(start), jnp.int32(c_n))
-                seq.prefilled = start + c_n
+                logits = self._run_prefill_chunk(seq, start, c_n, self._chunk)
                 if seq.prefilled >= seq.length:
                     self._emit_sampled(seq, logits, out)
         else:
             for seq in admitted:
+                if seq.decode_entry:
+                    continue  # fully cached: enters via the decode program
+                if seq.prefilled:
+                    # cached prefix: suffix-only prefill through the
+                    # start-offset program, bucketed like whole prompts
+                    # so the shape set stays fixed
+                    n_suf = seq.length - seq.prefilled
+                    logits = self._run_prefill_chunk(
+                        seq, seq.prefilled, n_suf, self._bucket(n_suf))
+                    self._emit_sampled(seq, logits, out)
+                    continue
                 # seq.length, not prompt_len: a preempted sequence
                 # re-prefills its whole prefix (prompt + tokens generated
                 # before eviction)
@@ -328,6 +459,7 @@ class InferenceEngineV2:
                     self.params, self._pools,
                     jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
                 seq.prefilled = n
+                self._register_pages(seq)
                 self._emit_sampled(seq, logits, out)
 
         active = [s for s in self._slots
@@ -389,11 +521,45 @@ class InferenceEngineV2:
             seq.tokens.append(tok)
             # the decode step wrote KV for the token it consumed
             seq.prefilled = seq.length - 1
+            if self.prefix_cache is not None and seq.prefilled % ps == 0:
+                # the decode write completed a page: publish it so a
+                # preempted-then-readmitted (or forked) sequence can remap
+                # instead of recomputing
+                self._register_pages(seq)
             rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
             rec["tokens"].append(tok)
             self._maybe_finish(seq, tok)
             rec["done"] = seq.done
         return out
+
+    # -- serving metrics -----------------------------------------------------
+    def cache_stats(self) -> Dict[str, float]:
+        """Prefix-cache and prefill-work counters (cumulative).  Valid —
+        all zeros for the cache-specific entries — with caching off, so
+        dashboards need no conditional wiring."""
+        s: Dict[str, float] = dict(self._stats)
+        s["cache_hits"] = self.prefix_cache.hits if self.prefix_cache else 0
+        s["cache_misses"] = (self.prefix_cache.misses
+                             if self.prefix_cache else 0)
+        s["cache_evictions"] = self.allocator.evictions
+        s["cached_pages"] = self.allocator.cached_pages
+        adm = s["prefill_admitted_tokens"]
+        s["prefix_hit_rate"] = (s["prefix_hit_tokens"] / adm) if adm else 0.0
+        return s
+
+    def reset_cache_stats(self) -> None:
+        """Zero the counters (cache CONTENTS are kept) — benches call this
+        after warmup so compile-wave admissions don't pollute the rates."""
+        self._stats = {k: 0 for k in self._stats}
+        self.allocator.evictions = 0
+        if self.prefix_cache is not None:
+            self.prefix_cache.hits = self.prefix_cache.misses = 0
+
+    def publish_metrics(self, monitor, step: int) -> None:
+        """Surface the serving counters through a monitor/* writer
+        (MonitorMaster or any object with ``write_events``)."""
+        monitor.write_events([(f"serving/{k}", float(v), int(step))
+                              for k, v in self.cache_stats().items()])
 
     def generate_all(self, requests: List[RaggedRequest],
                      max_steps: int = 10_000) -> Dict[int, List[int]]:

@@ -164,6 +164,18 @@ def paged_prefill(cfg: TransformerConfig, params, pools,
     return logits, out_pools
 
 
+def paged_copy_page(pools, src, dst):
+    """Copy-on-write for the prefix cache: duplicate page ``src`` into
+    ``dst`` across every pool leaf (K/V codes and, under kv_quant, their
+    scales — all laid out ``[L, P+1, ...]``).  A sequence whose whole
+    prompt is cached must write the KV of its final prompt token through
+    the decode program; that write lands in its private copy so the
+    shared cached page is never mutated.  The engine jits this with the
+    pools donated — one compiled program regardless of src/dst."""
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, dst].set(a[:, src]), pools)
+
+
 def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
                         ids, chunk_rows, prev_table, start, n
                         ) -> Tuple[jnp.ndarray, Any]:
@@ -173,6 +185,16 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
     steps for other sequences interleave between chunks, bounding
     per-step latency instead of stalling every running stream for a full
     prompt.
+
+    This is also the engine's START-OFFSET prefill for automatic prefix
+    caching: a request whose leading pages were mapped from the prefix
+    cache prefills only the uncached suffix by calling this with
+    ``start`` at the first uncached (page-aligned) position — the cached
+    pages sit in ``prev_table`` at their position-ordered rows, so the
+    ``< start`` visibility mask attends them exactly like
+    previously-computed chunks.  The engine buckets the suffix length to
+    the same power-of-two page counts as chunked prefill, keeping the
+    suffix-only path a fixed set of compiled shapes.
 
     ids: [C] chunk tokens (C fixed, multiple of page_size);
     chunk_rows: [C // ps] pages receiving this chunk's K/V;

@@ -17,7 +17,9 @@ scatter unconditional (no data-dependent control flow under jit).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -39,29 +41,210 @@ class KVBlockConfig:
 
 
 class BlockAllocator:
-    """Free-list page allocator (reference inference/v2/ragged
-    BlockedAllocator): O(1) alloc/free, host-side."""
+    """Ref-counted page allocator (reference inference/v2/ragged
+    BlockedAllocator, grown for automatic prefix caching): O(1)
+    alloc/share/free, host-side.
 
-    def __init__(self, num_pages: int):
+    Every live page carries a refcount: ``alloc`` hands out pages at
+    refcount 1, ``share`` maps an already-written page into another
+    sequence (+1), ``free`` drops a reference.  A page is *never* recycled
+    while referenced.  Pages may additionally be **registered** under a
+    content key (PrefixCache): when a registered page's refcount drops to
+    0 it is parked in an LRU of cached-but-unreferenced pages instead of
+    the raw free list, so later requests with the same prefix can re-map
+    it.  ``alloc`` prefers truly-free pages and only then evicts from the
+    LRU tail (unregistering the evicted key) — referenced pages are never
+    eviction candidates because they are never in the LRU.
+    """
+
+    def __init__(self, num_pages: int, cache_pages: int = 0):
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._ref: List[int] = [0] * num_pages
+        #: cap on cached-but-unreferenced pages retained (0 = pool-bounded)
+        self.cache_cap = cache_pages
+        self._by_key: Dict[Any, int] = {}   # content key -> page
+        self._key_of: Dict[int, Any] = {}   # page -> content key
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # oldest first
+        self.evictions = 0
+        #: bumped on every registry change (register/evict) so match
+        #: results can be memoized: a blocked head-of-queue request must
+        #: not re-hash its whole prompt every engine step when nothing
+        #: it could match against has changed
+        self.generation = 0
+        #: bumped only on unregister: registrations can only EXTEND an
+        #: existing match, so while this is unchanged a memoized match
+        #: prefix stays valid and the walk can RESUME from its end
+        #: instead of re-hashing the whole prompt
+        self.evict_generation = 0
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Allocatable pages: truly free + cached-but-unreferenced."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._by_key)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
 
     def alloc(self, n: int) -> List[int]:
-        if n > len(self._free):
+        if n > self.free_pages:
             raise MemoryError(f"KV pool exhausted: need {n} pages, "
-                              f"{len(self._free)} free")
-        out = [self._free.pop() for _ in range(n)]
+                              f"{self.free_pages} free")
+        out = []
+        for _ in range(n):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p = self._evict_lru()
+            self._ref[p] = 1
+            out.append(p)
         return out
 
+    def share(self, page: int) -> int:
+        """Map an already-written page into another sequence (+1 ref).
+        A cached page at refcount 0 leaves the LRU: it is live again."""
+        if not (0 <= page < self.num_pages):
+            raise ValueError(f"sharing invalid page {page}")
+        if self._ref[page] == 0:
+            if page not in self._lru:
+                raise ValueError(f"sharing unreferenced uncached page {page}")
+            del self._lru[page]
+        self._ref[page] += 1
+        return page
+
     def free(self, pages: List[int]) -> None:
+        # validate the WHOLE list before mutating (duplicate-aware): a
+        # bad page mid-list must not leave earlier refcounts decremented
+        counts: Dict[int, int] = {}
         for p in pages:
             if not (0 <= p < self.num_pages):
                 raise ValueError(f"freeing invalid page {p}")
-        self._free.extend(pages)
+            counts[p] = counts.get(p, 0) + 1
+        for p, c in counts.items():
+            if self._ref[p] < c:
+                raise ValueError(f"double free of page {p}")
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                if p in self._key_of:
+                    # registered content survives: park in the LRU (MRU
+                    # end) for prefix reuse instead of the free list
+                    self._lru[p] = None
+                    self._trim_cache()
+                else:
+                    self._free.append(p)
+
+    # -- prefix-cache registry ----------------------------------------------
+    def register(self, page: int, key: Any) -> bool:
+        """Publish ``page`` as the cached page for ``key``.  First writer
+        wins: duplicate keys (concurrent identical prefills) and pages
+        already registered under another key are skipped."""
+        if key in self._by_key or page in self._key_of:
+            return False
+        self._by_key[key] = page
+        self._key_of[page] = key
+        self.generation += 1
+        return True
+
+    def lookup(self, key: Any) -> Optional[int]:
+        return self._by_key.get(key)
+
+    def _unregister(self, page: int) -> None:
+        key = self._key_of.pop(page, None)
+        if key is not None and self._by_key.get(key) == page:
+            del self._by_key[key]
+            self.generation += 1
+            self.evict_generation += 1
+
+    def _evict_lru(self) -> int:
+        page, _ = self._lru.popitem(last=False)
+        self._unregister(page)
+        self.evictions += 1
+        return page
+
+    def _trim_cache(self) -> None:
+        if self.cache_cap > 0:
+            while len(self._lru) > self.cache_cap:
+                self._free.append(self._evict_lru())
+
+
+class PrefixCache:
+    """Automatic prefix caching: a content-hash chain over FULL pages.
+
+    Page ``j``'s key is ``hash((key[j-1], tokens[j*ps:(j+1)*ps]))`` — the
+    chain makes a page's identity depend on its entire token prefix, so a
+    lookup walk from the root finds the longest cached page-aligned
+    prefix.  Only full pages are hashed: partial tail pages stay private
+    to their sequence (the engine copy-on-writes the one case where a
+    shared full page must be written — see engine_v2._admit).  Counters
+    (``hits``/``misses`` here, ``evictions`` on the allocator) feed the
+    serving monitor and bench_serving.py.
+    """
+
+    def __init__(self, page_size: int, allocator: BlockAllocator):
+        self.page_size = page_size
+        self.allocator = allocator
+        self.hits = 0    # page lookups that matched (counted on admission)
+        self.misses = 0  # admission walks that ended on a missing page
+
+    @staticmethod
+    def chain_key(parent_key: Any, page_tokens: Sequence[int]) -> bytes:
+        """sha256 digest chain, NOT Python hash(): registry lookups go by
+        key equality alone, and a non-cryptographic 64-bit hash collision
+        (or an offline-constructed colliding token sequence from another
+        tenant) would silently map a request onto someone else's KV."""
+        h = hashlib.sha256()
+        if parent_key is not None:
+            h.update(parent_key)
+        h.update(",".join(str(int(t)) for t in page_tokens).encode())
+        return h.digest()
+
+    def page_keys(self, tokens: Sequence[int], n_pages: int,
+                  prefix_keys: Sequence[Any] = ()) -> List[Any]:
+        """Chain keys for full pages ``[len(prefix_keys), n_pages)``,
+        extending an already-computed prefix of keys."""
+        keys = list(prefix_keys)
+        ps = self.page_size
+        for j in range(len(keys), n_pages):
+            parent = keys[j - 1] if j else None
+            keys.append(self.chain_key(parent, tokens[j * ps:(j + 1) * ps]))
+        return keys
+
+    def match(self, tokens: Sequence[int],
+              resume: Optional[Tuple[List[int], List[Any]]] = None
+              ) -> Tuple[List[int], List[Any]]:
+        """Longest cached page-aligned prefix of ``tokens``: walks the
+        hash chain over full pages until a key misses.  Pure — the caller
+        bumps hits/misses only when an admission actually consumes the
+        match (a blocked head-of-queue peek must not inflate the rate).
+
+        ``resume``: a previous (pages, keys) match for the SAME tokens,
+        known still valid (allocator.evict_generation unchanged since) —
+        the walk continues from its end, so a blocked head of queue under
+        heavy registration traffic re-hashes only the frontier page."""
+        ps = self.page_size
+        pages: List[int] = list(resume[0]) if resume else []
+        keys: List[Any] = list(resume[1]) if resume else []
+        parent = keys[-1] if keys else None
+        for j in range(len(pages), len(tokens) // ps):
+            key = self.chain_key(parent, tokens[j * ps:(j + 1) * ps])
+            page = self.allocator.lookup(key)
+            if page is None:
+                break
+            pages.append(page)
+            keys.append(key)
+            parent = key
+        return pages, keys
+
+    def count(self, matched_pages: int, n_full_pages: int) -> None:
+        """Record a consumed match in the hit/miss counters."""
+        self.hits += matched_pages
+        if matched_pages < n_full_pages:
+            self.misses += 1
 
 
 class PagedKVCache:
@@ -102,9 +285,25 @@ class SequenceState:
     pages: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     admit_order: int = -1  # monotonic admission stamp (preemption policy)
-    #: tokens of the prefix already prefilled (chunked prefill); a
-    #: sequence decodes only once prefilled == length at chunk end
+    #: tokens of the prefix already prefilled (chunked prefill / cached
+    #: prefix pages mapped at admission); a sequence decodes only once
+    #: prefilled == length at chunk end
     prefilled: int = 0
+    #: prefix-cache bookkeeping: chain keys of full pages computed so far,
+    #: and how many leading pages have been offered to the registry
+    page_keys: List[Any] = dataclasses.field(default_factory=list)
+    registered_upto: int = 0
+    #: fully-cached prompt: every prompt page was mapped from the cache
+    #: (last one copy-on-write); the sequence enters through the decode
+    #: program, which recomputes only the final prompt token
+    decode_entry: bool = False
+    #: memoized prefix-cache match for a QUEUED sequence, valid while
+    #: the allocator's registry generation is unchanged; while only
+    #: REGISTRATIONS happened (evict generation unchanged) the match is
+    #: resumed from its end rather than recomputed
+    cached_match: Any = None
+    match_gen: int = -1
+    match_evict_gen: int = -1
 
     @property
     def length(self) -> int:

@@ -808,6 +808,31 @@ def test_bloom_parity(tmp_path):
     np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
 
 
+def test_bloom_untied_head_parity(tmp_path):
+    """tie_word_embeddings=false bloom: the separate lm_head.weight must be
+    imported, not silently replaced by the tied embedding (ADVICE r5 —
+    the hardcoded tied head produced wrong logits for untied variants)."""
+    import torch
+    from transformers import BloomConfig, BloomForCausalLM
+
+    hf_cfg = BloomConfig(vocab_size=90, hidden_size=32, n_layer=2,
+                         n_head=4, layer_norm_epsilon=1e-5,
+                         tie_word_embeddings=False)
+    torch.manual_seed(21)
+    m = BloomForCausalLM(hf_cfg).eval()
+    m.save_pretrained(tmp_path)
+
+    from deepspeed_tpu.checkpoint.hf_import import load_hf_model
+
+    cfg, params = load_hf_model(str(tmp_path), dtype=jnp.float32)
+    assert not cfg.tie_embeddings and "lm_head" in params
+    ids = np.random.RandomState(22).randint(0, 90, (2, 10)).astype(np.int32)
+    with torch.no_grad():
+        want = m(torch.tensor(ids.astype(np.int64))).logits.float().numpy()
+    got = _logits_ours(cfg, params, ids)
+    np.testing.assert_allclose(got, want, atol=5e-4, rtol=5e-3)
+
+
 def test_gpt_neox_parity(tmp_path):
     """GPT-NeoX: per-head fused QKV, partial rotary (rotary_pct), parallel
     residual with separate norms, untied embed_out."""

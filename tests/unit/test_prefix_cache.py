@@ -1,0 +1,263 @@
+"""Automatic prefix caching tests.
+
+Fast tier: allocator refcount/LRU/eviction invariants and the hash-chain
+match — pure host logic, no model.  Slow tier: engine-level oracles —
+cache-on generations must be BIT-IDENTICAL to cache-off for shared-prefix
+batches, copy-on-write isolates fully-cached prompts, and a preempted
+sequence's re-prefill hits the cache it populated.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (BlockAllocator, InferenceEngineV2,
+                                        PrefixCache, RaggedInferenceConfig,
+                                        RaggedRequest)
+
+
+# ----------------------------- fast: allocator/index invariants -------------
+def test_refcount_no_free_while_referenced():
+    a = BlockAllocator(4)
+    (p,) = a.alloc(1)
+    a.share(p)
+    assert a.refcount(p) == 2
+    a.free([p])  # one ref dropped: page must NOT return to the pool
+    assert a.refcount(p) == 1 and a.free_pages == 3
+    with pytest.raises(MemoryError):
+        a.alloc(4)
+    a.free([p])
+    assert a.free_pages == 4
+    with pytest.raises(ValueError):
+        a.free([p])  # double free
+    with pytest.raises(ValueError):
+        a.share(p)  # unreferenced + unregistered: nothing to share
+
+
+def test_lru_evicts_only_unreferenced_and_in_order():
+    a = BlockAllocator(4)
+    pc = PrefixCache(2, a)
+    pages = a.alloc(3)
+    keys = [pc.chain_key(None, [i, i]) for i in range(3)]
+    for p, k in zip(pages, keys):
+        a.register(p, k)
+    a.free([pages[1]])  # parked first -> LRU-oldest
+    a.free([pages[0]])
+    # pages[2] stays referenced: never an eviction candidate
+    assert a.free_pages == 3  # 1 raw free + 2 cached-unreferenced
+    got = a.alloc(3)  # raw free page, then LRU order: pages[1], pages[0]
+    assert a.evictions == 2
+    assert pages[1] in got and pages[0] in got and pages[2] not in got
+    assert a.lookup(keys[1]) is None and a.lookup(keys[0]) is None
+    assert a.lookup(keys[2]) == pages[2]  # referenced page still cached
+
+
+def test_share_revives_cached_page_from_lru():
+    a = BlockAllocator(2)
+    pc = PrefixCache(2, a)
+    (p,) = a.alloc(1)
+    a.register(p, pc.chain_key(None, [7, 7]))
+    a.free([p])
+    assert a.free_pages == 2  # cached page counts as allocatable
+    a.share(p)  # re-mapped by a new sequence: leaves the LRU
+    assert a.refcount(p) == 1 and a.free_pages == 1
+    a.alloc(1)
+    assert a.evictions == 0  # the revived page was not evicted
+
+
+def test_cache_cap_trims_unreferenced_cached_pages():
+    a = BlockAllocator(8, cache_pages=2)
+    pc = PrefixCache(2, a)
+    pages = a.alloc(4)
+    for i, p in enumerate(pages):
+        a.register(p, pc.chain_key(None, [i, i]))
+    a.free(pages)  # all unreferenced: LRU must trim to the 2 newest
+    assert a.evictions == 2 and a.cached_pages == 2
+    assert a.free_pages == 8
+
+
+def test_prefix_match_chain_and_counters():
+    """Hash-chain match walks full pages until divergence; hit/miss/
+    eviction counters are exposed and move as specified."""
+    a = BlockAllocator(8)
+    pc = PrefixCache(4, a)
+    tokens = list(range(12))  # 3 full pages
+    keys = pc.page_keys(tokens, 3)
+    pages = a.alloc(3)
+    for p, k in zip(pages, keys):
+        a.register(p, k)
+
+    got, gkeys = pc.match(tokens)
+    assert got == pages and gkeys == keys
+    # same first page, diverges in page 2
+    got2, _ = pc.match(tokens[:4] + [99] * 8)
+    assert got2 == pages[:1]
+    # divergence INSIDE page 1: chain root differs, nothing matches
+    got3, _ = pc.match([99] + tokens[1:])
+    assert got3 == []
+    # partial tail page never matches beyond the last full page
+    got4, _ = pc.match(tokens + [1, 2])
+    assert got4 == pages
+
+    assert (pc.hits, pc.misses) == (0, 0)  # match() is pure
+    pc.count(len(got), len(tokens) // 4)
+    pc.count(len(got2), 3)
+    assert (pc.hits, pc.misses) == (4, 1)
+    a.free(pages)
+    a.alloc(8)
+    assert a.evictions == 3
+
+
+def test_engine_exposes_cache_stats_via_monitor():
+    """publish_metrics surfaces serving/* counters through any
+    write_events sink (MonitorMaster-compatible)."""
+    from deepspeed_tpu.models.llama import llama_model
+
+    eng = InferenceEngineV2(
+        llama_model("tiny", max_seq_len=64),
+        RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=16,
+                              max_seqs=2, max_pages_per_seq=8,
+                              enable_prefix_cache=True))
+    events = []
+
+    class Sink:
+        def write_events(self, ev):
+            events.extend(ev)
+
+    eng.publish_metrics(Sink(), step=3)
+    tags = {t for t, _v, _s in events}
+    for want in ("serving/cache_hits", "serving/cache_misses",
+                 "serving/cache_evictions", "serving/prefix_hit_rate",
+                 "serving/prefill_admitted_tokens",
+                 "serving/prefill_computed_tokens"):
+        assert want in tags, (want, tags)
+    assert all(s == 3 for _t, _v, s in events)
+
+
+# ----------------------------- slow: engine oracles -------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=256)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **kw):
+    return InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=64, max_seqs=2,
+        max_pages_per_seq=10, **kw), params=params)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [{}, {"prefill_chunk": 16}])
+def test_shared_prefix_bit_exact_and_counted(tiny_model, extra):
+    """Shared-prefix batch: cache-on generations equal cache-off
+    token-for-token; hit/computed counters reflect the reuse."""
+    model, params = tiny_model
+    rng = np.random.RandomState(2)
+    prefix = list(rng.randint(0, model.config.vocab_size, 24))
+    prompts = [prefix + list(rng.randint(0, model.config.vocab_size, n))
+               for n in (13, 5, 28)]
+    reqs = lambda: [RaggedRequest(prompt_ids=p, max_new_tokens=6)  # noqa: E731
+                    for p in prompts]
+
+    want = _engine(model, params, **extra).generate_all(reqs())
+    eng = _engine(model, params, enable_prefix_cache=True, **extra)
+    got = eng.generate_all(reqs())
+    assert got == want, (got, want)
+    st = eng.cache_stats()
+    assert st["cache_hits"] > 0 and st["prefix_hit_tokens"] >= 24
+    assert st["prefill_computed_tokens"] < st["prefill_admitted_tokens"]
+    assert st["prefix_hit_rate"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [{}, {"prefill_chunk": 8}])
+def test_full_prompt_cached_copy_on_write(tiny_model, extra):
+    """A page-aligned prompt whose every page is cached enters through
+    the decode program with its last page COPY-ON-WRITTEN: the cached
+    page is never mutated, the sharer gets a private copy, and the
+    generation equals the cache-off run exactly — whole-prompt AND
+    chunked prefill (decode_entry must stay out of the pending list)."""
+    model, params = tiny_model
+    rng = np.random.RandomState(7)
+    prompt = list(rng.randint(0, model.config.vocab_size, 16))  # 2 pages
+
+    want = _engine(model, params, **extra).generate_all(
+        [RaggedRequest(prompt_ids=prompt, max_new_tokens=5)])
+    eng = _engine(model, params, enable_prefix_cache=True, **extra)
+    first = eng.generate_all([RaggedRequest(prompt_ids=prompt,
+                                            max_new_tokens=5)])
+    assert list(first.values())[0] == list(want.values())[0]
+
+    # second identical prompt: full hit -> decode-entry + CoW
+    keys = eng.prefix_cache.page_keys(prompt, 2)
+    src = eng.allocator.lookup(keys[1])
+    assert src is not None
+    eng.put(RaggedRequest(prompt_ids=prompt, max_new_tokens=5))
+    out = eng.step()  # admission + first decode step in one engine step
+    seq = next(s for s in eng._slots if s is not None)
+    assert seq.decode_entry
+    assert seq.pages[0] == eng.allocator.lookup(keys[0])  # shared directly
+    assert seq.pages[1] != src  # private CoW copy, shared page untouched
+    assert eng.allocator.lookup(keys[1]) == src
+    toks = list(out.values())[0]["tokens"]
+    while eng.has_work():
+        for _u, rec in eng.step().items():
+            toks.extend(rec["tokens"])
+    assert toks == list(want.values())[0]
+    st = eng.cache_stats()
+    assert st["prefix_hit_tokens"] >= 15  # length-1 of the second request
+
+
+@pytest.mark.slow
+def test_preempt_readmit_hits_cache(tiny_model):
+    """A preempted sequence's re-prefill must hit the pages it populated
+    before eviction — recompute becomes a table lookup."""
+    model, params = tiny_model
+    rng = np.random.RandomState(3)
+    prompt = list(rng.randint(0, model.config.vocab_size, 28))
+
+    eng = _engine(model, params, enable_prefix_cache=True)
+    uid = eng.put(RaggedRequest(prompt_ids=prompt, max_new_tokens=10))
+    got = []
+    for _ in range(3):
+        for u, rec in eng.step().items():
+            if u == uid:
+                got.extend(rec["tokens"])
+    seq = next(s for s in eng._slots if s is not None)
+    eng._preempt(seq)  # KV-pressure relief, mid-generation
+    eng.reset_cache_stats()
+    while eng.has_work():
+        for _u, rec in eng.step().items():
+            got.extend(rec["tokens"])
+    st = eng.cache_stats()
+    assert st["cache_hits"] >= 3, st  # 28-token prompt = 3 full pages
+    assert st["prefix_hit_tokens"] >= 24
+    want = _engine(model, params).generate_all(
+        [RaggedRequest(prompt_ids=prompt, max_new_tokens=10)])
+    assert got == list(want.values())[0]
+
+
+@pytest.mark.slow
+def test_cache_under_pool_pressure_stays_exact(tiny_model):
+    """Tight pool + caching: LRU eviction of unreferenced cached pages
+    keeps admission/growth alive and generations exact (referenced pages
+    are never stolen)."""
+    model, params = tiny_model
+    rng = np.random.RandomState(4)
+    prompts = [list(rng.randint(0, model.config.vocab_size, 28))
+               for _ in range(2)]
+    reqs = lambda: [RaggedRequest(prompt_ids=p, max_new_tokens=10)  # noqa: E731
+                    for p in prompts]
+
+    want = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=8, max_seqs=2,
+        max_pages_per_seq=8), params=params).generate_all(reqs())
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=8, max_seqs=2,
+        max_pages_per_seq=8, enable_prefix_cache=True), params=params)
+    got = eng.generate_all(reqs())
+    assert got == want, (got, want)
+    assert eng.allocator.free_pages == 8  # everything returned or parked

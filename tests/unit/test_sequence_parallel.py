@@ -32,6 +32,23 @@ def test_ulysses_matches_dense(causal, devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("nh", [6, 3])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_uneven_heads_matches_replicated(causal, nh, devices8):
+    """Uneven heads (nh % sp != 0) run the first-class padded head
+    scatter, not a replicated fallback: outputs must match the
+    replicated/dense path exactly for 6 and 3 heads on an 8-way
+    sequence group (pad heads are zeros and independent of real ones)."""
+    initialize_topology(MeshConfig(data=1, sequence=8), devices8)
+    q, k, v = _qkv(nh=nh)
+    ref = xla_attention(q, k, v, causal)  # the old replicated path
+    with deepspeed_tpu.get_topology().mesh:
+        out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, causal))(q, k, v)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=1e-4)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_matches_dense(causal, devices8):
     initialize_topology(MeshConfig(data=1, sequence=8), devices8)

@@ -1,0 +1,148 @@
+"""Shared-prefix serving bench: automatic prefix caching A/B.
+
+Realistic serving traffic shares prompt prefixes (system prompts,
+few-shot templates) across thousands of requests.  This bench measures
+what the prefix cache buys on exactly that shape: N requests sharing one
+P-token prefix with unique suffixes, run through InferenceEngineV2 twice
+— ``enable_prefix_cache=false`` then ``true`` — on the same weights, and
+checked token-for-token identical.
+
+Prints ONE JSON line: end-to-end tokens/s for both runs, prefill tokens
+admitted vs. computed (the FLOP story), cache hit/miss/eviction
+counters, and the computed-prefill reduction factor.  Knobs (env):
+    DSTPU_SBENCH_SIZE    model size (default 160m on TPU, tiny on CPU)
+    DSTPU_SBENCH_PREFIX  shared prefix tokens    (default 256)
+    DSTPU_SBENCH_SUFFIX  unique suffix tokens    (default 16)
+    DSTPU_SBENCH_GEN     new tokens per request  (default 64 TPU / 8 CPU)
+    DSTPU_SBENCH_NREQ    total requests          (default 32)
+    DSTPU_SBENCH_SLOTS   concurrent decode slots (default 8)
+    DSTPU_SBENCH_CHUNK   chunked-prefill tokens  (default 0 = whole)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bench import _backend_usable, _int_env as _int, _pin_cpu
+
+
+def main() -> None:
+    import jax
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceConfig,
+                                                      RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+
+    on_tpu = jax.default_backend() != "cpu"
+    size = os.environ.get("DSTPU_SBENCH_SIZE", "160m" if on_tpu else "tiny")
+    n_prefix = _int("DSTPU_SBENCH_PREFIX", 256)
+    n_suffix = _int("DSTPU_SBENCH_SUFFIX", 16)
+    gen = _int("DSTPU_SBENCH_GEN", 64 if on_tpu else 8)
+    nreq = _int("DSTPU_SBENCH_NREQ", 32)
+    slots = _int("DSTPU_SBENCH_SLOTS", 8)
+    chunk = _int("DSTPU_SBENCH_CHUNK", 0)
+
+    page = 16
+    seq_len = n_prefix + n_suffix + gen
+    pages_per_seq = -(-seq_len // page) + 1
+    model = llama_model(size, max_seq_len=seq_len + page)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prefix = rng.randint(1, vocab, n_prefix).tolist()
+    requests = [prefix + rng.randint(1, vocab, n_suffix).tolist()
+                for _ in range(nreq)]
+    # warmup workload: DIFFERENT shared prefix, same shapes — compiles the
+    # whole-prompt, suffix-chunk, and decode programs without seeding the
+    # measured cache state with the real prefix
+    warm_prefix = rng.randint(1, vocab, n_prefix).tolist()
+    warm = [warm_prefix + rng.randint(1, vocab, n_suffix).tolist()
+            for _ in range(2)]
+
+    def run(cache: bool):
+        eng = InferenceEngineV2(model, RaggedInferenceConfig(
+            page_size=page, max_pages_per_seq=pages_per_seq,
+            num_pages=pages_per_seq * slots + 2 * pages_per_seq,
+            max_seqs=slots, prefill_chunk=chunk,
+            enable_prefix_cache=cache), params=params)
+        # sequentially, so the second warm request HITS the warm prefix
+        # and compiles the suffix-only prefill program — batching them
+        # would admit both before either registered its pages
+        for p in warm:
+            eng.generate_all([RaggedRequest(prompt_ids=p, max_new_tokens=2)])
+        eng.reset_cache_stats()
+        t0 = time.perf_counter()
+        got = eng.generate_all([RaggedRequest(prompt_ids=p,
+                                              max_new_tokens=gen)
+                                for p in requests])
+        dt = time.perf_counter() - t0
+        toks = [got[u] for u in sorted(got)]
+        assert sum(len(t) for t in toks) == nreq * gen
+        return toks, dt, eng.cache_stats()
+
+    toks_off, dt_off, st_off = run(False)
+    toks_on, dt_on, st_on = run(True)
+    identical = toks_off == toks_on
+    mismatched = sum(1 for a, b in zip(toks_off, toks_on) if a != b)
+
+    out_tokens = nreq * gen
+    reduction = (st_off["prefill_computed_tokens"]
+                 / max(st_on["prefill_computed_tokens"], 1))
+    dev = jax.devices()[0]
+    result = {
+        "metric": f"llama-{size} shared-prefix serving tok/s with prefix "
+                  f"cache (prefix={n_prefix}, suffix={n_suffix}, gen={gen}, "
+                  f"nreq={nreq}, slots={slots}, chunk={chunk})",
+        "value": round(out_tokens / dt_on, 1),
+        "unit": "tokens/s",
+        "tokens_per_s": {"cache_off": round(out_tokens / dt_off, 1),
+                         "cache_on": round(out_tokens / dt_on, 1)},
+        "speedup": round(dt_off / dt_on, 2),
+        "prefill_tokens": {
+            "admitted": int(st_on["prefill_admitted_tokens"]),
+            "computed_cache_off": int(st_off["prefill_computed_tokens"]),
+            "computed_cache_on": int(st_on["prefill_computed_tokens"])},
+        "prefill_reduction": round(reduction, 2),
+        "prefix_hit_rate": round(st_on["prefix_hit_rate"], 3),
+        "cache": {"hits": int(st_on["cache_hits"]),
+                  "misses": int(st_on["cache_misses"]),
+                  "evictions": int(st_on["cache_evictions"])},
+        "identical_generations": identical,
+        "mismatched_requests": mismatched,
+        "backend": jax.default_backend(),
+        "device_kind": str(getattr(dev, "device_kind", "unknown")),
+    }
+    reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
+    if reason and jax.default_backend() == "cpu":
+        result["fallback_reason"] = reason
+    print(json.dumps(result))
+    # hard identity gate on CPU only: XLA-CPU is deterministic across the
+    # two paths, while kernel backends may flip a near-tie greedy pick at
+    # ULP level (docs/SERVING.md) — there the mismatch COUNT is the signal
+    if not identical and jax.default_backend() == "cpu":
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    # same wedged-chip discipline as bench.py: probe the backend in a
+    # subprocess (a hung TPU lease hangs backend init uninterruptibly
+    # in-process) and fall back to a self-describing CPU run
+    if "--cpu" in sys.argv:
+        _pin_cpu()
+    else:
+        usable, reason, _backend = _backend_usable()
+        if not usable:
+            os.environ["DSTPU_BENCH_FALLBACK_REASON"] = reason
+            _pin_cpu()
+        elif _backend == "cpu":
+            _pin_cpu()
+    main()
