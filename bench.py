@@ -123,22 +123,13 @@ def _backend_usable() -> tuple:
           file=sys.stderr)
     return False, reason, ""
 
-PEAK_BF16_FLOPS = {
-    # per-chip peak bf16 FLOP/s
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
-    "cpu": 1e12,  # nominal, so CPU runs still report something
-}
-
-
 def _peak_for(device) -> float:
-    kind = getattr(device, "device_kind", "cpu")
-    for name, peak in PEAK_BF16_FLOPS.items():
-        if name.lower() in str(kind).lower():
-            return peak
-    return PEAK_BF16_FLOPS["cpu"]
+    # canonical per-generation table lives in telemetry/mfu.py (one copy,
+    # shared with the engine's MFU gauge and tools/tune_mfu.py); imported
+    # lazily so --cpu pinning happens before any jax-touching import
+    from deepspeed_tpu.telemetry.mfu import peak_flops_for_device
+
+    return peak_flops_for_device(device)
 
 
 

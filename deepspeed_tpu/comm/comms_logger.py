@@ -4,16 +4,38 @@ Analogue of the reference ``CommsLogger`` (``deepspeed/utils/comms_logging.py``)
 fed by the ``timed_op`` decorator (comm/comm.py:102).  On TPU, collectives are
 compiled into the XLA program, so per-call wall time is not observable from
 Python — instead we record *trace-time* occurrences and message sizes (what
-the program will execute each step) and estimated bus bandwidth is left to the
-profiler.  ``log_summary`` prints per-op totals like the reference.
+the program will execute each step).  ``log_summary`` prints per-op totals
+like the reference, and — given axis sizes — estimated *bus* traffic using
+the standard algorithmic factors (the reference's ``get_bw``,
+comms_logging.py: ring all_reduce moves ``2(n-1)/n`` bytes per payload byte
+over the wire, all_gather/reduce_scatter/all_to_all ``(n-1)/n``); with an
+elapsed wall time that becomes an estimated algorithmic bus bandwidth.
+``publish`` re-homes the per-op totals onto the telemetry registry.
 """
 
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Union
 
 from ..utils.logging import logger
+
+#: bytes-on-wire per payload byte for ring algorithms on an n-rank axis
+#: (n is substituted at summary time); ops not listed move ~1x
+_BUS_FACTORS = {
+    "all_reduce": lambda n: 2.0 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "broadcast": lambda n: (n - 1) / n,
+}
+
+
+def bus_factor(op_name: str, n: int) -> float:
+    """Algorithmic bus factor for ``op_name`` over an ``n``-rank axis."""
+    if n <= 1:
+        return 0.0
+    return _BUS_FACTORS.get(op_name, lambda _n: 1.0)(n)
 
 
 class CommsLogger:
@@ -52,18 +74,90 @@ class CommsLogger:
         if self.verbose:
             logger.info(f"comm: {op_name} axis={axis} bytes={msg_size_bytes}")
 
-    def log_summary(self) -> str:
-        lines = ["Comms summary (trace-time):",
-                 f"{'op':<20}{'axis':<28}{'count':>8}{'total MB':>12}"]
+    def _axis_n(self, axis: str,
+                axis_sizes: Optional[Union[int, Dict[str, int]]]) -> int:
+        if axis_sizes is None:
+            return 0
+        if isinstance(axis_sizes, int):
+            return axis_sizes
+        n = axis_sizes.get(axis)
+        if n is None:
+            # a multi-axis collective logs axis as "('data', 'repl')":
+            # the effective rank count is the product of the named axes
+            n = 1
+            for name, size in axis_sizes.items():
+                if name and f"'{name}'" in axis:
+                    n *= size
+            if n == 1 and axis in axis_sizes:
+                n = axis_sizes[axis]
+        return int(n or 0)
+
+    def log_summary(self,
+                    axis_sizes: Optional[Union[int, Dict[str, int]]] = None,
+                    elapsed_s: Optional[float] = None) -> str:
+        """Per-op totals.  ``axis_sizes`` (axis name -> rank count, or one
+        int for all axes) adds the estimated bus traffic column using the
+        algorithmic factors; ``elapsed_s`` (wall time the totals
+        accumulated over) additionally prints estimated algorithmic bus
+        bandwidth — the number to compare against ICI/DCN line rate."""
+        hdr = f"{'op':<20}{'axis':<28}{'count':>8}{'total MB':>12}"
+        if axis_sizes is not None:
+            hdr += f"{'bus MB':>12}"
+            if elapsed_s:
+                hdr += f"{'busbw GB/s':>12}"
+        lines = ["Comms summary (trace-time):", hdr]
         for op, axes in sorted(self.comms_dict.items()):
             for axis, (count, nbytes) in sorted(axes.items()):
-                lines.append(f"{op:<20}{axis:<28}{count:>8}{nbytes / 1e6:>12.2f}")
+                row = f"{op:<20}{axis:<28}{count:>8}{nbytes / 1e6:>12.2f}"
+                if axis_sizes is not None:
+                    n = self._axis_n(axis, axis_sizes)
+                    bus = nbytes * bus_factor(op, n)
+                    row += f"{bus / 1e6:>12.2f}"
+                    if elapsed_s:
+                        row += f"{bus / elapsed_s / 1e9:>12.2f}"
+                lines.append(row)
         out = "\n".join(lines)
         logger.info(out)
         return out
 
+    def publish(self, registry=None,
+                axis_sizes: Optional[Union[int, Dict[str, int]]] = None) -> None:
+        """Re-home the per-op totals onto the telemetry registry
+        (counters are cumulative: only the delta since the last publish
+        is added, so repeated publishes of the same comms_dict don't
+        double-count)."""
+        from ..telemetry import get_registry
+
+        reg = registry or get_registry()
+        ops = reg.counter("deepspeed_tpu_comm_ops_total",
+                          "trace-time collective op count",
+                          labelnames=("op", "axis"))
+        byts = reg.counter("deepspeed_tpu_comm_bytes_total",
+                           "trace-time collective payload bytes",
+                           labelnames=("op", "axis"))
+        bus = reg.counter("deepspeed_tpu_comm_bus_bytes_total",
+                          "estimated bytes on the wire (algorithmic factor)",
+                          labelnames=("op", "axis"))
+        published = getattr(self, "_published", None)
+        if published is None:
+            published = self._published = {}
+        for op, axes in self.comms_dict.items():
+            for axis, (count, nbytes) in axes.items():
+                pc, pb = published.get((op, axis), (0, 0))
+                if count > pc:
+                    ops.inc(count - pc, op=op, axis=axis)
+                if nbytes > pb:
+                    byts.inc(nbytes - pb, op=op, axis=axis)
+                    n = self._axis_n(axis, axis_sizes)
+                    if n > 1:
+                        bus.inc((nbytes - pb) * bus_factor(op, n),
+                                op=op, axis=axis)
+                published[(op, axis)] = (count, nbytes)
+
     def reset(self) -> None:
         self.comms_dict.clear()
+        if getattr(self, "_published", None):
+            self._published.clear()
 
 
 _COMMS_LOGGER: Optional[CommsLogger] = None

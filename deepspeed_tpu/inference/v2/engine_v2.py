@@ -29,6 +29,8 @@ import numpy as np
 from ...models.transformer import TransformerConfig
 from ...runtime.config_utils import ConfigModel
 from ...runtime.precision import cast_tree
+from ...telemetry import get_registry
+from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
 from .model_runner import (paged_copy_page, paged_decode, paged_prefill,
                            paged_prefill_chunk)
@@ -159,6 +161,7 @@ class InferenceEngineV2:
         self._stats = {"prefill_admitted_tokens": 0,
                        "prefill_computed_tokens": 0,
                        "prefix_hit_tokens": 0}
+        self._init_serving_metrics()
         self._uid = itertools.count()
         self._admit_counter = itertools.count()
         self._rng = np.random.RandomState(seed)
@@ -195,6 +198,83 @@ class InferenceEngineV2:
         self._sample_key = jax.random.PRNGKey(seed)
         self._decode_steps = 0
 
+    # -- telemetry -----------------------------------------------------------
+    def _init_serving_metrics(self) -> None:
+        """Register the serving metric family on the process telemetry
+        registry (get-or-create: several engines in one process share
+        the cumulative series; ``cache_stats`` keeps the per-engine view
+        via ``self._stats`` and the allocator/prefix-cache counters)."""
+        reg = get_registry()
+        self._m_queue = reg.gauge(
+            "deepspeed_tpu_serving_queue_depth",
+            "requests waiting for admission")
+        self._m_occupancy = reg.gauge(
+            "deepspeed_tpu_serving_batch_occupancy",
+            "occupied decode slots / max_seqs")
+        self._m_prefill_h = reg.histogram(
+            "deepspeed_tpu_serving_prefill_seconds",
+            "per-sequence prefill program wall time (one chunk or whole "
+            "prompt, incl. the prefix-end sample)")
+        self._m_decode_h = reg.histogram(
+            "deepspeed_tpu_serving_decode_seconds",
+            "one batched decode step wall time (dispatch + token fetch)")
+        self._m_requests = reg.counter(
+            "deepspeed_tpu_serving_requests_total", "requests enqueued")
+        self._m_gen_tokens = reg.counter(
+            "deepspeed_tpu_serving_tokens_generated_total",
+            "tokens produced by the decode program")
+        self._m_admitted = reg.counter(
+            "deepspeed_tpu_serving_prefill_admitted_tokens_total",
+            "prompt tokens admitted for prefill")
+        self._m_computed = reg.counter(
+            "deepspeed_tpu_serving_prefill_computed_tokens_total",
+            "prompt tokens actually computed (admitted minus prefix hits)")
+        self._m_hit_tokens = reg.counter(
+            "deepspeed_tpu_serving_prefix_hit_tokens_total",
+            "prompt tokens served from the prefix cache")
+        self._m_cache_hits = reg.counter(
+            "deepspeed_tpu_serving_prefix_cache_hits_total",
+            "prefix-cache page lookups that matched")
+        self._m_cache_misses = reg.counter(
+            "deepspeed_tpu_serving_prefix_cache_misses_total",
+            "admission walks ending on a missing page")
+        self._m_cache_evict = reg.counter(
+            "deepspeed_tpu_serving_prefix_cache_evictions_total",
+            "cached pages evicted (LRU or cap trim)")
+        self._m_cached_pages = reg.gauge(
+            "deepspeed_tpu_serving_prefix_cached_pages",
+            "pages currently parked in the prefix cache")
+        self._m_preemptions = reg.counter(
+            "deepspeed_tpu_serving_preemptions_total",
+            "sequences evicted to the queue under KV-pool pressure")
+        # last-published absolutes for the per-engine cache counters, so
+        # the process-cumulative registry counters only receive deltas
+        self._cache_pub = {"hits": 0, "misses": 0, "evictions": 0}
+
+    def _phase(self, name: str, hist) -> PhaseTimer:
+        """Profiler annotation + wall-time histogram for one serving
+        phase (prefill/decode)."""
+        return PhaseTimer(name, sink=lambda _n, dt: hist.observe(dt))
+
+    def _sync_cache_counters(self) -> None:
+        """Forward allocator/prefix-cache counter deltas to the registry
+        (those objects stay the per-engine source of truth; re-homing
+        them wholesale would break per-engine ``cache_stats``)."""
+        pub = self._cache_pub
+        ev = self.allocator.evictions
+        if ev > pub["evictions"]:
+            self._m_cache_evict.inc(ev - pub["evictions"])
+            pub["evictions"] = ev
+        if self.prefix_cache is not None:
+            h, m = self.prefix_cache.hits, self.prefix_cache.misses
+            if h > pub["hits"]:
+                self._m_cache_hits.inc(h - pub["hits"])
+                pub["hits"] = h
+            if m > pub["misses"]:
+                self._m_cache_misses.inc(m - pub["misses"])
+                pub["misses"] = m
+        self._m_cached_pages.set(self.allocator.cached_pages)
+
     # -- request API ---------------------------------------------------------
     def put(self, request: RaggedRequest) -> int:
         """Queue a request; returns its uid."""
@@ -209,6 +289,8 @@ class InferenceEngineV2:
             uid=uid, tokens=list(request.prompt_ids), prompt_len=n,
             max_new_tokens=request.max_new_tokens,
             temperature=request.temperature, eos_id=request.eos_id))
+        self._m_requests.inc()
+        self._m_queue.set(len(self._queue))
         return uid
 
     def has_work(self) -> bool:
@@ -241,6 +323,7 @@ class InferenceEngineV2:
         seq.page_keys, seq.registered_upto, seq.decode_entry = [], 0, False
         seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
         self._queue.insert(0, seq)
+        self._m_preemptions.inc()
 
     def _admit(self) -> List[SequenceState]:
         admitted = []
@@ -309,6 +392,9 @@ class InferenceEngineV2:
             self._stats["prefill_admitted_tokens"] += seq.length
             self._stats["prefix_hit_tokens"] += seq.prefilled
             self._stats["prefill_computed_tokens"] += seq.length - seq.prefilled
+            self._m_admitted.inc(seq.length)
+            self._m_hit_tokens.inc(seq.prefilled)
+            self._m_computed.inc(seq.length - seq.prefilled)
             seq.slot = i
             seq.admit_order = next(self._admit_counter)
             self._page_table[i, :] = self.block.trash_page
@@ -417,6 +503,10 @@ class InferenceEngineV2:
         ps = self.block.page_size
 
         admitted = self._admit()
+        self._m_queue.set(len(self._queue))
+        self._m_occupancy.set(
+            sum(1 for s in self._slots if s is not None)
+            / max(1, self.block.max_seqs))
         if self._chunk:
             # Dynamic-SplitFuse-style chunked prefill: ONE chunk per
             # pending-prefill sequence per step; decode for ready
@@ -429,9 +519,11 @@ class InferenceEngineV2:
             for seq in pending:
                 start = seq.prefilled  # page-aligned: chunk % ps == 0
                 c_n = min(self._chunk, seq.length - start)
-                logits = self._run_prefill_chunk(seq, start, c_n, self._chunk)
-                if seq.prefilled >= seq.length:
-                    self._emit_sampled(seq, logits, out)
+                with self._phase("prefill", self._m_prefill_h):
+                    logits = self._run_prefill_chunk(seq, start, c_n,
+                                                     self._chunk)
+                    if seq.prefilled >= seq.length:
+                        self._emit_sampled(seq, logits, out)
         else:
             for seq in admitted:
                 if seq.decode_entry:
@@ -441,9 +533,10 @@ class InferenceEngineV2:
                     # start-offset program, bucketed like whole prompts
                     # so the shape set stays fixed
                     n_suf = seq.length - seq.prefilled
-                    logits = self._run_prefill_chunk(
-                        seq, seq.prefilled, n_suf, self._bucket(n_suf))
-                    self._emit_sampled(seq, logits, out)
+                    with self._phase("prefill", self._m_prefill_h):
+                        logits = self._run_prefill_chunk(
+                            seq, seq.prefilled, n_suf, self._bucket(n_suf))
+                        self._emit_sampled(seq, logits, out)
                     continue
                 # seq.length, not prompt_len: a preempted sequence
                 # re-prefills its whole prefix (prompt + tokens generated
@@ -455,12 +548,13 @@ class InferenceEngineV2:
                 rows = np.full((bucket // ps,), self.block.trash_page,
                                np.int32)
                 rows[:len(seq.pages)] = seq.pages
-                logits, self._pools = self._prefill(
-                    self.params, self._pools,
-                    jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
-                seq.prefilled = n
-                self._register_pages(seq)
-                self._emit_sampled(seq, logits, out)
+                with self._phase("prefill", self._m_prefill_h):
+                    logits, self._pools = self._prefill(
+                        self.params, self._pools,
+                        jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
+                    seq.prefilled = n
+                    self._register_pages(seq)
+                    self._emit_sampled(seq, logits, out)
 
         active = [s for s in self._slots
                   if s is not None and self._ready_to_decode(s)]
@@ -508,13 +602,15 @@ class InferenceEngineV2:
             temps[seq.slot] = max(seq.temperature, 0.0)
 
         self._decode_steps += 1
-        tokens, self._pools = self._decode(
-            self.params, self._pools,
-            jnp.asarray(last), jnp.asarray(pos),
-            jnp.asarray(self._page_table), jnp.asarray(act),
-            jnp.asarray(temps), self._sample_key,
-            jnp.asarray(self._decode_steps, jnp.uint32))
-        tokens = np.asarray(tokens)
+        with self._phase("decode", self._m_decode_h):
+            tokens, self._pools = self._decode(
+                self.params, self._pools,
+                jnp.asarray(last), jnp.asarray(pos),
+                jnp.asarray(self._page_table), jnp.asarray(act),
+                jnp.asarray(temps), self._sample_key,
+                jnp.asarray(self._decode_steps, jnp.uint32))
+            tokens = np.asarray(tokens)
+        self._m_gen_tokens.inc(len(active))
 
         for seq in active:
             tok = int(tokens[seq.slot])
@@ -530,6 +626,7 @@ class InferenceEngineV2:
             rec["tokens"].append(tok)
             self._maybe_finish(seq, tok)
             rec["done"] = seq.done
+        self._sync_cache_counters()
         return out
 
     # -- serving metrics -----------------------------------------------------
@@ -537,6 +634,7 @@ class InferenceEngineV2:
         """Prefix-cache and prefill-work counters (cumulative).  Valid —
         all zeros for the cache-specific entries — with caching off, so
         dashboards need no conditional wiring."""
+        self._sync_cache_counters()
         s: Dict[str, float] = dict(self._stats)
         s["cache_hits"] = self.prefix_cache.hits if self.prefix_cache else 0
         s["cache_misses"] = (self.prefix_cache.misses
@@ -549,11 +647,14 @@ class InferenceEngineV2:
 
     def reset_cache_stats(self) -> None:
         """Zero the counters (cache CONTENTS are kept) — benches call this
-        after warmup so compile-wave admissions don't pollute the rates."""
+        after warmup so compile-wave admissions don't pollute the rates.
+        The registry counters stay cumulative (Prometheus counters never
+        go backwards); only the delta baseline resets with the sources."""
         self._stats = {k: 0 for k in self._stats}
         self.allocator.evictions = 0
         if self.prefix_cache is not None:
             self.prefix_cache.hits = self.prefix_cache.misses = 0
+        self._cache_pub = {"hits": 0, "misses": 0, "evictions": 0}
 
     def publish_metrics(self, monitor, step: int) -> None:
         """Surface the serving counters through a monitor/* writer

@@ -23,22 +23,54 @@ class Monitor:
     def write_events(self, events: List[Event]) -> None:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release writer resources (file handles, background threads)."""
+
 
 class CSVMonitor(Monitor):
+    """One CSV per tag.  File handles are opened once per tag and kept —
+    a per-event open/close costs a syscall storm at high tag cardinality
+    (the registry fan-out emits dozens of tags per step).  Each
+    ``write_events`` batch ends with an explicit flush of the touched
+    handles so readers (tests, tail -f dashboards) see complete rows."""
+
     def __init__(self, output_path: str, job_name: str):
         self.dir = os.path.join(output_path or "./csv_monitor", job_name)
         os.makedirs(self.dir, exist_ok=True)
-        self._files = {}
+        self._files = {}    # tag -> open file handle
+        self._writers = {}  # tag -> csv.writer over that handle
+
+    def _writer(self, tag: str):
+        w = self._writers.get(tag)
+        if w is None:
+            safe = tag.replace("/", "_").replace("=", "-")
+            fname = os.path.join(self.dir, safe + ".csv")
+            # header exactly once: only when the file is created empty
+            # (appending to a previous run's file must not re-header)
+            new = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            f = open(fname, "a", newline="")
+            self._files[tag] = f
+            w = self._writers[tag] = csv.writer(f)
+            if new:
+                w.writerow(["step", tag])
+        return w
 
     def write_events(self, events: List[Event]) -> None:
+        touched = set()
         for tag, value, step in events:
-            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerow([step, value])
+            self._writer(tag).writerow([step, value])
+            touched.add(tag)
+        for tag in touched:
+            self._files[tag].flush()
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files.clear()
+        self._writers.clear()
 
 
 class TensorBoardMonitor(Monitor):
@@ -52,6 +84,9 @@ class TensorBoardMonitor(Monitor):
             self.writer.add_scalar(tag, value, step)
         self.writer.flush()
 
+    def close(self) -> None:
+        self.writer.close()
+
 
 class WandbMonitor(Monitor):
     def __init__(self, project: str, group, team):
@@ -63,6 +98,9 @@ class WandbMonitor(Monitor):
     def write_events(self, events: List[Event]) -> None:
         for tag, value, step in events:
             self._wandb.log({tag: value}, step=step)
+
+    def close(self) -> None:
+        self._wandb.finish()
 
 
 class CometMonitor(Monitor):
@@ -135,3 +173,23 @@ class MonitorMaster(Monitor):
     def write_events(self, events: List[Event]) -> None:
         for m in self.monitors:
             m.write_events(events)
+
+    def write_registry(self, registry, step: int) -> None:
+        """Fan a telemetry ``MetricsRegistry`` snapshot out through every
+        writer: counters/gauges as scalar tags, histograms as
+        p50/p95/p99/count/sum sub-tags (see registry.snapshot_events)."""
+        if not self.monitors:
+            return
+        events = registry.snapshot_events(step)
+        if events:
+            self.write_events(events)
+
+    def close(self) -> None:
+        """Close every writer (flush + release handles).  Safe to call
+        more than once; a writer that fails to close must not block the
+        rest."""
+        for m in self.monitors:
+            try:
+                m.close()
+            except Exception as e:
+                logger.warning(f"monitor close failed: {e}")

@@ -205,6 +205,53 @@ class FlopsProfilerConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class StallWatchdogConfig(ConfigModel):
+    """stall_watchdog sub-block of ``telemetry``: flag steps exceeding
+    ``multiple`` x the rolling median over the last ``window`` steps."""
+
+    enabled: bool = True
+    multiple: float = 3.0
+    window: int = 32
+
+    def validate(self) -> None:
+        if self.multiple <= 1.0:
+            raise ValueError(f"stall_watchdog.multiple must be > 1, "
+                             f"got {self.multiple}")
+        if self.window < 2:
+            raise ValueError("stall_watchdog.window must be >= 2")
+
+
+@dataclasses.dataclass
+class TelemetryConfig(ConfigModel):
+    """``telemetry`` block: the unified metrics registry + export paths
+    (see deepspeed_tpu/telemetry/ and docs/OBSERVABILITY.md).
+
+    ``enabled`` turns on registry collection in the engines; each export
+    sink is then individually opt-in: ``prometheus_path`` rewrites a
+    Prometheus textfile every ``export_interval`` steps,
+    ``prometheus_port`` serves /metrics over HTTP (0 = off),
+    ``jsonl_path`` appends snapshot events to a JSON-lines log.
+    ``trace_annotations`` wraps steps in ``jax.profiler`` step/phase
+    annotations (no-op without a live profiler capture)."""
+
+    enabled: bool = False
+    prometheus_path: str = ""
+    prometheus_port: int = 0
+    jsonl_path: str = ""
+    export_interval: int = 10
+    trace_annotations: bool = True
+    stall_watchdog: StallWatchdogConfig = dataclasses.field(
+        default_factory=StallWatchdogConfig)
+
+    def validate(self) -> None:
+        if self.export_interval < 1:
+            raise ValueError("telemetry.export_interval must be >= 1")
+        if not (0 <= self.prometheus_port < 65536):
+            raise ValueError(f"telemetry.prometheus_port out of range: "
+                             f"{self.prometheus_port}")
+
+
+@dataclasses.dataclass
 class CommsLoggerConfig(ConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -328,6 +375,7 @@ class DeepSpeedConfig:
     activation_checkpointing: ActivationCheckpointingConfig
     flops_profiler: FlopsProfilerConfig
     comms_logger: CommsLoggerConfig
+    telemetry: TelemetryConfig
     tensorboard: TensorBoardConfig
     wandb: WandbConfig
     comet: CometConfig
@@ -379,6 +427,7 @@ class DeepSpeedConfig:
             g("activation_checkpointing"))
         self.flops_profiler = FlopsProfilerConfig.from_dict(g("flops_profiler"))
         self.comms_logger = CommsLoggerConfig.from_dict(g("comms_logger"))
+        self.telemetry = TelemetryConfig.from_dict(g("telemetry"))
         self.tensorboard = TensorBoardConfig.from_dict(g("tensorboard"))
         self.wandb = WandbConfig.from_dict(g("wandb"))
         self.comet = CometConfig.from_dict(g("comet"))

@@ -24,6 +24,7 @@ one program) and is what benchmarks should use.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Iterator, Optional, Tuple
 
@@ -117,7 +118,16 @@ class DeepSpeedTPUEngine:
         self.lr_scheduler = LRSchedulerShim(self.lr_schedule)
 
         # observability
-        self.timers = SynchronizedWallClockTimer()
+        self.telemetry = None
+        if config.telemetry.enabled:
+            from ..telemetry import Telemetry
+
+            self.telemetry = Telemetry(config.telemetry, loop="train")
+            self._init_train_metrics()
+        # timer sink: every phase timer stop() lands in the phase
+        # histogram, making the registry the single sink for step metrics
+        self.timers = SynchronizedWallClockTimer(
+            sink=(self._observe_phase if self.telemetry is not None else None))
         self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size or 1,
                                           steps_per_output=config.steps_per_print)
         self.monitor = None
@@ -975,22 +985,32 @@ class DeepSpeedTPUEngine:
             gas_ = self.config.gradient_accumulation_steps or 1
             self.micro_steps -= self.micro_steps % gas_
             self._acc_dirty = False
-        with self.topology.mesh:
-            self.state, loss = self._train_batch(self.state, batch, self._next_rng())
-        self._repin_opt_state()
-        if self.offload_optimizer is not None:
-            self._apply_step_offload()
-        self.global_steps += 1
-        self.micro_steps += self.config.gradient_accumulation_steps or 1
-        self._sanity_check_maybe(loss, skipped_before)
-        # dispatch is async: drain the device queue at reporting boundaries so
-        # the throughput window [boundary, boundary] measures real wall time
-        if self.global_steps % self.config.steps_per_print == 0 or \
-                self.config.wall_clock_breakdown:
-            jax.block_until_ready(loss)
+        from ..telemetry.tracing import _noop as _no_trace
+
+        t0 = time.perf_counter()
+        trace = (self.telemetry.step_trace(self.global_steps)
+                 if self.telemetry is not None else _no_trace())
+        with trace:
+            with self.topology.mesh:
+                self.state, loss = self._train_batch(self.state, batch,
+                                                     self._next_rng())
+            self._repin_opt_state()
+            if self.offload_optimizer is not None:
+                self._apply_step_offload()
+            self.global_steps += 1
+            self.micro_steps += self.config.gradient_accumulation_steps or 1
+            self._sanity_check_maybe(loss, skipped_before)
+            # dispatch is async: drain the device queue at reporting
+            # boundaries so the throughput window [boundary, boundary]
+            # measures real wall time
+            if self.global_steps % self.config.steps_per_print == 0 or \
+                    self.config.wall_clock_breakdown:
+                jax.block_until_ready(loss)
         self.tput_timer.stop()
         if self.flops_profiler is not None:
             self.flops_profiler.stop_profile_maybe(self.global_steps)
+        if self.telemetry is not None:
+            self._report_telemetry(loss, batch, time.perf_counter() - t0)
         self._report(loss)
         return loss
 
@@ -1041,6 +1061,8 @@ class DeepSpeedTPUEngine:
             self.lr_scheduler.step()
             if self.config.wall_clock_breakdown:
                 jax.block_until_ready(self.state.step)
+            if self.telemetry is not None:
+                self._report_telemetry(self._cached_loss, None)
             self._report(self._cached_loss)
         self.timers(STEP_GLOBAL_TIMER).stop()
         if self.flops_profiler is not None:
@@ -1076,6 +1098,151 @@ class DeepSpeedTPUEngine:
         return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *micro_batches)
 
     # ---------------------------------------------------------- observability
+    def _init_train_metrics(self) -> None:
+        """Register the training metric family on the telemetry registry
+        (get-or-create: many engines per process share the series)."""
+        reg = self.telemetry.registry
+        self._m_phase = reg.histogram(
+            "deepspeed_tpu_train_phase_seconds",
+            "host wall time per training phase (fwd/bwd/step/train_batch)",
+            labelnames=("phase",))
+        self._m_loss = reg.gauge("deepspeed_tpu_train_loss",
+                                 "last reported training loss")
+        self._m_lr = reg.gauge("deepspeed_tpu_train_lr",
+                               "current learning rate")
+        self._m_grad_norm = reg.gauge("deepspeed_tpu_train_grad_norm",
+                                      "global gradient norm at the last boundary")
+        self._m_loss_scale = reg.gauge("deepspeed_tpu_train_loss_scale",
+                                       "fp16 dynamic loss scale (1 when off)")
+        self._m_samples_ps = reg.gauge(
+            "deepspeed_tpu_train_samples_per_second",
+            "throughput over the last reporting window")
+        self._m_tokens_ps = reg.gauge(
+            "deepspeed_tpu_train_tokens_per_second",
+            "token throughput over the last reporting window "
+            "(0 when the batch carries no [B, T] integer ids)")
+        self._m_mfu = reg.gauge(
+            "deepspeed_tpu_train_mfu",
+            "model FLOPs utilization vs per-generation peak "
+            "(telemetry/mfu.py table)")
+        self._m_steps = reg.counter("deepspeed_tpu_train_steps_total",
+                                    "optimizer steps taken")
+        self._m_skipped = reg.counter(
+            "deepspeed_tpu_train_skipped_steps_total",
+            "fp16 overflow steps skipped by the loss scaler")
+        self._win_time = 0.0
+        self._win_steps = 0
+        self._win_tokens = 0
+        self._skipped_pub = 0
+        self._flops_per_step: Optional[float] = None
+
+    def _observe_phase(self, name: str, dt: float) -> None:
+        self._m_phase.observe(dt, phase=name)
+
+    @staticmethod
+    def _batch_tokens(batch) -> int:
+        """Token count of one (possibly gas-stacked) batch: the size of
+        the first integer leaf of rank >= 2 ([B, T] or [gas, B, T] ids);
+        0 when the model is not token-based."""
+        for leaf in jax.tree_util.tree_leaves(batch):
+            if (getattr(leaf, "ndim", 0) >= 2
+                    and jnp.issubdtype(getattr(leaf, "dtype", jnp.float32),
+                                       jnp.integer)):
+                return int(np.prod(leaf.shape))
+        return 0
+
+    def _model_flops_per_step(self, batch) -> float:
+        """FLOPs one optimizer step spends on the MODEL, cached after the
+        first call.  Preferred source: the analytic ``6N + attn`` model
+        cost (transformer.flops_per_token) — rematerialization cannot
+        inflate it.  Fallback: XLA's cost analysis of the compiled fused
+        step (hardware flops: includes remat + optimizer, so MFU reads a
+        few points high there)."""
+        if self._flops_per_step is not None:
+            return self._flops_per_step
+        mc = getattr(self.model, "config", None)
+        toks = self._batch_tokens(batch)
+        if mc is not None and hasattr(mc, "n_layers") and toks:
+            from ..models.transformer import flops_per_token
+
+            leaf = next(l for l in jax.tree_util.tree_leaves(batch)
+                        if getattr(l, "ndim", 0) >= 2
+                        and jnp.issubdtype(l.dtype, jnp.integer))
+            self._flops_per_step = flops_per_token(
+                mc, int(leaf.shape[-1])) * toks
+        else:
+            from ..profiling.flops_profiler import cost_analysis_of
+
+            with self.topology.mesh:
+                costs = cost_analysis_of(self._train_batch, self.state,
+                                         batch, jax.random.PRNGKey(0))
+            self._flops_per_step = float(costs.get("flops", 0.0))
+        return self._flops_per_step
+
+    def _report_telemetry(self, loss, batch,
+                          step_dt: Optional[float] = None) -> None:
+        """Per-step registry updates + boundary-cadence export.
+
+        Cheap host-side observations (phase time, watchdog) land every
+        step; anything needing a device value (loss, grad norm) or an
+        export write waits for the steps_per_print boundary, where
+        train_batch has already drained the dispatch queue — no extra
+        syncs on the hot path.  ``step_dt=None`` marks the incremental
+        fwd/bwd/step path: phase times arrived via the timer sink
+        already, so only the boundary publication runs."""
+        tm = self.telemetry
+        self._m_steps.inc()
+        if step_dt is not None:
+            self._m_phase.observe(step_dt, phase="train_batch")
+            tm.observe_step_time(step_dt, self.global_steps)
+            self._win_time += step_dt
+            self._win_steps += 1
+            self._win_tokens += self._batch_tokens(batch)
+        if self.global_steps % self.config.steps_per_print != 0:
+            return
+        if loss is not None:
+            self._m_loss.set(float(loss))
+        self._m_lr.set(self.get_lr()[0])
+        self._m_grad_norm.set(float(self.state.global_grad_norm))
+        self._m_loss_scale.set(self.loss_scale())
+        skipped = int(self.state.skipped_steps)
+        if skipped > self._skipped_pub:
+            self._m_skipped.inc(skipped - self._skipped_pub)
+            self._skipped_pub = skipped
+        if self._win_time > 0:
+            bs = self.config.train_batch_size or 1
+            self._m_samples_ps.set(self._win_steps * bs / self._win_time)
+            self._m_tokens_ps.set(self._win_tokens / self._win_time)
+            from ..telemetry import mfu as _mfu
+
+            # batch=None marks a boundary reached via the incremental
+            # step() API: reuse the cached flops if a fused step already
+            # derived them, but never run (and cache) the cost analysis
+            # against a None batch — that would pin the MFU gauge to 0
+            # for the engine's lifetime
+            flops = (self._model_flops_per_step(batch)
+                     if batch is not None
+                     else (self._flops_per_step or 0.0))
+            if flops > 0:
+                self._m_mfu.set(_mfu(flops * self._win_steps, self._win_time,
+                                     n_chips=self.topology.world_size))
+        self._win_time, self._win_steps, self._win_tokens = 0.0, 0, 0
+        cl = comm.get_comms_logger()
+        if cl is not None and cl.enabled:
+            cl.publish(tm.registry, axis_sizes=self.topology.axis_sizes)
+        if self.monitor is not None:
+            self.monitor.write_registry(tm.registry, self.global_steps)
+        tm.export(self.global_steps)
+
+    def close(self) -> None:
+        """Flush and release observability sinks (telemetry exporters,
+        monitor writer handles).  Idempotent."""
+        if self.telemetry is not None:
+            self.telemetry.export(self.global_steps, force=True)
+            self.telemetry.close()
+        if self.monitor is not None:
+            self.monitor.close()
+
     def _report(self, loss) -> None:
         cfg = self.config
         if self.monitor is not None and loss is not None:

@@ -1,0 +1,119 @@
+"""Unified telemetry.
+
+One process-local :class:`MetricsRegistry` (``registry.py``) is the
+single sink for training-engine step metrics, serving metrics and comms
+totals; ``exporter.py`` gives it two wire formats (Prometheus text,
+JSONL events), ``tracing.py`` annotates steps/phases for the XLA
+profiler, ``mfu.py`` owns the per-generation TPU peak-FLOPs table, and
+``watchdog.py`` flags stalled steps.  ``Telemetry`` below bundles the
+export side behind the ``telemetry`` config block
+(``runtime/config.py``) so the engines wire it with one object.
+
+See ``docs/OBSERVABILITY.md`` for the metric catalog and setup.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .exporter import (JSONLWriter, PrometheusFileExporter,
+                       PrometheusHTTPExporter, parse_prometheus_text,
+                       to_prometheus_text)
+from .mfu import (PEAK_BF16_FLOPS, mfu, peak_flops_for_device,
+                  peak_flops_for_kind)
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, get_registry, set_registry)
+from .tracing import (PhaseTimer, annotate, profiler_available, step_trace)
+from .watchdog import StallWatchdog
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "get_registry", "set_registry",
+    "to_prometheus_text", "parse_prometheus_text",
+    "PrometheusFileExporter", "PrometheusHTTPExporter", "JSONLWriter",
+    "step_trace", "annotate", "PhaseTimer", "profiler_available",
+    "PEAK_BF16_FLOPS", "peak_flops_for_kind", "peak_flops_for_device", "mfu",
+    "StallWatchdog", "Telemetry",
+]
+
+
+class Telemetry:
+    """Config-driven export bundle: the engines create one of these from
+    the ``telemetry`` config block and call ``export(step)`` at their
+    reporting cadence and ``close()`` at teardown.
+
+    Holds: the registry (shared process default unless injected), the
+    optional Prometheus file/HTTP exporters, the optional JSONL log, and
+    the stall watchdog.  All parts are individually optional — an empty
+    config block yields a registry-only session (metrics still
+    collectable by ``tools/telemetry_dump.py`` or a monitor fan-out)."""
+
+    def __init__(self, config=None, loop: str = "train",
+                 registry: Optional[MetricsRegistry] = None):
+        self.config = config
+        self.registry = registry or get_registry()
+        self.loop = loop
+        self.jsonl: Optional[JSONLWriter] = None
+        self.prom_file: Optional[PrometheusFileExporter] = None
+        self.prom_http: Optional[PrometheusHTTPExporter] = None
+        self.watchdog: Optional[StallWatchdog] = None
+        self.export_interval = 1
+        self.trace_annotations = True
+        self._last_export: Optional[int] = None
+        if config is None:
+            return
+        self.export_interval = max(1, int(getattr(config, "export_interval", 1)))
+        self.trace_annotations = bool(getattr(config, "trace_annotations", True))
+        if getattr(config, "jsonl_path", ""):
+            self.jsonl = JSONLWriter(config.jsonl_path)
+        if getattr(config, "prometheus_path", ""):
+            self.prom_file = PrometheusFileExporter(config.prometheus_path,
+                                                    self.registry)
+        if getattr(config, "prometheus_port", 0):
+            self.prom_http = PrometheusHTTPExporter(
+                port=config.prometheus_port, registry=self.registry).start()
+        wd = getattr(config, "stall_watchdog", None)
+        if wd is not None and getattr(wd, "enabled", False):
+            self.watchdog = StallWatchdog(multiple=wd.multiple,
+                                          window=wd.window, name=loop,
+                                          registry=self.registry)
+
+    def step_trace(self, step_num: int):
+        """Profiler step annotation (no-op context when disabled)."""
+        if not self.trace_annotations:
+            from .tracing import _noop
+
+            return _noop()
+        return step_trace(step_num)
+
+    def observe_step_time(self, dt_s: float, step: Optional[int] = None) -> bool:
+        """Feed the stall watchdog; True when the step rates as a stall."""
+        if self.watchdog is None:
+            return False
+        return self.watchdog.observe(dt_s, step)
+
+    def export(self, step: int, force: bool = False) -> None:
+        """Write the configured sinks at the configured cadence.
+
+        Cadence is steps SINCE THE LAST EXPORT, not ``step %
+        interval`` — callers invoke this at their own reporting
+        boundaries (e.g. steps_per_print), and a modulo gate would
+        stretch the effective cadence to the lcm of the two strides
+        (steps_per_print=7, interval=10 -> an export every 70 steps)."""
+        if not force:
+            if (self._last_export is not None
+                    and step - self._last_export < self.export_interval):
+                return
+        self._last_export = step
+        if self.prom_file is not None:
+            self.prom_file.write()
+        if self.jsonl is not None:
+            self.jsonl.emit_snapshot(self.registry, step=step)
+
+    def close(self) -> None:
+        for part in (self.prom_file, self.prom_http, self.jsonl):
+            if part is not None:
+                try:
+                    part.close()
+                except Exception:
+                    pass

@@ -23,13 +23,40 @@ STEP_MICRO_TIMER = "step_microstep"
 STEP_GLOBAL_TIMER = "step"
 
 
+_SYNC_PROGRAM = None
+
+
+def _device_sync() -> None:
+    """Block until previously dispatched device work completes.
+
+    ``jax.effects_barrier`` only flushes *effects* (io_callback and
+    friends) — it does NOT wait on pending computations, so it cannot
+    close a timing window on an async backend.  A bare
+    ``device_put(0.0)`` is not enough either: host-to-device transfers
+    ride the transfer path, not the compute queue, so they can complete
+    while a long program is still running.  Enqueue a tiny COMPILED
+    program instead — per-device program execution is in dispatch order,
+    so blocking on its output orders behind all previously dispatched
+    computations (the ``cuda.synchronize`` analogue this module's
+    docstring promises)."""
+    global _SYNC_PROGRAM
+    if _SYNC_PROGRAM is None:
+        import jax.numpy as jnp
+
+        _SYNC_PROGRAM = jax.jit(lambda: jnp.zeros(()))
+    _SYNC_PROGRAM().block_until_ready()
+
+
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, sink=None):
         self.name = name
         self.started = False
         self._start = 0.0
         self._elapsed = 0.0
         self.count = 0
+        #: optional ``(name, seconds)`` callback fired on every stop —
+        #: how phase times reach the telemetry registry
+        self.sink = sink
 
     def start(self):
         if self.started:
@@ -41,10 +68,13 @@ class _Timer:
         if not self.started:
             return
         if sync:
-            (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
-        self._elapsed += time.perf_counter() - self._start
+            _device_sync()
+        dt = time.perf_counter() - self._start
+        self._elapsed += dt
         self.count += 1
         self.started = False
+        if self.sink is not None:
+            self.sink(self.name, dt)
 
     def elapsed(self, reset: bool = True) -> float:
         e = self._elapsed
@@ -58,12 +88,14 @@ class _Timer:
 
 
 class SynchronizedWallClockTimer:
-    def __init__(self):
+    def __init__(self, sink=None):
         self.timers: Dict[str, _Timer] = {}
+        #: per-stop ``(name, seconds)`` callback installed on every timer
+        self.sink = sink
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name)
+            self.timers[name] = _Timer(name, sink=self.sink)
         return self.timers[name]
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,

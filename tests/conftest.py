@@ -21,10 +21,44 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import sys  # noqa: E402
+
+# CI wrappers run this suite under `timeout ... | tee log` and count
+# progress dots from the log.  Two buffering layers can eat that
+# progress when the timeout SIGTERMs the interpreter mid-run: the plain
+# stdio block buffer, and — with pytest's default fd-capture — the
+# dup'd stream the terminal reporter writes through (which `python -u`
+# does NOT reach).  Line-buffer the visible streams here, and flush the
+# terminal reporter after every test below, so every completed test's
+# dot is already on disk when the axe falls.
+for _stream in (sys.stdout, sys.stderr):
+    try:
+        _stream.reconfigure(line_buffering=True)
+    except (AttributeError, ValueError):
+        pass
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", _platform)
+
+_terminal_reporter = None
+
+
+def pytest_configure(config):
+    global _terminal_reporter
+    _terminal_reporter = config.pluginmanager.get_plugin("terminalreporter")
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_runtest_logreport(report):
+    # runs on every phase report; by teardown the test's progress dot has
+    # been written to the reporter's (possibly capture-dup'd) stream
+    if report.when == "teardown" and _terminal_reporter is not None:
+        try:
+            _terminal_reporter._tw.flush()
+        except Exception:
+            pass
 
 
 # The <2-minute smoke tier for perf-round edit loops (README "Testing"):

@@ -1,0 +1,65 @@
+"""tools/check_metric_names.py runs as a tier-1 gate: every metric the
+package registers is snake_case, deepspeed_tpu_-prefixed, single-owner,
+single-type.  Also unit-tests the lint's own detection logic on a
+synthetic tree so a silently-broken scanner can't green-light bad names.
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_lint():
+    path = os.path.join(REPO, "tools", "check_metric_names.py")
+    spec = importlib.util.spec_from_file_location("check_metric_names", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_metric_names", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_metric_names_pass():
+    lint = _load_lint()
+    errors = lint.check(REPO)
+    assert not errors, "\n".join(errors)
+    # sanity: the scan actually found the telemetry families (an empty
+    # scan passing would be a broken scanner, not a clean package)
+    names = set(lint.collect(REPO))
+    assert "deepspeed_tpu_train_phase_seconds" in names
+    assert "deepspeed_tpu_serving_decode_seconds" in names
+    assert "deepspeed_tpu_comm_bytes_total" in names
+
+
+def test_lint_catches_violations(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "a.py").write_text(
+        "reg.counter('deepspeed_tpu_BadCase_total')\n"
+        "reg.gauge('deepspeed_tpu_dup')\n")
+    (pkg / "b.py").write_text(
+        "reg.counter('deepspeed_tpu_dup')\n"  # second site AND other type
+        "Counter('deepspeed_tpu_ok_total')\n")
+    errors = lint.check(str(tmp_path))
+    joined = "\n".join(errors)
+    assert "deepspeed_tpu_BadCase_total" in joined
+    assert "multiple types" in joined
+    assert "2 call sites" in joined
+    # the clean constructor-registered name produced no error
+    assert "deepspeed_tpu_ok_total'" not in joined
+
+
+def test_lint_ignores_unrelated_calls(tmp_path):
+    lint = _load_lint()
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "a.py").write_text(
+        "itertools.count('x')\n"
+        "collections.Counter('abc')\n"
+        "reg.counter(name_variable)\n")
+    assert lint.check(str(tmp_path)) == []
