@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..telemetry.spans import get_span_recorder
 from ..utils.logging import logger
 from .comms_logger import get_comms_logger
 
@@ -181,10 +182,21 @@ def all_gather_object(obj):
 # in-program collectives (use inside shard_map / pjit bodies)
 # --------------------------------------------------------------------------
 def _log(op: str, tensor, axis: AxisName) -> None:
+    """Report one collective to the comms logger and the span ring.
+
+    Runs at TRACE time (collectives compile into the program), so the
+    span ring gets zero-duration point events marking op/bytes/group —
+    a timeline of what each traced program will execute, aligned with
+    the surrounding compile/step spans — not per-step wall times."""
     cl = get_comms_logger()
-    if cl is not None and cl.enabled:
-        size = getattr(tensor, "size", 0) * jnp.dtype(getattr(tensor, "dtype", jnp.float32)).itemsize
+    rec = get_span_recorder()
+    log_cl = cl is not None and cl.enabled
+    if not log_cl and not rec.enabled:
+        return
+    size = getattr(tensor, "size", 0) * jnp.dtype(getattr(tensor, "dtype", jnp.float32)).itemsize
+    if log_cl:
         cl.append(op, str(axis), size)
+    rec.event(op, cat="comm", axis=str(axis), bytes=int(size))
 
 
 def all_reduce(tensor, op: str = "sum", axis: AxisName = "data"):

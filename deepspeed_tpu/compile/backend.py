@@ -39,6 +39,23 @@ PassFn = Callable[[Any], None]
 PASS_REGISTRY: Dict[str, PassFn] = {}
 
 
+def shape_signature(*trees: Any) -> tuple:
+    """Hashable ``(shape, dtype)`` signature of the array leaves of
+    ``trees`` — the arg-shape key the recompilation sentinel
+    (telemetry/compile_sentinel.py) attributes compiles with: a jitted
+    program retraces exactly when this signature (or a static arg)
+    changes, so an unchanged signature that still compiled is the
+    steady-state-recompile smell.  Host-side only: reads ``.shape`` /
+    ``.dtype`` avals, never device values."""
+    parts = []
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            parts.append((tuple(getattr(leaf, "shape", ())),
+                          str(getattr(leaf, "dtype",
+                                      type(leaf).__name__))))
+    return tuple(parts)
+
+
 def _register(name: str):
     def deco(fn: PassFn) -> PassFn:
         PASS_REGISTRY[name] = fn
@@ -174,11 +191,16 @@ def compile_engine(engine, backend: str = "xla",
         raise ValueError(f"unknown compile backend '{backend}'")
     names: List[str] = list(passes if passes is not None else DEFAULT_PASSES)
     applied = []
+    from ..telemetry.compile_sentinel import expect_recompile
+
     for name in names:
         if name not in PASS_REGISTRY:
             raise KeyError(f"unknown compile pass '{name}'; "
                            f"known: {sorted(PASS_REGISTRY)}")
         PASS_REGISTRY[name](engine)
+        # a pass that re-jits the step legitimately compiles on the next
+        # call — tell the sentinel so it is not flagged as steady-state
+        expect_recompile(f"compile_pass:{name}")
         applied.append(name)
     existing = list(getattr(engine, "compile_passes_applied", []))
     engine.compile_passes_applied = existing + applied

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -30,6 +31,9 @@ from ...models.transformer import TransformerConfig
 from ...runtime.config_utils import ConfigModel
 from ...runtime.precision import cast_tree
 from ...telemetry import get_registry
+from ...telemetry.compile_sentinel import RecompileSentinel
+from ...telemetry.flight import dump_on_exception
+from ...telemetry.spans import begin_span, end_span, record_event
 from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
 from .model_runner import (paged_copy_page, paged_decode, paged_prefill,
@@ -72,6 +76,14 @@ class RaggedInferenceConfig(ConfigModel):
     #: cap on cached-but-UNREFERENCED pages retained for reuse (LRU);
     #: 0 = bounded only by the pool itself
     prefix_cache_pages: int = 0
+    #: recompile sentinel for the serving loop (telemetry/
+    #: compile_sentinel.py): attribute XLA compiles to steps via the
+    #: step's program shapes and warn on steady-state recompilation.
+    #: The serving engine takes no `telemetry` config block, so the
+    #: knob lives here; `sentinel_steady_after` mirrors
+    #: telemetry.recompile_sentinel.steady_after
+    recompile_sentinel: bool = True
+    sentinel_steady_after: int = 3
 
     @property
     def jnp_dtype(self):
@@ -197,6 +209,18 @@ class InferenceEngineV2:
                        if self.config.prefill_chunk > 0 else 0)
         self._sample_key = jax.random.PRNGKey(seed)
         self._decode_steps = 0
+        # request lifecycle bookkeeping: enqueue/first-token stamps + the
+        # open request span, keyed by uid (survives preemption, which
+        # resets the SequenceState but not the request)
+        self._req_meta: Dict[int, Dict[str, Any]] = {}
+        # per-step program signature parts for the recompile sentinel:
+        # each prefill bucket / chunk size and the decode program are
+        # components — a compile during a step that introduced no new
+        # component after warmup is a steady-state recompilation
+        self._step_parts: set = set()
+        self._sentinel = (RecompileSentinel(
+            loop="serve", steady_after=self.config.sentinel_steady_after)
+            if self.config.recompile_sentinel else None)
 
     # -- telemetry -----------------------------------------------------------
     def _init_serving_metrics(self) -> None:
@@ -247,14 +271,50 @@ class InferenceEngineV2:
         self._m_preemptions = reg.counter(
             "deepspeed_tpu_serving_preemptions_total",
             "sequences evicted to the queue under KV-pool pressure")
+        self._m_ttft_h = reg.histogram(
+            "deepspeed_tpu_serving_ttft_seconds",
+            "time to first token: enqueue to first sampled token "
+            "(includes queue wait)")
+        self._m_tpot_h = reg.histogram(
+            "deepspeed_tpu_serving_tpot_seconds",
+            "mean time per output token after the first, observed once "
+            "per finished request")
         # last-published absolutes for the per-engine cache counters, so
         # the process-cumulative registry counters only receive deltas
         self._cache_pub = {"hits": 0, "misses": 0, "evictions": 0}
 
-    def _phase(self, name: str, hist) -> PhaseTimer:
-        """Profiler annotation + wall-time histogram for one serving
-        phase (prefill/decode)."""
-        return PhaseTimer(name, sink=lambda _n, dt: hist.observe(dt))
+    def _phase(self, name: str, hist, **attrs) -> PhaseTimer:
+        """Profiler annotation + wall-time histogram + trace-ring span
+        for one serving phase (prefill/decode); ``attrs`` land on the
+        span only."""
+        return PhaseTimer(name, sink=lambda _n, dt: hist.observe(dt), **attrs)
+
+    # -- request lifecycle bookkeeping ---------------------------------------
+    def _note_tokens(self, seq: SequenceState, n: int = 1) -> None:
+        """Account ``n`` newly emitted tokens against the request: the
+        first one closes the TTFT window (enqueue -> first token,
+        queue wait included)."""
+        m = self._req_meta.get(seq.uid)
+        if m is None:
+            return
+        now = time.perf_counter()
+        if m["t_first"] is None:
+            m["t_first"] = now
+            self._m_ttft_h.observe(now - m["t0"])
+        m["t_last"] = now
+        m["n"] += n
+
+    def _finish_request(self, seq: SequenceState) -> None:
+        """Close the request span and observe TPOT (mean inter-token
+        time after the first — the decode-side latency SLO)."""
+        m = self._req_meta.pop(seq.uid, None)
+        if m is None:
+            return
+        if m["n"] > 1 and m["t_first"] is not None:
+            self._m_tpot_h.observe(
+                (m["t_last"] - m["t_first"]) / (m["n"] - 1))
+        end_span(m["span"], generated=m["n"],
+                 total_s=round(time.perf_counter() - m["t0"], 6))
 
     def _sync_cache_counters(self) -> None:
         """Forward allocator/prefix-cache counter deltas to the registry
@@ -289,6 +349,12 @@ class InferenceEngineV2:
             uid=uid, tokens=list(request.prompt_ids), prompt_len=n,
             max_new_tokens=request.max_new_tokens,
             temperature=request.temperature, eos_id=request.eos_id))
+        self._req_meta[uid] = {
+            "t0": time.perf_counter(), "t_first": None, "t_last": None,
+            "n": 0,
+            "span": begin_span("request", cat="serve", uid=uid,
+                               prompt_tokens=n,
+                               max_new_tokens=request.max_new_tokens)}
         self._m_requests.inc()
         self._m_queue.set(len(self._queue))
         return uid
@@ -324,6 +390,8 @@ class InferenceEngineV2:
         seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
         self._queue.insert(0, seq)
         self._m_preemptions.inc()
+        record_event("preempt", cat="serve", uid=seq.uid,
+                     prefix_tokens=seq.length)
 
     def _admit(self) -> List[SequenceState]:
         admitted = []
@@ -374,6 +442,7 @@ class InferenceEngineV2:
             fresh = self.allocator.alloc(need_new)
             if full_hit:
                 src, dst = shared[-1], fresh[-1]
+                self._step_parts.add("copy_page")
                 self._pools = self._copy_page(self._pools, jnp.int32(src),
                                               jnp.int32(dst))
                 self.allocator.free([src])  # drop our ref on the original
@@ -399,6 +468,9 @@ class InferenceEngineV2:
             seq.admit_order = next(self._admit_counter)
             self._page_table[i, :] = self.block.trash_page
             self._page_table[i, :len(seq.pages)] = seq.pages
+            record_event("admit", cat="serve", uid=seq.uid, slot=i,
+                         cache_hit_pages=m, new_pages=len(fresh),
+                         full_hit=full_hit)
             admitted.append(seq)
             self._slots[i] = seq
         return admitted
@@ -425,6 +497,7 @@ class InferenceEngineV2:
         shared by the whole-prompt and final-chunk prefill paths."""
         tok = self._sample(seq, np.asarray(logits, np.float32))
         seq.tokens.append(tok)
+        self._note_tokens(seq)
         out[seq.uid] = {"tokens": [tok], "done": False}
         self._maybe_finish(seq, tok)
         if seq.done:
@@ -455,6 +528,7 @@ class InferenceEngineV2:
         self._page_table[seq.slot, :] = self.block.trash_page
         self._slots[seq.slot] = None
         seq.slot, seq.pages, seq.done = -1, [], True
+        self._finish_request(seq)
 
     def _maybe_finish(self, seq: SequenceState, token: int) -> None:
         if (seq.generated >= seq.max_new_tokens
@@ -485,6 +559,7 @@ class InferenceEngineV2:
             b *= 2
         prev = self._page_table[seq.slot][:min(
             b, self.block.max_pages_per_seq)]
+        self._step_parts.add(("prefill_chunk", C, int(prev.shape[0])))
         logits, self._pools = self._prefill_chunk(
             self.params, self._pools, jnp.asarray(ids),
             jnp.asarray(rows), jnp.asarray(prev),
@@ -498,7 +573,23 @@ class InferenceEngineV2:
         """Admit + prefill new sequences, decode one token for running ones.
 
         Returns {uid: {"tokens": [newly generated], "done": bool}}.
-        """
+
+        A step that raises dumps the flight recorder (when one is
+        installed) before propagating; a step that compiled is reported
+        to the recompile sentinel with the set of program shapes it
+        dispatched (prefill buckets/chunks, decode, page copies)."""
+        self._step_parts = set()
+        try:
+            out = self._step_impl()
+        except Exception:
+            dump_on_exception("engine_v2.step")
+            raise
+        if self._step_parts and self._sentinel is not None:
+            self._sentinel.observe_step(frozenset(self._step_parts),
+                                        step=self._decode_steps)
+        return out
+
+    def _step_impl(self) -> Dict[int, Dict[str, Any]]:
         out: Dict[int, Dict[str, Any]] = {}
         ps = self.block.page_size
 
@@ -519,7 +610,8 @@ class InferenceEngineV2:
             for seq in pending:
                 start = seq.prefilled  # page-aligned: chunk % ps == 0
                 c_n = min(self._chunk, seq.length - start)
-                with self._phase("prefill", self._m_prefill_h):
+                with self._phase("prefill", self._m_prefill_h, uid=seq.uid,
+                                 start=start, tokens=c_n):
                     logits = self._run_prefill_chunk(seq, start, c_n,
                                                      self._chunk)
                     if seq.prefilled >= seq.length:
@@ -533,7 +625,9 @@ class InferenceEngineV2:
                     # start-offset program, bucketed like whole prompts
                     # so the shape set stays fixed
                     n_suf = seq.length - seq.prefilled
-                    with self._phase("prefill", self._m_prefill_h):
+                    with self._phase("prefill", self._m_prefill_h,
+                                     uid=seq.uid, start=seq.prefilled,
+                                     tokens=n_suf):
                         logits = self._run_prefill_chunk(
                             seq, seq.prefilled, n_suf, self._bucket(n_suf))
                         self._emit_sampled(seq, logits, out)
@@ -548,7 +642,9 @@ class InferenceEngineV2:
                 rows = np.full((bucket // ps,), self.block.trash_page,
                                np.int32)
                 rows[:len(seq.pages)] = seq.pages
-                with self._phase("prefill", self._m_prefill_h):
+                self._step_parts.add(("prefill", bucket))
+                with self._phase("prefill", self._m_prefill_h, uid=seq.uid,
+                                 tokens=n, bucket=bucket):
                     logits, self._pools = self._prefill(
                         self.params, self._pools,
                         jnp.asarray(ids), jnp.asarray(rows), jnp.int32(n))
@@ -602,7 +698,8 @@ class InferenceEngineV2:
             temps[seq.slot] = max(seq.temperature, 0.0)
 
         self._decode_steps += 1
-        with self._phase("decode", self._m_decode_h):
+        self._step_parts.add("decode")
+        with self._phase("decode", self._m_decode_h, batch=len(active)):
             tokens, self._pools = self._decode(
                 self.params, self._pools,
                 jnp.asarray(last), jnp.asarray(pos),
@@ -615,6 +712,7 @@ class InferenceEngineV2:
         for seq in active:
             tok = int(tokens[seq.slot])
             seq.tokens.append(tok)
+            self._note_tokens(seq)
             # the decode step wrote KV for the token it consumed
             seq.prefilled = seq.length - 1
             if self.prefix_cache is not None and seq.prefilled % ps == 0:

@@ -52,6 +52,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 from ..parallel.mesh import (BATCH_AXES, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS,
                              peek_topology)
 
@@ -264,7 +266,7 @@ def moe_ffn_ep(x: jnp.ndarray, gate_w: jnp.ndarray,
     in_specs = (tok_spec, P(None, None),
                 w_col if wg is not None else P(),
                 w_col, P(EXPERT_AXIS, MODEL_AXIS, None), P())
-    mapped = jax.shard_map(
+    mapped = shard_map(
         block, mesh=mesh, in_specs=in_specs,
         out_specs=(tok_spec, P()), check_vma=False)
     # non-swiglu blocks never read wg; a dummy scalar rides the P() spec

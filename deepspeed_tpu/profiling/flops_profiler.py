@@ -195,6 +195,11 @@ class FlopsProfiler:
         return count_params(self.engine.state.params)
 
     def print_profile(self) -> None:
+        from ..telemetry.compile_sentinel import expect_recompile
+
+        # the profile lowers+compiles components out of band — announce
+        # the compiles so the sentinel doesn't blame the next step
+        expect_recompile("flops_profiler")
         params = self.get_total_params()
         flops = self.get_total_flops()
         tput = flops / self.duration if self.duration > 0 else 0.0
@@ -203,8 +208,23 @@ class FlopsProfiler:
             f"flops/micro-step={flops / 1e9:.2f}G "
             f"step_time={self.duration * 1e3:.1f}ms "
             f"achieved={tput / 1e12:.2f} TFLOPS")
+        self._publish(params, flops, tput)
         if getattr(self.config, "module_depth", -1) != 0:
             self.print_model_profile()
+
+    def _publish(self, params: int, flops: float, tput: float) -> None:
+        """Land the one-shot profile on the telemetry registry too, so it
+        reaches Prometheus/JSONL alongside the log line (the log scrolls
+        away; the gauges survive to the next export)."""
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        reg.gauge("deepspeed_tpu_profile_params",
+                  "parameter count from the flops profiler").set(params)
+        reg.gauge("deepspeed_tpu_profile_flops_per_micro_step",
+                  "XLA cost-analysis FLOPs of one micro-step").set(flops)
+        reg.gauge("deepspeed_tpu_profile_achieved_tflops",
+                  "achieved TFLOPS over the profiled step").set(tput / 1e12)
 
     def print_model_profile(self) -> None:
         """Per-module breakdown (reference print_model_profile) when the

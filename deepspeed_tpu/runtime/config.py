@@ -222,6 +222,55 @@ class StallWatchdogConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class SpanTraceConfig(ConfigModel):
+    """``spans`` sub-block of ``telemetry``: the host-side span ring
+    (telemetry/spans.py) feeding Chrome-trace dumps and the flight
+    recorder.  ``profiler_annotations`` nests each span in a
+    ``jax.profiler.TraceAnnotation`` so XProf captures carry the same
+    names."""
+
+    enabled: bool = True
+    ring_size: int = 4096
+    profiler_annotations: bool = True
+
+    def validate(self) -> None:
+        if self.ring_size < 16:
+            raise ValueError("telemetry.spans.ring_size must be >= 16")
+
+
+@dataclasses.dataclass
+class FlightRecorderConfig(ConfigModel):
+    """``flight_recorder`` sub-block of ``telemetry``: dump the span
+    ring + recent log events + a registry snapshot to a timestamped
+    JSONL on exception-in-step, watchdog trip, or demand (``path`` is
+    the dump DIRECTORY, default ./flight_recorder)."""
+
+    enabled: bool = True
+    path: str = ""
+    events: int = 256
+
+    def validate(self) -> None:
+        if self.events < 16:
+            raise ValueError("telemetry.flight_recorder.events must be >= 16")
+
+
+@dataclasses.dataclass
+class RecompileSentinelConfig(ConfigModel):
+    """``recompile_sentinel`` sub-block of ``telemetry``: count XLA
+    compiles per step (telemetry/compile_sentinel.py) and warn when a
+    step recompiles after ``steady_after`` steady steps with unchanged
+    arg shapes."""
+
+    enabled: bool = True
+    steady_after: int = 3
+
+    def validate(self) -> None:
+        if self.steady_after < 0:
+            raise ValueError(
+                "telemetry.recompile_sentinel.steady_after must be >= 0")
+
+
+@dataclasses.dataclass
 class TelemetryConfig(ConfigModel):
     """``telemetry`` block: the unified metrics registry + export paths
     (see deepspeed_tpu/telemetry/ and docs/OBSERVABILITY.md).
@@ -232,7 +281,10 @@ class TelemetryConfig(ConfigModel):
     ``prometheus_port`` serves /metrics over HTTP (0 = off),
     ``jsonl_path`` appends snapshot events to a JSON-lines log.
     ``trace_annotations`` wraps steps in ``jax.profiler`` step/phase
-    annotations (no-op without a live profiler capture)."""
+    annotations (no-op without a live profiler capture).  ``spans``,
+    ``flight_recorder`` and ``recompile_sentinel`` configure the
+    timeline side (all default-on once ``enabled`` is set; see
+    docs/OBSERVABILITY.md "Tracing & flight recorder")."""
 
     enabled: bool = False
     prometheus_path: str = ""
@@ -242,6 +294,12 @@ class TelemetryConfig(ConfigModel):
     trace_annotations: bool = True
     stall_watchdog: StallWatchdogConfig = dataclasses.field(
         default_factory=StallWatchdogConfig)
+    spans: SpanTraceConfig = dataclasses.field(
+        default_factory=SpanTraceConfig)
+    flight_recorder: FlightRecorderConfig = dataclasses.field(
+        default_factory=FlightRecorderConfig)
+    recompile_sentinel: RecompileSentinelConfig = dataclasses.field(
+        default_factory=RecompileSentinelConfig)
 
     def validate(self) -> None:
         if self.export_interval < 1:

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ...utils.jax_compat import shard_map
+
 from ...models.transformer import TransformerConfig, _norm, _repeat_kv, _rope
 from ...parallel.mesh import MODEL_AXIS
 
@@ -177,6 +179,6 @@ def domino_transformer_forward(cfg: TransformerConfig, params, input_ids,
         return _norm(x, params["final_norm"]["scale"],
                      params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(specs, P(None, None)),
-                       out_specs=P(None, None, None), check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(specs, P(None, None)),
+                   out_specs=P(None, None, None), check_vma=False)
     return fn(params, input_ids)

@@ -35,6 +35,10 @@ import optax
 
 from .. import comm
 from ..parallel.mesh import MeshTopology
+from ..telemetry.compile_sentinel import expect_recompile
+from ..telemetry.flight import dump_on_exception
+from ..telemetry.spans import record_event, span
+from ..utils.jax_compat import shard_map
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
@@ -538,10 +542,10 @@ class DeepSpeedTPUEngine:
                     # every step
                     pspecs = self.zero_plan.tree_specs(params, "master")
                     sspecs = self.zero_plan.tree_specs(opt_state, "master")
-                    fn = jax.shard_map(direct, mesh=self.topology.mesh,
-                                       in_specs=(pspecs, sspecs, pspecs),
-                                       out_specs=(pspecs, sspecs),
-                                       check_vma=False)
+                    fn = shard_map(direct, mesh=self.topology.mesh,
+                                   in_specs=(pspecs, sspecs, pspecs),
+                                   out_specs=(pspecs, sspecs),
+                                   check_vma=False)
                     new_params, new_opt = fn(grads, opt_state, params)
                 else:
                     new_params, new_opt = direct(grads, opt_state, params)
@@ -629,6 +633,9 @@ class DeepSpeedTPUEngine:
             self._param_offload_kind = param_memory_kind
         opt_state_memory_kind = getattr(self, "_opt_offload_kind", None)
         param_memory_kind = getattr(self, "_param_offload_kind", None)
+        # rebuilt jit wrappers legitimately compile on the next call —
+        # announce it so the sentinel does not flag a steady-state recompile
+        expect_recompile("engine._compile_steps")
         donate = dict(donate_argnums=(0,))
         self._micro_step = jax.jit(self._micro_step_body, **donate)
         self._eval_fn = None
@@ -990,23 +997,38 @@ class DeepSpeedTPUEngine:
         t0 = time.perf_counter()
         trace = (self.telemetry.step_trace(self.global_steps)
                  if self.telemetry is not None else _no_trace())
-        with trace:
-            with self.topology.mesh:
-                self.state, loss = self._train_batch(self.state, batch,
-                                                     self._next_rng())
-            self._repin_opt_state()
-            if self.offload_optimizer is not None:
-                self._apply_step_offload()
-            self.global_steps += 1
-            self.micro_steps += self.config.gradient_accumulation_steps or 1
-            self._sanity_check_maybe(loss, skipped_before)
-            # dispatch is async: drain the device queue at reporting
-            # boundaries so the throughput window [boundary, boundary]
-            # measures real wall time
-            if self.global_steps % self.config.steps_per_print == 0 or \
-                    self.config.wall_clock_breakdown:
-                jax.block_until_ready(loss)
+        try:
+            with trace, span("train_batch", cat="train",
+                             step=self.global_steps):
+                with self.topology.mesh:
+                    self.state, loss = self._train_batch(self.state, batch,
+                                                         self._next_rng())
+                self._repin_opt_state()
+                if self.offload_optimizer is not None:
+                    self._apply_step_offload()
+                self.global_steps += 1
+                self.micro_steps += self.config.gradient_accumulation_steps or 1
+                self._sanity_check_maybe(loss, skipped_before)
+                # dispatch is async: drain the device queue at reporting
+                # boundaries so the throughput window [boundary, boundary]
+                # measures real wall time
+                if self.global_steps % self.config.steps_per_print == 0 or \
+                        self.config.wall_clock_breakdown:
+                    jax.block_until_ready(loss)
+        except Exception:
+            # black box first, then propagate: the flight dump is the
+            # only record of what the process was doing when it died
+            dump_on_exception("engine.train_batch")
+            raise
         self.tput_timer.stop()
+        if self.telemetry is not None and self.telemetry.sentinel is not None:
+            # observed BEFORE the reporting path below: its occasional
+            # cost-analysis compiles must not masquerade as this step's
+            from ..compile.backend import shape_signature
+
+            self.telemetry.sentinel.observe_step(
+                [("train_batch", shape_signature(batch))],
+                step=self.global_steps)
         if self.flops_profiler is not None:
             self.flops_profiler.stop_profile_maybe(self.global_steps)
         if self.telemetry is not None:
@@ -1021,7 +1043,8 @@ class DeepSpeedTPUEngine:
         if self.flops_profiler is not None:
             self.flops_profiler.start_profile_maybe(self.global_steps, batch)
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        with self.topology.mesh:
+        with span("forward", cat="train", micro_step=self.micro_steps), \
+                self.topology.mesh:
             self.state, loss = self._micro_step(self.state, batch, self._next_rng())
         self._acc_dirty = True
         self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -1035,6 +1058,11 @@ class DeepSpeedTPUEngine:
         one program); this advances the micro-step counter (reference
         engine.backward, engine.py:2466)."""
         self.timers(BACKWARD_GLOBAL_TIMER).start()
+        # a point event, not a span: the gradient work is fused into the
+        # forward program, so a duration here would read as "backward is
+        # free" in a trace — the marker records only the cadence
+        record_event("backward", cat="train", micro_step=self.micro_steps,
+                     fused_into="forward")
         self.micro_steps += 1
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss if loss is not None else self._cached_loss
@@ -1049,12 +1077,18 @@ class DeepSpeedTPUEngine:
         self.timers(STEP_GLOBAL_TIMER).start()
         if self.is_gradient_accumulation_boundary():
             skipped_before = self._skipped_steps_snapshot()
-            if self.offload_optimizer is not None:
-                self._apply_step_offload()
-            else:
-                with self.topology.mesh:
-                    self.state = self._apply_step(self.state)
-                self._repin_opt_state()
+            try:
+                with span("optimizer_step", cat="train",
+                          step=self.global_steps):
+                    if self.offload_optimizer is not None:
+                        self._apply_step_offload()
+                    else:
+                        with self.topology.mesh:
+                            self.state = self._apply_step(self.state)
+                        self._repin_opt_state()
+            except Exception:
+                dump_on_exception("engine.step")
+                raise
             self._acc_dirty = False  # buffer consumed and re-zeroed
             self.global_steps += 1
             self._sanity_check_maybe(self._cached_loss, skipped_before)
@@ -1173,6 +1207,9 @@ class DeepSpeedTPUEngine:
         else:
             from ..profiling.flops_profiler import cost_analysis_of
 
+            # the cost analysis lowers+compiles the step out of band —
+            # announce it so the sentinel doesn't blame the next step
+            expect_recompile("cost_analysis")
             with self.topology.mesh:
                 costs = cost_analysis_of(self._train_batch, self.state,
                                          batch, jax.random.PRNGKey(0))
@@ -1236,7 +1273,18 @@ class DeepSpeedTPUEngine:
 
     def close(self) -> None:
         """Flush and release observability sinks (telemetry exporters,
-        monitor writer handles).  Idempotent."""
+        monitor writer handles).  Idempotent.
+
+        Emits the comms-logger per-op summary first (rank 0, once): the
+        trace-time bus-bandwidth totals exist only in the logger's dict
+        and would otherwise be silently lost at teardown unless the
+        user called ``log_summary()`` by hand."""
+        cl = comm.get_comms_logger()
+        if (cl is not None and cl.enabled and cl.comms_dict
+                and not getattr(self, "_comms_summary_emitted", False)
+                and comm.get_rank() == 0):
+            cl.log_summary(axis_sizes=self.topology.axis_sizes)
+            self._comms_summary_emitted = True
         if self.telemetry is not None:
             self.telemetry.export(self.global_steps, force=True)
             self.telemetry.close()
@@ -1283,15 +1331,19 @@ class DeepSpeedTPUEngine:
         tag = tag or f"global_step{self.global_steps}"
         if partitioned is None:
             partitioned = jax.process_count() > 1
-        if partitioned:
-            from ..checkpoint.partitioned import save_partitioned
-            from .checkpoint_engine.engines import make_checkpoint_engine
+        with span("checkpoint_save", cat="ckpt", tag=tag,
+                  partitioned=partitioned):
+            if partitioned:
+                from ..checkpoint.partitioned import save_partitioned
+                from .checkpoint_engine.engines import make_checkpoint_engine
 
-            return save_partitioned(self, save_dir, tag, client_state or {},
-                                    checkpoint_engine=make_checkpoint_engine(self.config))
-        from ..checkpoint.saving import save_checkpoint
+                return save_partitioned(
+                    self, save_dir, tag, client_state or {},
+                    checkpoint_engine=make_checkpoint_engine(self.config))
+            from ..checkpoint.saving import save_checkpoint
 
-        return save_checkpoint(self, save_dir, tag=tag, client_state=client_state or {})
+            return save_checkpoint(self, save_dir, tag=tag,
+                                   client_state=client_state or {})
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
         import os
@@ -1304,9 +1356,11 @@ class DeepSpeedTPUEngine:
             latest = os.path.join(load_dir, "latest")
             if os.path.exists(latest):
                 resolved = open(latest).read().strip()
-        if resolved and os.path.exists(os.path.join(load_dir, resolved, META_FILE)):
-            return load_partitioned(self, load_dir, tag=resolved)
-        return load_checkpoint(self, load_dir, tag=tag)
+        with span("checkpoint_load", cat="ckpt", tag=resolved or ""):
+            if resolved and os.path.exists(
+                    os.path.join(load_dir, resolved, META_FILE)):
+                return load_partitioned(self, load_dir, tag=resolved)
+            return load_checkpoint(self, load_dir, tag=tag)
 
     # batch-size accessors (reference engine API)
     def train_micro_batch_size_per_gpu(self) -> int:

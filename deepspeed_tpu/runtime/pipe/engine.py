@@ -33,6 +33,7 @@ from ...models.transformer import (TransformerConfig, _block, _norm,
                                    _pick_attn, init_transformer_params,
                                    transformer_partition_rules)
 from ...parallel.mesh import BATCH_AXES, PIPE_AXIS, get_topology
+from ...utils.jax_compat import shard_map
 from ...runtime.module import ModelSpec
 
 
@@ -220,7 +221,7 @@ def pipelined_causal_lm(cfg: TransformerConfig, num_microbatches: int = 4,
             is_leaf=lambda x: isinstance(x, P))
         body = functools.partial(_pipe_body, cfg=cfg, num_micro=num_microbatches,
                                  pp=pp)
-        fn = jax.shard_map(
+        fn = shard_map(
             body, mesh=topo.mesh,
             in_specs=(manual_specs, P(BATCH_AXES, None), P(BATCH_AXES, None)),
             out_specs=P(), axis_names=set(manual), check_vma=False)

@@ -58,6 +58,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import BATCH_AXES, PIPE_AXIS, get_topology
+from ...utils.jax_compat import shard_map
 from ...utils.logging import logger
 from ..module import ModelSpec
 
@@ -506,9 +507,9 @@ class PipelineModule:
         body = functools.partial(self._pipe_body, pp=pp)
         data_spec = P(BATCH_AXES, *([None] * (xs.ndim - 1)))
         label_spec = P(BATCH_AXES, *([None] * (ys.ndim - 1)))
-        fn = jax.shard_map(body, mesh=topo.mesh,
-                           in_specs=(param_specs, data_spec, label_spec),
-                           out_specs=P(), check_vma=False)
+        fn = shard_map(body, mesh=topo.mesh,
+                       in_specs=(param_specs, data_spec, label_spec),
+                       out_specs=P(), check_vma=False)
         return fn(params, xs, ys)
 
     def to_model_spec(self) -> ModelSpec:

@@ -32,6 +32,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...parallel.mesh import DATA_AXIS
+from ...utils.jax_compat import shard_map
 from ...utils.logging import logger
 
 QBLOCK = 128  # quantization block (reference csrc/quantization group size)
@@ -231,7 +232,7 @@ def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     out_specs = tuple(
         (t if sd >= 0 else P(*tuple(c)[1:]))
         for c, t, sd in zip(flat_chunk, flat_target, sdims))
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(tuple(flat_chunk),),
-                       out_specs=out_specs, check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=(tuple(flat_chunk),),
+                   out_specs=out_specs, check_vma=False)
     out_flat = fn(tuple(grads_flat))
     return jax.tree_util.tree_unflatten(treedef, out_flat)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, MODEL_AXIS, get_topology
+from ..utils.jax_compat import shard_map
 
 
 def _vp_ce_body(logits_local: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
@@ -62,6 +63,6 @@ def vocab_parallel_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray,
     batch = BATCH_AXES if batch_sharded else None
     in_specs = (P(batch, *([None] * (logits.ndim - 2)), MODEL_AXIS),
                 P(batch, *([None] * (targets.ndim - 1))))
-    fn = jax.shard_map(_vp_ce_body, mesh=topo.mesh, in_specs=in_specs,
-                       out_specs=in_specs[1], check_vma=False)
+    fn = shard_map(_vp_ce_body, mesh=topo.mesh, in_specs=in_specs,
+                   out_specs=in_specs[1], check_vma=False)
     return fn(logits, targets)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, SEQ_AXIS, get_topology
+from ..utils.jax_compat import shard_map
 
 NEG_INF = -1e30
 
@@ -105,7 +106,7 @@ def ring_attention(q, k, v, causal: bool = True, mask=None):
         raise NotImplementedError("ring attention with padding masks: use "
                                   "ulysses or pad to full blocks")
     spec = P(BATCH_AXES, SEQ_AXIS, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ring_body, causal=causal),
         mesh=topo.mesh, in_specs=(spec,), out_specs=spec, check_vma=False)
     return fn((q, k, v))

@@ -16,22 +16,34 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .compile_sentinel import (RecompileSentinel, compile_counts,
+                               expect_recompile)
 from .exporter import (JSONLWriter, PrometheusFileExporter,
                        PrometheusHTTPExporter, parse_prometheus_text,
-                       to_prometheus_text)
+                       snapshot_metrics, to_prometheus_text)
+from .flight import (FlightRecorder, dump_on_exception, get_flight_recorder,
+                     install_flight_recorder)
 from .mfu import (PEAK_BF16_FLOPS, mfu, peak_flops_for_device,
                   peak_flops_for_kind)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, get_registry, set_registry)
+from .spans import (SpanRecorder, begin_span, configure_spans, end_span,
+                    get_span_recorder, record_event, set_span_recorder, span,
+                    trace_dump)
 from .tracing import (PhaseTimer, annotate, profiler_available, step_trace)
 from .watchdog import StallWatchdog
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
     "get_registry", "set_registry",
-    "to_prometheus_text", "parse_prometheus_text",
+    "to_prometheus_text", "parse_prometheus_text", "snapshot_metrics",
     "PrometheusFileExporter", "PrometheusHTTPExporter", "JSONLWriter",
     "step_trace", "annotate", "PhaseTimer", "profiler_available",
+    "SpanRecorder", "span", "begin_span", "end_span", "record_event",
+    "trace_dump", "get_span_recorder", "set_span_recorder", "configure_spans",
+    "FlightRecorder", "get_flight_recorder", "install_flight_recorder",
+    "dump_on_exception",
+    "RecompileSentinel", "expect_recompile", "compile_counts",
     "PEAK_BF16_FLOPS", "peak_flops_for_kind", "peak_flops_for_device", "mfu",
     "StallWatchdog", "Telemetry",
 ]
@@ -43,10 +55,13 @@ class Telemetry:
     reporting cadence and ``close()`` at teardown.
 
     Holds: the registry (shared process default unless injected), the
-    optional Prometheus file/HTTP exporters, the optional JSONL log, and
-    the stall watchdog.  All parts are individually optional — an empty
-    config block yields a registry-only session (metrics still
-    collectable by ``tools/telemetry_dump.py`` or a monitor fan-out)."""
+    optional Prometheus file/HTTP exporters, the optional JSONL log, the
+    stall watchdog, and the timeline side — span-ring configuration, the
+    flight recorder (installed as the process recorder so exception
+    paths and the watchdog can dump), and the recompilation sentinel.
+    All parts are individually optional — an empty config block yields a
+    registry-only session (metrics still collectable by
+    ``tools/telemetry_dump.py`` or a monitor fan-out)."""
 
     def __init__(self, config=None, loop: str = "train",
                  registry: Optional[MetricsRegistry] = None):
@@ -57,6 +72,8 @@ class Telemetry:
         self.prom_file: Optional[PrometheusFileExporter] = None
         self.prom_http: Optional[PrometheusHTTPExporter] = None
         self.watchdog: Optional[StallWatchdog] = None
+        self.flight: Optional[FlightRecorder] = None
+        self.sentinel: Optional[RecompileSentinel] = None
         self.export_interval = 1
         self.trace_annotations = True
         self._last_export: Optional[int] = None
@@ -72,11 +89,33 @@ class Telemetry:
         if getattr(config, "prometheus_port", 0):
             self.prom_http = PrometheusHTTPExporter(
                 port=config.prometheus_port, registry=self.registry).start()
+        sp = getattr(config, "spans", None)
+        if sp is not None:
+            configure_spans(enabled=sp.enabled, ring_size=sp.ring_size,
+                            profiler_annotations=sp.profiler_annotations)
+        fr = getattr(config, "flight_recorder", None)
+        if fr is not None and getattr(fr, "enabled", False):
+            self.flight = FlightRecorder(path=fr.path, max_events=fr.events,
+                                         registry=self.registry)
+            install_flight_recorder(self.flight)
+        rs = getattr(config, "recompile_sentinel", None)
+        if rs is not None and getattr(rs, "enabled", False):
+            self.sentinel = RecompileSentinel(
+                loop=loop, registry=self.registry,
+                steady_after=rs.steady_after)
         wd = getattr(config, "stall_watchdog", None)
         if wd is not None and getattr(wd, "enabled", False):
             self.watchdog = StallWatchdog(multiple=wd.multiple,
                                           window=wd.window, name=loop,
-                                          registry=self.registry)
+                                          registry=self.registry,
+                                          on_stall=self._on_stall)
+
+    def _on_stall(self, name: str, step, ratio: float) -> None:
+        """Watchdog incident edge -> flight-recorder dump (black box for
+        a run that is wedging rather than crashing)."""
+        if self.flight is not None:
+            self.flight.note("stall", loop=name, step=step, ratio=ratio)
+            self.flight.dump(reason=f"watchdog:{name}")
 
     def step_trace(self, step_num: int):
         """Profiler step annotation (no-op context when disabled)."""
@@ -105,10 +144,13 @@ class Telemetry:
                     and step - self._last_export < self.export_interval):
                 return
         self._last_export = step
-        if self.prom_file is not None:
-            self.prom_file.write()
-        if self.jsonl is not None:
-            self.jsonl.emit_snapshot(self.registry, step=step)
+        if self.prom_file is None and self.jsonl is None:
+            return
+        with span("telemetry_export", step=step):
+            if self.prom_file is not None:
+                self.prom_file.write()
+            if self.jsonl is not None:
+                self.jsonl.emit_snapshot(self.registry, step=step)
 
     def close(self) -> None:
         for part in (self.prom_file, self.prom_http, self.jsonl):
@@ -117,3 +159,7 @@ class Telemetry:
                     part.close()
                 except Exception:
                     pass
+        # release the process flight-recorder slot if it is ours (a later
+        # engine's Telemetry installs its own)
+        if self.flight is not None and get_flight_recorder() is self.flight:
+            install_flight_recorder(None)

@@ -188,6 +188,28 @@ class PrometheusHTTPExporter:
 # --------------------------------------------------------------------------
 # JSONL event log
 # --------------------------------------------------------------------------
+def snapshot_metrics(registry: Optional[MetricsRegistry] = None) -> Dict[str, list]:
+    """Registry contents as one JSON-safe dict: counters/gauges as
+    values, histograms as ``{count, sum, p50, p95, p99}`` per label-set.
+    Shared by ``JSONLWriter.emit_snapshot`` and the flight recorder."""
+    registry = registry or get_registry()
+    metrics: Dict[str, list] = {}
+    for m in registry.collect():
+        rows = []
+        if isinstance(m, Histogram):
+            for k, s in m.series():
+                if s.count == 0:
+                    continue
+                rows.append({"labels": dict(k), "count": s.count,
+                             "sum": s.sum, **m.percentiles(**dict(k))})
+        else:
+            for k, v in m.series():
+                rows.append({"labels": dict(k), "value": v})
+        if rows:
+            metrics[m.name] = rows
+    return metrics
+
+
 class JSONLWriter:
     """Append-only JSON-lines event log with an explicit flush per emit."""
 
@@ -208,22 +230,8 @@ class JSONLWriter:
                       step: Optional[int] = None) -> None:
         """Full registry dump: counters/gauges as values, histograms as
         ``{count, sum, p50, p95, p99}`` per label-set."""
-        registry = registry or get_registry()
-        metrics: Dict[str, list] = {}
-        for m in registry.collect():
-            rows = []
-            if isinstance(m, Histogram):
-                for k, s in m.series():
-                    if s.count == 0:
-                        continue
-                    rows.append({"labels": dict(k), "count": s.count,
-                                 "sum": s.sum, **m.percentiles(**dict(k))})
-            else:
-                for k, v in m.series():
-                    rows.append({"labels": dict(k), "value": v})
-            if rows:
-                metrics[m.name] = rows
-        rec = {"kind": "snapshot", "ts": time.time(), "metrics": metrics}
+        rec = {"kind": "snapshot", "ts": time.time(),
+               "metrics": snapshot_metrics(registry)}
         if step is not None:
             rec["step"] = int(step)
         self._write(rec)

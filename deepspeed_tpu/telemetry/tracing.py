@@ -73,21 +73,27 @@ def stop_trace() -> None:
 
 
 class PhaseTimer:
-    """Context manager that both annotates a phase for the profiler and
-    reports its host wall time to a callback (usually a histogram
-    ``observe``)."""
+    """Context manager that annotates a phase for the profiler, reports
+    its host wall time to a callback (usually a histogram ``observe``),
+    and records the range as a span (cat ``phase``) in the trace ring —
+    one context, three sinks.  ``attrs`` ride on the span only."""
 
-    def __init__(self, name: str, sink=None):
+    def __init__(self, name: str, sink=None, **attrs):
         self.name = name
         self.sink = sink
+        self.attrs = attrs
         self._ann = None
         self._t0: Optional[float] = None
+        self._t0_us: float = 0.0
 
     def __enter__(self):
         import time
 
         self._ann = annotate(self.name)
         self._ann.__enter__()
+        from .spans import _now_us
+
+        self._t0_us = _now_us()
         self._t0 = time.perf_counter()
         return self
 
@@ -98,4 +104,10 @@ class PhaseTimer:
         self._ann.__exit__(*exc)
         if self.sink is not None:
             self.sink(self.name, dt)
+        from .spans import get_span_recorder
+
+        rec = get_span_recorder()
+        if rec.enabled:
+            rec.record(self.name, self._t0_us, dt * 1e6, cat="phase",
+                       **self.attrs)
         return False

@@ -21,12 +21,18 @@ from .registry import MetricsRegistry, get_registry
 class StallWatchdog:
     def __init__(self, multiple: float = 3.0, window: int = 32,
                  min_samples: int = 5, name: str = "train",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 on_stall=None):
         if multiple <= 1.0:
             raise ValueError(f"stall multiple must be > 1, got {multiple}")
         self.multiple = float(multiple)
         self.min_samples = int(min_samples)
         self.name = name
+        #: ``(name, step, ratio)`` callback fired once per incident edge
+        #: (with the log line, not per slow step) — how a stall reaches
+        #: the flight recorder.  Exceptions are swallowed: a broken sink
+        #: must not turn a slow step into a dead run.
+        self.on_stall = on_stall
         self._times = collections.deque(maxlen=int(window))
         self._in_stall = False
         reg = registry or get_registry()
@@ -59,6 +65,12 @@ class StallWatchdog:
                         f"{'' if step is None else ' ' + str(step)} took "
                         f"{step_time_s * 1e3:.1f}ms, {ratio:.1f}x the "
                         f"rolling median ({med * 1e3:.1f}ms)")
+                    if self.on_stall is not None:
+                        try:
+                            self.on_stall(self.name, step, ratio)
+                        except Exception as e:
+                            logger.error(f"stall watchdog [{self.name}]: "
+                                         f"on_stall callback failed: {e}")
                 self._in_stall = True
             else:
                 self._in_stall = False

@@ -33,8 +33,22 @@ def _create_logger(name: str = "DeepSpeedTPU", level: int = logging.INFO) -> log
     return lg
 
 
-logger = _create_logger(
-    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO))
+#: level env override, in priority order: the spelled-out name first,
+#: then the short historical one.  Values are the ``log_levels`` names
+#: ("debug" ... "critical", case-insensitive); unknown values fall back
+#: to info rather than failing an import.
+LEVEL_ENVS = ("DEEPSPEED_TPU_LOG_LEVEL", "DSTPU_LOG_LEVEL")
+
+
+def _env_log_level(default: int = logging.INFO) -> int:
+    for name in LEVEL_ENVS:
+        v = os.environ.get(name)
+        if v:
+            return log_levels.get(v.strip().lower(), default)
+    return default
+
+
+logger = _create_logger(level=_env_log_level())
 
 
 def _process_index() -> int:

@@ -17,6 +17,7 @@ from deepspeed_tpu.linear.optimized_linear import (LoRAConfig, init_lora_linear,
                                                    lora_linear,
                                                    trainable_lora_params)
 from deepspeed_tpu.parallel.mesh import DATA_AXIS, MeshTopology
+from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.runtime.comm.compressed import compressed_all_reduce
 from deepspeed_tpu.runtime.config import MeshConfig
 from deepspeed_tpu.runtime.data_pipeline.curriculum import (
@@ -31,9 +32,9 @@ def test_compressed_allreduce_error_feedback(devices8):
     def body(g, e):
         return compressed_all_reduce(g, e, DATA_AXIS)
 
-    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh,
-                      in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
-                      out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)))
+    f = shard_map(body, check_vma=False, mesh=topo.mesh,
+                  in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                  out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)))
     rng = np.random.RandomState(0)
     g = jnp.asarray(rng.randn(8, 256).astype(np.float32))
     e = jnp.zeros_like(g)

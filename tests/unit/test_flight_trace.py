@@ -1,0 +1,442 @@
+"""Span tracing, flight recorder, and recompile sentinel tests.
+
+Covers: the span ring + Chrome-trace schema (the Perfetto-required
+``ph/ts/dur/pid/tid/name`` keys), cross-step begin/end spans, the
+flight recorder's JSONL dump (manual, watchdog-trip, and
+exception-in-step triggers), recompile-counter semantics on a forced
+shape change (monitoring and shape-fallback modes, steady-state
+detection), TTFT/TPOT histogram wiring in ``InferenceEngineV2``, and
+the log-level env override.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                     RecompileSentinel, SpanRecorder,
+                                     get_span_recorder,
+                                     install_flight_recorder,
+                                     set_span_recorder, trace_dump)
+
+TRACE_EVENT_KEYS = ("ph", "ts", "dur", "pid", "tid", "name")
+
+
+@pytest.fixture
+def fresh_spans():
+    """Install a fresh default span recorder; restore the old one."""
+    old = get_span_recorder()
+    rec = SpanRecorder(ring_size=256)
+    set_span_recorder(rec)
+    yield rec
+    set_span_recorder(old)
+
+
+@pytest.fixture
+def fresh_registry():
+    """Install a fresh default registry so engines constructed here do
+    not pollute the shared process registry other tests assert absolute
+    counts against (and vice versa)."""
+    from deepspeed_tpu.telemetry import get_registry, set_registry
+
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+# ----------------------------- span ring + Chrome schema --------------------
+def test_chrome_trace_schema_round_trip(tmp_path, fresh_spans):
+    rec = fresh_spans
+    with rec.span("loading", cat="demo", shard=3):
+        pass
+    h = rec.begin("request", cat="serve", uid=7)
+    rec.event("admit", cat="serve", uid=7, cache_hit_pages=2)
+    rec.end(h, generated=5)
+
+    path = trace_dump(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    assert "traceEvents" in doc and doc["displayTimeUnit"] == "ms"
+    events = doc["traceEvents"]
+    assert len(events) == 3
+    for ev in events:
+        for k in TRACE_EVENT_KEYS:
+            assert k in ev, f"missing Perfetto key {k} in {ev}"
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    by_name = {ev["name"]: ev for ev in events}
+    assert by_name["loading"]["args"]["shard"] == 3
+    assert by_name["admit"]["dur"] == 0.0  # point event
+    req = by_name["request"]
+    assert req["args"]["uid"] == 7 and req["args"]["generated"] == 5
+    # the request began before the admit event and spans past it
+    assert req["ts"] <= by_name["admit"]["ts"] <= req["ts"] + req["dur"]
+
+
+def test_span_ring_is_bounded_and_togglable():
+    rec = SpanRecorder(ring_size=32)
+    for i in range(100):
+        rec.event("tick", i=i)
+    spans = rec.spans()
+    assert len(spans) == 32
+    assert rec.dropped == 100 - 32
+    assert spans[-1].attrs["i"] == 99  # newest kept, oldest dropped
+    rec.configure(enabled=False)
+    rec.event("tock")
+    with rec.span("quiet"):
+        pass
+    assert len(rec.spans()) == 32  # nothing recorded while disabled
+    assert rec.begin("open") is None
+    rec.end(None)  # no-op, not a crash
+    rec.clear()
+    assert rec.spans() == [] and rec.dropped == 0
+
+
+def test_phase_timer_records_span(fresh_spans):
+    from deepspeed_tpu.telemetry.tracing import PhaseTimer
+
+    seen = []
+    with PhaseTimer("decode", sink=lambda n, dt: seen.append((n, dt)),
+                    batch=4):
+        pass
+    assert len(seen) == 1 and seen[0][0] == "decode"
+    spans = fresh_spans.spans()
+    assert len(spans) == 1
+    sp = spans[0]
+    assert sp.name == "decode" and sp.cat == "phase"
+    assert sp.attrs["batch"] == 4
+    assert sp.dur_us == pytest.approx(seen[0][1] * 1e6, rel=0.5)
+
+
+# ----------------------------- flight recorder ------------------------------
+def test_flight_dump_contents(tmp_path, fresh_spans):
+    reg = MetricsRegistry()
+    reg.gauge("deepspeed_tpu_t_flight_v").set(2.5)
+    fr = FlightRecorder(path=str(tmp_path), max_events=16, registry=reg)
+    with fresh_spans.span("step", step=3):
+        pass
+    fr.note("loss_spike", step=3, loss=9.9)
+    path = fr.dump(reason="manual")
+    recs = [json.loads(line) for line in open(path)]
+    assert recs[0]["kind"] == "flight_header"
+    assert recs[0]["reason"] == "manual" and recs[0]["spans"] == 1
+    kinds = [r["kind"] for r in recs]
+    assert kinds.count("span") == 1 and kinds.count("log") == 1
+    sp = next(r for r in recs if r["kind"] == "span")
+    assert sp["name"] == "step" and sp["args"]["step"] == 3
+    log = next(r for r in recs if r["kind"] == "log")
+    assert log["name"] == "loss_spike" and log["loss"] == 9.9
+    snap = recs[-1]
+    assert snap["kind"] == "snapshot"
+    assert snap["metrics"]["deepspeed_tpu_t_flight_v"][0]["value"] == 2.5
+    # the dump itself is counted (trigger = text before the colon)
+    assert reg.get("deepspeed_tpu_flight_dumps_total").value(
+        trigger="manual") == 1
+    # log-event ring is bounded
+    for i in range(40):
+        fr.note("e", i=i)
+    recs = [json.loads(line) for line in open(fr.dump(reason="again"))]
+    assert sum(1 for r in recs if r["kind"] == "log") == 16
+
+
+def test_watchdog_trip_dumps_flight(tmp_path, fresh_spans):
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import Telemetry
+
+    cfg = TelemetryConfig.from_dict({
+        "enabled": True,
+        "flight_recorder": {"path": str(tmp_path / "fl")},
+        "stall_watchdog": {"enabled": True, "multiple": 2.0, "window": 8},
+    })
+    tm = Telemetry(cfg, loop="train", registry=MetricsRegistry())
+    try:
+        for step in range(6):
+            assert not tm.observe_step_time(0.01, step)
+        assert tm.observe_step_time(1.0, step=6)  # 100x the median: stall
+        dumps = list((tmp_path / "fl").glob("flight_*watchdog*.jsonl"))
+        assert len(dumps) == 1
+        recs = [json.loads(line) for line in open(dumps[0])]
+        assert recs[0]["reason"] == "watchdog:train"
+        # the stall note itself rode along in the event ring
+        assert any(r.get("name") == "stall" and r.get("step") == 6
+                   for r in recs if r["kind"] == "log")
+        # sustained stall: no second dump until the incident clears
+        tm.observe_step_time(1.0, step=7)
+        assert len(list((tmp_path / "fl").glob("flight_*.jsonl"))) == 1
+    finally:
+        tm.close()
+
+
+def test_exception_in_train_step_dumps(tmp_path, fresh_spans, monkeypatch, fresh_registry):
+    import deepspeed_tpu
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "telemetry": {"enabled": True,
+                              "flight_recorder": {"path": str(tmp_path)}}})
+    try:
+        engine.train_batch(random_batch(batch_size=4, gas=1, seed=0))
+
+        def boom(*a, **k):
+            raise RuntimeError("device on fire")
+
+        monkeypatch.setattr(engine, "_train_batch", boom)
+        with pytest.raises(RuntimeError, match="device on fire"):
+            engine.train_batch(random_batch(batch_size=4, gas=1, seed=1))
+        dumps = list(tmp_path.glob("flight_*exception*.jsonl"))
+        assert len(dumps) == 1
+        recs = [json.loads(line) for line in open(dumps[0])]
+        assert recs[0]["reason"] == "exception:engine.train_batch"
+        # the black box carries the healthy step's span and a snapshot
+        assert any(r["kind"] == "span" and r["name"] == "train_batch"
+                   for r in recs)
+        assert recs[-1]["kind"] == "snapshot" and recs[-1]["metrics"]
+    finally:
+        engine.close()
+
+
+def test_exception_in_serving_step_dumps(tmp_path, fresh_spans, monkeypatch, fresh_registry):
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceConfig,
+                                                      RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+
+    fr = FlightRecorder(path=str(tmp_path), registry=MetricsRegistry())
+    install_flight_recorder(fr)
+    try:
+        eng = InferenceEngineV2(
+            llama_model("tiny", max_seq_len=64),
+            RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=16,
+                                  max_seqs=2, max_pages_per_seq=4))
+        eng.put(RaggedRequest(prompt_ids=[1, 2, 3], max_new_tokens=2))
+
+        def boom():
+            raise RuntimeError("kv pool corrupt")
+
+        monkeypatch.setattr(eng, "_step_impl", boom)
+        with pytest.raises(RuntimeError, match="kv pool corrupt"):
+            eng.step()
+        dumps = list(tmp_path.glob("flight_*exception*.jsonl"))
+        assert len(dumps) == 1
+        assert json.loads(open(dumps[0]).readline())["reason"] == \
+            "exception:engine_v2.step"
+    finally:
+        install_flight_recorder(None)
+
+
+# ----------------------------- recompile sentinel ---------------------------
+def test_recompile_counter_on_forced_shape_change(fresh_spans):
+    from deepspeed_tpu.compile.backend import shape_signature
+
+    reg = MetricsRegistry()
+    s = RecompileSentinel(loop="t1", registry=reg, steady_after=3)
+    f = jax.jit(lambda x: x * 2 + 1)
+
+    x3 = jnp.asarray(np.ones(3, np.float32))
+    f(x3).block_until_ready()
+    sig3 = shape_signature(x3)
+    assert s.observe_step([("f", sig3)], step=0)  # first compile: expected
+    for step in range(1, 4):
+        f(x3).block_until_ready()
+        assert not s.observe_step([("f", sig3)], step=step)  # cache hits
+    assert s.recompiles == 1
+
+    # forced shape change: exactly one more recompiled step, not flagged
+    # as steady-state (the signature component is new)
+    x5 = jnp.asarray(np.ones(5, np.float32))
+    f(x5).block_until_ready()
+    assert s.observe_step([("f", shape_signature(x5))], step=4)
+    assert s.recompiles == 2
+    assert s.steady_recompiles == 0
+    # the recompile left a point event in the trace ring
+    names = [sp.name for sp in fresh_spans.spans()]
+    assert "recompile" in names
+
+
+def test_recompile_sentinel_steady_state_warn(fresh_spans, caplog):
+    reg = MetricsRegistry()
+    s = RecompileSentinel(loop="t2", registry=reg, steady_after=2)
+    sig = [("step", ((4,), "float32"))]
+    x = jnp.asarray(np.ones(4, np.float32))
+    f = jax.jit(lambda v: v + 1)
+    f(x).block_until_ready()
+    s.observe_step(sig, step=0)
+    for step in range(1, 4):  # steady: no compiles, same signature
+        s.observe_step(sig, step=step)
+    if not s.monitoring:
+        pytest.skip("jax.monitoring unavailable: steady-state recompiles "
+                    "are not detectable in fallback mode")
+    # a compile fires with UNCHANGED shapes after >= steady_after steps
+    g = jax.jit(lambda v: v - 1)
+    g(x).block_until_ready()
+    assert s.observe_step(sig, step=4)
+    assert s.steady_recompiles == 1
+    # the WORST pathology — recompiling every step with unchanged shapes
+    # — must keep counting (the steady window tracks steps since the
+    # last shape change, not since the last recompile)
+    g2 = jax.jit(lambda v: v * 5)
+    g2(x).block_until_ready()
+    assert s.observe_step(sig, step=5)
+    assert s.steady_recompiles == 2
+    # an ANNOUNCED re-jit with the same signature is not flagged
+    h = jax.jit(lambda v: v * 3)
+    for step in range(6, 9):
+        s.observe_step(sig, step=step)
+    s.expect_recompile("test_rebuild")
+    h(x).block_until_ready()
+    assert s.observe_step(sig, step=9)
+    assert s.steady_recompiles == 2
+
+
+def test_recompile_single_attribution_across_sentinels(fresh_spans):
+    """Compiles are a process-wide stream: the first observing sentinel
+    claims them; a co-located loop must not count the same compile."""
+    reg = MetricsRegistry()
+    a = RecompileSentinel(loop="ta", registry=reg, steady_after=99)
+    b = RecompileSentinel(loop="tb", registry=reg, steady_after=99)
+    if not a.monitoring:
+        pytest.skip("jax.monitoring unavailable: claim path inactive")
+    a.observe_step(["drain"], step=-1)  # absorb any stray compiles
+    a0, b0 = a.recompiles, b.recompiles
+    x = jnp.asarray(np.ones(6, np.float32))
+    f = jax.jit(lambda v: v + 7)
+    f(x).block_until_ready()
+    assert a.observe_step(["p"], step=0)      # first observer claims it
+    assert not b.observe_step(["p"], step=0)  # nothing left to claim
+    assert a.recompiles - a0 == 1 and b.recompiles - b0 == 0
+
+
+def test_recompile_sentinel_shape_fallback():
+    """Without jax.monitoring, a never-seen signature counts as the
+    compile signal (compile/backend.py arg-shape fallback)."""
+    reg = MetricsRegistry()
+    s = RecompileSentinel(loop="t3", registry=reg, steady_after=2)
+    s.monitoring = False  # force the fallback path
+    assert s.observe_step([("p", (8,))], step=0)
+    assert not s.observe_step([("p", (8,))], step=1)
+    assert s.observe_step([("p", (16,))], step=2)  # new bucket
+    assert not s.observe_step([("p", (8,)), ("p", (16,))], step=3)  # both seen
+    assert s.recompiles == 2 and s.steady_recompiles == 0
+
+
+# ----------------------------- serving TTFT/TPOT + request spans ------------
+def test_engine_v2_ttft_tpot_and_request_spans(fresh_spans, fresh_registry):
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceConfig,
+                                                      RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    ttft = reg.histogram("deepspeed_tpu_serving_ttft_seconds")
+    tpot = reg.histogram("deepspeed_tpu_serving_tpot_seconds")
+    ttft0, tpot0 = ttft.count(), tpot.count()
+
+    model = llama_model("tiny", max_seq_len=64)
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=16, max_seqs=2,
+        max_pages_per_seq=4))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, model.config.vocab_size, 9).tolist()
+               for _ in range(2)]
+    got = eng.generate_all([RaggedRequest(prompt_ids=p, max_new_tokens=3)
+                            for p in prompts])
+    assert all(len(v) == 3 for v in got.values())
+
+    assert ttft.count() - ttft0 == 2  # one TTFT per request
+    assert tpot.count() - tpot0 == 2  # >1 token each -> one TPOT each
+    assert ttft.sum() > 0 and tpot.sum() >= 0
+    # request spans closed with the generation count; admit events inside
+    spans = fresh_spans.spans()
+    reqs = [sp for sp in spans if sp.name == "request"]
+    assert len(reqs) == 2
+    assert all(sp.attrs["generated"] == 3 for sp in reqs)
+    admits = [sp for sp in spans if sp.name == "admit"]
+    assert len(admits) == 2 and all(sp.dur_us == 0.0 for sp in admits)
+    assert {sp.attrs["uid"] for sp in reqs} == \
+        {sp.attrs["uid"] for sp in admits}
+    assert eng._req_meta == {}  # all lifecycle state reclaimed
+
+
+# ----------------------------- satellites -----------------------------------
+def test_log_level_env_override(monkeypatch):
+    import logging
+
+    from deepspeed_tpu.utils.logging import _env_log_level
+
+    monkeypatch.delenv("DEEPSPEED_TPU_LOG_LEVEL", raising=False)
+    monkeypatch.delenv("DSTPU_LOG_LEVEL", raising=False)
+    assert _env_log_level() == logging.INFO
+    monkeypatch.setenv("DSTPU_LOG_LEVEL", "warning")
+    assert _env_log_level() == logging.WARNING
+    # the spelled-out name wins over the short one
+    monkeypatch.setenv("DEEPSPEED_TPU_LOG_LEVEL", "debug")
+    assert _env_log_level() == logging.DEBUG
+    monkeypatch.setenv("DEEPSPEED_TPU_LOG_LEVEL", "not-a-level")
+    assert _env_log_level() == logging.INFO
+
+
+def test_log_dist_carries_rank(caplog):
+    from deepspeed_tpu.utils.logging import log_dist, logger
+
+    logger.propagate = True
+    try:
+        with caplog.at_level("INFO", logger="DeepSpeedTPU"):
+            log_dist("attributable message", ranks=[-1])
+    finally:
+        logger.propagate = False
+    assert any("[Rank 0] attributable message" in r.message
+               for r in caplog.records)
+
+
+def test_flops_profiler_publishes_gauges(monkeypatch, fresh_registry):
+    import deepspeed_tpu
+    from deepspeed_tpu.telemetry import get_registry
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "flops_profiler": {"enabled": True, "profile_step": 1}})
+    try:
+        for i in range(2):
+            engine.train_batch(random_batch(batch_size=4, gas=1, seed=i))
+        reg = get_registry()
+        assert reg.get("deepspeed_tpu_profile_params").value() > 0
+        assert reg.get("deepspeed_tpu_profile_flops_per_micro_step").value() > 0
+        assert reg.get("deepspeed_tpu_profile_achieved_tflops").value() >= 0
+    finally:
+        engine.close()
+
+
+def test_engine_close_emits_comms_summary(monkeypatch, fresh_registry):
+    import deepspeed_tpu
+    from deepspeed_tpu import comm
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "comms_logger": {"enabled": True}})
+    cl = comm.get_comms_logger()
+    cl.append("all_reduce", "data", 4096)  # give the summary content
+    calls = []
+    monkeypatch.setattr(type(cl), "log_summary",
+                        lambda self, **kw: calls.append(kw) or "")
+    engine.train_batch(random_batch(batch_size=4, gas=1, seed=0))
+    engine.close()
+    engine.close()  # idempotent: summary exactly once
+    assert len(calls) == 1
+    assert calls[0]["axis_sizes"] == engine.topology.axis_sizes

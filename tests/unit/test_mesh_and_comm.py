@@ -7,6 +7,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import deepspeed_tpu.comm as comm
+from deepspeed_tpu.utils.jax_compat import shard_map
 from deepspeed_tpu.runtime.config import MeshConfig
 from deepspeed_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, MeshTopology,
                                          SEQ_AXIS)
@@ -32,8 +33,8 @@ def test_all_reduce_psum(devices8):
     def body(x):
         return comm.all_reduce(x, "sum", DATA_AXIS)
 
-    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS),
-                  out_specs=P(DATA_AXIS))
+    f = shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS),
+              out_specs=P(DATA_AXIS))
     x = jnp.arange(8.0)
     out = f(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
@@ -45,8 +46,8 @@ def test_all_gather_and_reduce_scatter(devices8):
     def gather_body(x):
         return comm.all_gather(x, DATA_AXIS, tensor_axis=0)
 
-    f = jax.shard_map(gather_body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS, None),
-                  out_specs=P(None, None))
+    f = shard_map(gather_body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS, None),
+              out_specs=P(None, None))
     x = jnp.arange(16.0).reshape(8, 2)
     out = f(x)
     # per-rank result is the full (8, 2); replicated -> global (8, 2)
@@ -56,8 +57,8 @@ def test_all_gather_and_reduce_scatter(devices8):
     def rs_body(x):
         return comm.reduce_scatter(x, "sum", DATA_AXIS, scatter_dim=0)
 
-    g = jax.shard_map(rs_body, check_vma=False, mesh=topo.mesh, in_specs=P(None, None),
-                  out_specs=P(DATA_AXIS, None))
+    g = shard_map(rs_body, check_vma=False, mesh=topo.mesh, in_specs=P(None, None),
+              out_specs=P(DATA_AXIS, None))
     y = jnp.ones((8, 2))
     out = g(y)
     np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 8.0))
@@ -70,8 +71,8 @@ def test_all_to_all(devices8):
         # x per-rank: [seq_shard, heads] -> [full seq, heads/ranks]
         return comm.all_to_all_single(x, SEQ_AXIS, split_dim=1, concat_dim=0)
 
-    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(SEQ_AXIS, None),
-                  out_specs=P(None, SEQ_AXIS))
+    f = shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(SEQ_AXIS, None),
+              out_specs=P(None, SEQ_AXIS))
     x = jnp.arange(64.0).reshape(8, 8)
     out = f(x)
     assert out.shape == (8, 8)
@@ -79,8 +80,8 @@ def test_all_to_all(devices8):
     def inv(x):
         return comm.all_to_all_single(x, SEQ_AXIS, split_dim=0, concat_dim=1)
 
-    finv = jax.shard_map(inv, check_vma=False, mesh=topo.mesh, in_specs=P(None, SEQ_AXIS),
-                     out_specs=P(SEQ_AXIS, None))
+    finv = shard_map(inv, check_vma=False, mesh=topo.mesh, in_specs=P(None, SEQ_AXIS),
+                 out_specs=P(SEQ_AXIS, None))
     np.testing.assert_allclose(np.asarray(finv(out)), np.asarray(x))
 
 
@@ -90,7 +91,7 @@ def test_broadcast(devices8):
     def body(x):
         return comm.broadcast(x, src_index=3, axis=DATA_AXIS)
 
-    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
+    f = shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
     x = jnp.arange(8.0)
     out = f(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
@@ -102,7 +103,7 @@ def test_ppermute_ring(devices8):
     def body(x):
         return comm.send_recv_next(x, "pipe")
 
-    f = jax.shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P("pipe"), out_specs=P("pipe"))
+    f = shard_map(body, check_vma=False, mesh=topo.mesh, in_specs=P("pipe"), out_specs=P("pipe"))
     x = jnp.arange(8.0)
     out = np.asarray(f(x))
     np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
@@ -112,8 +113,8 @@ def test_comms_logger(devices8):
     logger = comm.configure_comms_logger(enabled=True)
     logger.reset()
     topo = MeshTopology(MeshConfig(data=-1), devices8)
-    f = jax.shard_map(lambda x: comm.all_reduce(x, "sum", DATA_AXIS), check_vma=False,
-                      mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
+    f = shard_map(lambda x: comm.all_reduce(x, "sum", DATA_AXIS), check_vma=False,
+                  mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
     f(jnp.arange(8.0))
     assert "all_reduce" in logger.comms_dict
     logger.configure(enabled=False)
@@ -143,8 +144,8 @@ def test_p2p_send_recv_edge(devices8):
         return comm.send(x, src=2, dst=5, axis="pipe")
 
     x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1) + 1.0  # rank r holds r+1
-    fn = jax.shard_map(body, mesh=topo.mesh, in_specs=P("pipe", None),
-                       out_specs=P("pipe", None), check_vma=False)
+    fn = shard_map(body, mesh=topo.mesh, in_specs=P("pipe", None),
+                   out_specs=P("pipe", None), check_vma=False)
     out = np.asarray(fn(x)).ravel()
     assert out[5] == 3.0, out  # src rank 2 held value 3.0
     assert out[2] == 0.0 and out[0] == 0.0

@@ -107,7 +107,7 @@ def test_row_column_parallel_numerics(devices8):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
     # explicit shard_map form
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     body = shard_map(
         lambda x, w1, b1, w2, b2: row_parallel_explicit(

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static metric-name lint.
+"""Static metric- and span-name lint.
 
 AST-scans the package (``deepspeed_tpu/`` + ``tools/``) for metric
 registrations — ``<registry>.counter/gauge/histogram("name", ...)`` calls
@@ -16,6 +16,17 @@ string-literal first argument — and enforces:
 3. One name, one type: the same name must not appear as two different
    metric types anywhere.
 
+It also scans span/event recordings — ``span("name", ...)``,
+``begin_span("name", ...)``, ``record_event("name", ...)`` with a
+string-literal first argument (``telemetry/spans.py``) — and enforces
+the matching rules for the trace namespace:
+
+4. ``snake_case`` WITHOUT the ``deepspeed_tpu_`` prefix (that namespace
+   belongs to metrics; a prefixed span name would alias a metric family
+   in dashboards that join the two artifacts).
+5. Single owner: each literal span/event name is recorded from exactly
+   one call site (multi-site phases thread the name through a helper).
+
 Runs as a tier-1 test (``tests/unit/test_metric_names.py``) and stands
 alone: ``python tools/check_metric_names.py`` exits non-zero with a
 per-violation report.  No imports of the scanned code — pure AST, so it
@@ -31,12 +42,16 @@ import sys
 from typing import Dict, List, Tuple
 
 METRIC_NAME_RE = re.compile(r"^deepspeed_tpu_[a-z][a-z0-9_]*$")
+SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 _METHODS = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}
 _CTORS = {"Counter": "counter", "Gauge": "gauge", "Histogram": "histogram"}
+_SPAN_FNS = {"span": "span", "begin_span": "span", "record_event": "event"}
 
 #: registration sites that define the generic machinery itself, not a metric
 _EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "registry.py")}
+#: span sites that define the span machinery itself, not a span
+_SPAN_EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "spans.py")}
 
 Site = Tuple[str, int, str]  # (relpath, lineno, metric_type)
 
@@ -74,7 +89,36 @@ def _scan_file(path: str, rel: str) -> List[Tuple[str, Site]]:
     return out
 
 
-def collect(root: str) -> Dict[str, List[Site]]:
+def _scan_spans(path: str, rel: str) -> List[Tuple[str, Site]]:
+    """Span/event recordings: module-level ``span(...)`` /
+    ``begin_span(...)`` / ``record_event(...)`` calls (bare or via an
+    attribute, e.g. ``spans.record_event``) with a literal first arg."""
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        print(f"{rel}: syntax error during scan: {e}", file=sys.stderr)
+        return []
+    out: List[Tuple[str, Site]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+            continue
+        fn = None
+        if isinstance(node.func, ast.Name) and node.func.id in _SPAN_FNS:
+            fn = _SPAN_FNS[node.func.id]
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in _SPAN_FNS:
+            fn = _SPAN_FNS[node.func.attr]
+        if fn is None:
+            continue
+        out.append((first.value, (rel, node.lineno, fn)))
+    return out
+
+
+def _walk(root: str, scanner, exclude) -> Dict[str, List[Site]]:
     found: Dict[str, List[Site]] = {}
     for sub in ("deepspeed_tpu", "tools"):
         base = os.path.join(root, sub)
@@ -84,11 +128,19 @@ def collect(root: str) -> Dict[str, List[Site]]:
                     continue
                 path = os.path.join(dirpath, fn)
                 rel = os.path.relpath(path, root)
-                if rel in _EXCLUDE_FILES:
+                if rel in exclude:
                     continue
-                for name, site in _scan_file(path, rel):
+                for name, site in scanner(path, rel):
                     found.setdefault(name, []).append(site)
     return found
+
+
+def collect(root: str) -> Dict[str, List[Site]]:
+    return _walk(root, _scan_file, _EXCLUDE_FILES)
+
+
+def collect_spans(root: str) -> Dict[str, List[Site]]:
+    return _walk(root, _scan_spans, _SPAN_EXCLUDE_FILES)
 
 
 def check(root: str) -> List[str]:
@@ -109,6 +161,17 @@ def check(root: str) -> List[str]:
             errors.append(
                 f"{name!r} registered at {len(sites)} call sites ({where}): "
                 "each metric belongs to exactly one owner")
+    for name, sites in sorted(collect_spans(root).items()):
+        where = ", ".join(f"{f}:{ln}" for f, ln, _t in sites)
+        if not SPAN_NAME_RE.match(name) or name.startswith("deepspeed_tpu_"):
+            errors.append(
+                f"span {name!r} ({where}): span/event names are "
+                f"snake_case WITHOUT the 'deepspeed_tpu_' metric prefix")
+        if len(sites) > 1:
+            errors.append(
+                f"span {name!r} recorded at {len(sites)} call sites "
+                f"({where}): each span name belongs to exactly one owner "
+                "(thread the name through a helper for shared phases)")
     return errors
 
 
@@ -118,13 +181,15 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__)))
     errors = check(root)
     names = collect(root)
+    spans = collect_spans(root)
     if errors:
         print(f"check_metric_names: {len(errors)} violation(s) over "
-              f"{len(names)} metric name(s)")
+              f"{len(names)} metric name(s) + {len(spans)} span name(s)")
         for e in errors:
             print(f"  ERROR: {e}")
         return 1
-    print(f"check_metric_names: OK ({len(names)} metric names)")
+    print(f"check_metric_names: OK ({len(names)} metric names, "
+          f"{len(spans)} span names)")
     return 0
 
 

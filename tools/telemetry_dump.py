@@ -151,14 +151,10 @@ def _comms_demo(topology):
         r = comm.reduce_scatter(s, "sum", "data")
         return r + g[:r.shape[0]]
 
-    try:  # jax>=0.6 spells it jax.shard_map
-        smap = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
-                             out_specs=P("data"), check_vma=False)
-    except AttributeError:
-        from jax.experimental.shard_map import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
-        smap = shard_map(body, mesh=mesh, in_specs=P("data"),
-                         out_specs=P("data"), check_rep=False)
+    smap = shard_map(body, mesh=mesh, in_specs=P("data"),
+                     out_specs=P("data"), check_vma=False)
     np.asarray(jax.jit(smap)(x))
     return comm.get_comms_logger()
 

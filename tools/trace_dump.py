@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Span-trace / flight-recorder demo CLI.
+
+``--demo`` runs the timeline-observability path end-to-end on a tiny
+CPU model and writes BOTH artifacts:
+
+* a **Chrome-trace JSON** (``trace.json``, loadable in Perfetto /
+  ``chrome://tracing``) holding the demo's spans: training
+  ``train_batch`` phases, serving request lifecycles
+  (request/admit/prefill/decode), trace-time collective events, and
+  ``xla_compile`` spans from the recompilation sentinel's
+  ``jax.monitoring`` listener;
+* a **flight-recorder JSONL** (``flight/..jsonl``) with the final span
+  ring, recent log events, and a full registry snapshot — the black box
+  a crashed run would leave.
+
+It also forces ONE re-jit (a train step with a changed batch shape) and
+asserts the recompile counter moved by exactly one — the acceptance gate
+for step-attributed compile accounting.
+
+The output is ONE JSON summary line; exit status is non-zero when a
+required span family, Chrome-trace key, flight record, or the
+exactly-once recompile increment is missing.
+
+Knobs: ``--out DIR`` (default ./trace_demo), ``--steps N`` training
+steps (default 5), ``--serve-requests N`` (default 3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: every Chrome-trace event must carry these for Perfetto to load it
+TRACE_EVENT_KEYS = ("ph", "ts", "dur", "pid", "tid", "name")
+
+#: span families the demo must have produced
+REQUIRED_SPANS = ("train_batch", "prefill", "decode", "request")
+
+
+def _mlp_spec(hidden: int = 16, nlayers: int = 2):
+    """Tiny MLP ModelSpec (mirrors tests/unit/simple_model.py, which
+    tools must not import)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.module import ModelSpec
+
+    def init_params(rng):
+        keys = jax.random.split(rng, nlayers)
+        return {f"layer_{i}": {
+            "w": jax.random.normal(k, (hidden, hidden)) * 0.1,
+            "b": jnp.zeros((hidden,))} for i, k in enumerate(keys)}
+
+    def forward(params, x):
+        for i in range(nlayers):
+            layer = params[f"layer_{i}"]
+            x = x @ layer["w"] + layer["b"]
+            if i < nlayers - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return jnp.mean((forward(params, x) - y) ** 2)
+
+    return ModelSpec(init_params, loss_fn)
+
+
+def _train_demo(out_dir: str, steps: int):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=_mlp_spec(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "steps_per_print": 2,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "comms_logger": {"enabled": True},
+            "telemetry": {
+                "enabled": True,
+                "spans": {"ring_size": 2048},
+                "flight_recorder": {"path": os.path.join(out_dir, "flight")},
+                "recompile_sentinel": {"steady_after": 3},
+            },
+        })
+    hidden = 16
+    rng = np.random.RandomState(0)
+
+    def batch(bs):
+        x = rng.randn(bs, hidden).astype(np.float32)
+        y = x * 0.5
+        return (jnp.asarray(x[None]), jnp.asarray(y[None]))
+
+    B = engine.config.train_batch_size
+    for _ in range(steps):
+        engine.train_batch(batch(B))
+
+    # forced re-jit: a NEW batch shape retraces the fused step — the
+    # sentinel must attribute it as exactly ONE recompiled step
+    reg = engine.telemetry.registry
+    rc = reg.get("deepspeed_tpu_recompiles_total")
+    before = rc.value(loop="train")
+    engine.train_batch(batch(B + 2))
+    recompile_delta = rc.value(loop="train") - before
+    return engine, recompile_delta
+
+
+def _serving_demo(n_requests: int):
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceConfig,
+                                                      RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=128)
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        page_size=16, num_pages=64, max_seqs=4, max_pages_per_seq=8,
+        enable_prefix_cache=True))
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prefix = rng.randint(1, vocab, 32).tolist()
+    eng.generate_all([RaggedRequest(
+        prompt_ids=prefix + rng.randint(1, vocab, 8).tolist(),
+        max_new_tokens=4)])
+    eng.generate_all([RaggedRequest(
+        prompt_ids=prefix + rng.randint(1, vocab, 8).tolist(),
+        max_new_tokens=4) for _ in range(max(1, n_requests - 1))])
+    return eng
+
+
+def _verify_trace(path: str):
+    """Perfetto-loadability gate: the file parses, every event carries
+    the required keys with numeric ts/dur, and the demo's span families
+    are all present."""
+    problems = []
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    if not events:
+        problems.append("trace has no traceEvents")
+    for ev in events:
+        missing = [k for k in TRACE_EVENT_KEYS if k not in ev]
+        if missing:
+            problems.append(f"event {ev.get('name')!r} missing {missing}")
+            break
+        if ev["ph"] != "X" or not isinstance(ev["ts"], (int, float)) \
+                or not isinstance(ev["dur"], (int, float)):
+            problems.append(f"event {ev.get('name')!r} malformed: "
+                            f"ph={ev['ph']!r} ts={ev['ts']!r}")
+            break
+    names = {ev.get("name") for ev in events}
+    missing_spans = [s for s in REQUIRED_SPANS if s not in names]
+    if missing_spans:
+        problems.append(f"missing span families: {missing_spans}")
+    return len(events), sorted(n for n in names if n), problems
+
+
+def _verify_flight(path: str):
+    """The black box holds the final spans + a registry snapshot."""
+    problems = []
+    recs = [json.loads(line) for line in open(path)]
+    kinds = [r.get("kind") for r in recs]
+    if not recs or kinds[0] != "flight_header":
+        problems.append("flight dump does not start with a flight_header")
+    if kinds.count("span") == 0:
+        problems.append("flight dump holds no spans")
+    snaps = [r for r in recs if r.get("kind") == "snapshot"]
+    if not snaps or not snaps[-1].get("metrics"):
+        problems.append("flight dump holds no registry snapshot")
+    return len(recs), problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="run the tiny-CPU end-to-end demo workload")
+    ap.add_argument("--out", default="./trace_demo")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--serve-requests", type=int, default=3)
+    args = ap.parse_args(argv)
+    if not args.demo:
+        ap.error("only --demo mode is implemented; pass --demo")
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+
+    from deepspeed_tpu.telemetry import get_registry, trace_dump
+
+    engine, recompile_delta = _train_demo(out_dir, args.steps)
+    serve = _serving_demo(args.serve_requests)
+
+    # ---- write both artifacts ------------------------------------------
+    trace_path = trace_dump(os.path.join(out_dir, "trace.json"))
+    flight = engine.telemetry.flight
+    flight.note("demo_complete", steps=args.steps,
+                serve_requests=args.serve_requests)
+    flight_path = flight.dump(reason="demo")
+    engine.close()
+
+    # ---- verify them ---------------------------------------------------
+    n_events, span_names, trace_problems = _verify_trace(trace_path)
+    n_flight, flight_problems = _verify_flight(flight_path)
+    problems = trace_problems + flight_problems
+    if recompile_delta != 1:
+        problems.append(f"forced re-jit moved the recompile counter by "
+                        f"{recompile_delta}, expected exactly 1")
+
+    reg = get_registry()
+    ttft = reg.get("deepspeed_tpu_serving_ttft_seconds")
+    tpot = reg.get("deepspeed_tpu_serving_tpot_seconds")
+    if ttft is None or ttft.count() == 0:
+        problems.append("no TTFT observations from the serving demo")
+    summary = {
+        "trace_path": trace_path,
+        "flight_path": flight_path,
+        "trace_events": n_events,
+        "span_families": span_names,
+        "flight_records": n_flight,
+        "recompile_delta": recompile_delta,
+        "compiles_total": (reg.get("deepspeed_tpu_compiles_total").total()
+                           if reg.get("deepspeed_tpu_compiles_total") else 0),
+        "ttft_s": ttft.percentiles() if ttft and ttft.count() else None,
+        "tpot_s": tpot.percentiles() if tpot and tpot.count() else None,
+        "prefix_hit_rate": serve.cache_stats()["prefix_hit_rate"],
+        "problems": problems,
+        "ok": not problems,
+    }
+    print(json.dumps(summary, default=float))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
