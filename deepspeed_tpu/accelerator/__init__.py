@@ -164,6 +164,24 @@ class Accelerator:
         except Exception:
             return {}
 
+    def aggregate_memory_stats(self) -> Dict[str, int]:
+        """Memory stats summed across every addressable device of this
+        process — the process-level HBM view the memory ledger
+        (telemetry/memory.py) attributes against.  Per-key numeric sum:
+        ``bytes_in_use`` and ``bytes_limit`` add naturally; the summed
+        per-device peaks are an upper bound on any instant's total (the
+        devices need not have peaked together)."""
+        out: Dict[str, int] = {}
+        for d in self._devices():
+            try:
+                s = d.memory_stats() or {}
+            except Exception:
+                continue
+            for k, v in s.items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + int(v)
+        return out
+
     def memory_allocated(self, device_index: Optional[int] = None) -> int:
         return int(self.memory_stats(device_index).get("bytes_in_use", 0))
 
@@ -273,6 +291,11 @@ class CPUAccelerator(Accelerator):
 
     _name = "cpu"
     _communication_backend = "xla:host"
+
+    def aggregate_memory_stats(self) -> Dict[str, int]:
+        """Virtual CPU devices share one process RSS: summing the
+        per-device view would multiply it by the device count."""
+        return self.memory_stats()
 
     def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:  # noqa: ARG002
         import sys

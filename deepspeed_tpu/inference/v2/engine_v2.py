@@ -84,6 +84,11 @@ class RaggedInferenceConfig(ConfigModel):
     #: telemetry.recompile_sentinel.steady_after
     recompile_sentinel: bool = True
     sentinel_steady_after: int = 3
+    #: memory ledger (telemetry/memory.py): attach the weight copy + KV
+    #: page pool to the process ledger and watch prefill/decode phase
+    #: watermarks.  The serving engine takes no `telemetry` block, so —
+    #: like the sentinel above — the knob lives here
+    memory_ledger: bool = True
 
     @property
     def jnp_dtype(self):
@@ -221,6 +226,49 @@ class InferenceEngineV2:
         self._sentinel = (RecompileSentinel(
             loop="serve", steady_after=self.config.sentinel_steady_after)
             if self.config.recompile_sentinel else None)
+        self._wire_memory_ledger()
+
+    def _wire_memory_ledger(self) -> None:
+        """Attach the serving engine's HBM residents to the process
+        memory ledger (telemetry/memory.py): the weight copy, the KV
+        page pool, and — informationally, it is a sub-slice of the pool
+        — the bytes pinned by prefix-cache LRU pages.  Providers read
+        ``self`` dynamically so the donated pool buffers of the latest
+        step are measured.  Co-located engines replace each other's
+        components (latest owner wins); ``close()`` detaches exactly
+        what this engine attached so a torn-down engine's weights and
+        KV pool are not kept alive by the process-lifetime ledger."""
+        self._ledger_components = []
+        if not self.config.memory_ledger:
+            return
+        from ...telemetry.memory import get_memory_ledger
+
+        led = get_memory_ledger()
+        led.install_phase_watch()  # prefill/decode peak watermarks
+
+        def _attach(name, provider, **kw):
+            led.attach(name, provider, **kw)
+            self._ledger_components.append((name, provider))
+
+        _attach("serving_params", lambda: self.params)
+        _attach("kv_pool", lambda: self._pools)
+        _attach("kv_prefix_pinned",
+                lambda: {"device": self._pinned_page_bytes()},
+                informational=True)
+        led.update_context(
+            kv_num_pages=self.block.num_pages,
+            kv_page_size=self.block.page_size,
+            kv_max_seqs=self.block.max_seqs,
+            kv_quant=self.config.kv_quant,
+            prefix_cache=self.config.enable_prefix_cache)
+
+    def _pinned_page_bytes(self) -> int:
+        """Device bytes held by prefix-cache-pinned (LRU) pages: the
+        pool's per-page cost times the parked-page count."""
+        from ...telemetry.memory import tree_bytes
+
+        dev, _host = tree_bytes(self._pools)
+        return dev * self.allocator.lru_pages // (self.block.num_pages + 1)
 
     # -- telemetry -----------------------------------------------------------
     def _init_serving_metrics(self) -> None:
@@ -271,6 +319,18 @@ class InferenceEngineV2:
         self._m_preemptions = reg.counter(
             "deepspeed_tpu_serving_preemptions_total",
             "sequences evicted to the queue under KV-pool pressure")
+        # KV page-pool occupancy: used + free == num_pages; pinned pages
+        # (cached-but-unreferenced LRU) are a subset of free — allocatable,
+        # but evicting them costs future prefix hits
+        self._m_kv_used = reg.gauge(
+            "deepspeed_tpu_serving_kv_pages_used",
+            "KV pool pages referenced by live sequences")
+        self._m_kv_free = reg.gauge(
+            "deepspeed_tpu_serving_kv_pages_free",
+            "allocatable KV pool pages (truly free + cached-unreferenced)")
+        self._m_kv_pinned = reg.gauge(
+            "deepspeed_tpu_serving_kv_pages_pinned",
+            "cached-but-unreferenced pages parked in the prefix-cache LRU")
         self._m_ttft_h = reg.histogram(
             "deepspeed_tpu_serving_ttft_seconds",
             "time to first token: enqueue to first sampled token "
@@ -316,10 +376,25 @@ class InferenceEngineV2:
         end_span(m["span"], generated=m["n"],
                  total_s=round(time.perf_counter() - m["t0"], 6))
 
+    def _pool_occupancy(self) -> Dict[str, int]:
+        """Current KV page-pool occupancy, attached to every admission/
+        preemption event so scheduling decisions are explainable from
+        the event log alone."""
+        a = self.allocator
+        return {"pages_used": a.used_pages, "pages_free": a.free_pages,
+                "pages_pinned": a.lru_pages}
+
+    def _publish_pool_gauges(self) -> None:
+        occ = self._pool_occupancy()
+        self._m_kv_used.set(occ["pages_used"])
+        self._m_kv_free.set(occ["pages_free"])
+        self._m_kv_pinned.set(occ["pages_pinned"])
+
     def _sync_cache_counters(self) -> None:
         """Forward allocator/prefix-cache counter deltas to the registry
         (those objects stay the per-engine source of truth; re-homing
         them wholesale would break per-engine ``cache_stats``)."""
+        self._publish_pool_gauges()
         pub = self._cache_pub
         ev = self.allocator.evictions
         if ev > pub["evictions"]:
@@ -390,8 +465,17 @@ class InferenceEngineV2:
         seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
         self._queue.insert(0, seq)
         self._m_preemptions.inc()
+        occ = self._pool_occupancy()
         record_event("preempt", cat="serve", uid=seq.uid,
-                     prefix_tokens=seq.length)
+                     prefix_tokens=seq.length, **occ)
+        # preemptions are rare and always a capacity question — log the
+        # occupancy that forced this one so "why was this request
+        # preempted" is answerable without a trace dump
+        logger.info(
+            f"serving: preempted uid={seq.uid} (prefix {seq.length} tokens) "
+            f"under KV-pool pressure: {occ['pages_used']} pages used, "
+            f"{occ['pages_free']} free ({occ['pages_pinned']} of them "
+            f"prefix-cache pinned) of {self.block.num_pages}")
 
     def _admit(self) -> List[SequenceState]:
         admitted = []
@@ -470,9 +554,10 @@ class InferenceEngineV2:
             self._page_table[i, :len(seq.pages)] = seq.pages
             record_event("admit", cat="serve", uid=seq.uid, slot=i,
                          cache_hit_pages=m, new_pages=len(fresh),
-                         full_hit=full_hit)
+                         full_hit=full_hit, **self._pool_occupancy())
             admitted.append(seq)
             self._slots[i] = seq
+        self._publish_pool_gauges()
         return admitted
 
     def _register_pages(self, seq: SequenceState) -> None:
@@ -581,8 +666,8 @@ class InferenceEngineV2:
         self._step_parts = set()
         try:
             out = self._step_impl()
-        except Exception:
-            dump_on_exception("engine_v2.step")
+        except Exception as e:
+            dump_on_exception("engine_v2.step", e)
             raise
         if self._step_parts and self._sentinel is not None:
             self._sentinel.observe_step(frozenset(self._step_parts),
@@ -726,6 +811,19 @@ class InferenceEngineV2:
             rec["done"] = seq.done
         self._sync_cache_counters()
         return out
+
+    def close(self) -> None:
+        """Release this engine's memory-ledger slots (provider identity
+        guards: slots a newer co-located engine claimed stay attached).
+        Idempotent; safe without the ledger enabled."""
+        comps = getattr(self, "_ledger_components", [])
+        if comps:
+            from ...telemetry.memory import get_memory_ledger
+
+            led = get_memory_ledger()
+            for name, prov in comps:
+                led.detach(name, provider=prov)
+        self._ledger_components = []
 
     # -- serving metrics -----------------------------------------------------
     def cache_stats(self) -> Dict[str, float]:

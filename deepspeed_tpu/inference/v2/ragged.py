@@ -84,6 +84,18 @@ class BlockAllocator:
         return len(self._free) + len(self._lru)
 
     @property
+    def used_pages(self) -> int:
+        """Pages referenced by live sequences (refcount > 0)."""
+        return self.num_pages - len(self._free) - len(self._lru)
+
+    @property
+    def lru_pages(self) -> int:
+        """Cached-but-unreferenced pages parked in the LRU: they occupy
+        pool HBM purely for prefix reuse (the "pinned" occupancy the
+        serving gauges and the memory ledger report)."""
+        return len(self._lru)
+
+    @property
     def cached_pages(self) -> int:
         return len(self._by_key)
 

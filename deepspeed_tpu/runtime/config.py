@@ -271,6 +271,24 @@ class RecompileSentinelConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class MemoryLedgerConfig(ConfigModel):
+    """``memory`` sub-block of ``telemetry``: the HBM memory ledger
+    (telemetry/memory.py).  When enabled the engines attribute device
+    bytes to named components (params / master params / grads /
+    optimizer state / KV pool), track per-phase peak watermarks off the
+    span enters/exits, and upgrade RESOURCE_EXHAUSTED step failures to
+    OOM incident reports through the flight recorder.
+    ``top_buffers`` bounds the live-buffer table in an incident."""
+
+    enabled: bool = True
+    top_buffers: int = 10
+
+    def validate(self) -> None:
+        if self.top_buffers < 1:
+            raise ValueError("telemetry.memory.top_buffers must be >= 1")
+
+
+@dataclasses.dataclass
 class TelemetryConfig(ConfigModel):
     """``telemetry`` block: the unified metrics registry + export paths
     (see deepspeed_tpu/telemetry/ and docs/OBSERVABILITY.md).
@@ -282,9 +300,10 @@ class TelemetryConfig(ConfigModel):
     ``jsonl_path`` appends snapshot events to a JSON-lines log.
     ``trace_annotations`` wraps steps in ``jax.profiler`` step/phase
     annotations (no-op without a live profiler capture).  ``spans``,
-    ``flight_recorder`` and ``recompile_sentinel`` configure the
-    timeline side (all default-on once ``enabled`` is set; see
-    docs/OBSERVABILITY.md "Tracing & flight recorder")."""
+    ``flight_recorder``, ``recompile_sentinel`` and ``memory`` configure
+    the timeline/memory side (all default-on once ``enabled`` is set;
+    see docs/OBSERVABILITY.md "Tracing & flight recorder" and "Memory
+    ledger & OOM forensics")."""
 
     enabled: bool = False
     prometheus_path: str = ""
@@ -300,6 +319,8 @@ class TelemetryConfig(ConfigModel):
         default_factory=FlightRecorderConfig)
     recompile_sentinel: RecompileSentinelConfig = dataclasses.field(
         default_factory=RecompileSentinelConfig)
+    memory: MemoryLedgerConfig = dataclasses.field(
+        default_factory=MemoryLedgerConfig)
 
     def validate(self) -> None:
         if self.export_interval < 1:

@@ -215,6 +215,7 @@ class DeepSpeedTPUEngine:
 
         self.state = self._init_state()
         self._compile_steps()
+        self._wire_memory_ledger()
         # ZeRO-Infinity param offload (reference offload_param config): the
         # fp32 master lives in pinned host memory; the step streams it.
         # The optimizer-offload path already keeps the master in host RAM
@@ -1015,10 +1016,11 @@ class DeepSpeedTPUEngine:
                 if self.global_steps % self.config.steps_per_print == 0 or \
                         self.config.wall_clock_breakdown:
                     jax.block_until_ready(loss)
-        except Exception:
+        except Exception as e:
             # black box first, then propagate: the flight dump is the
             # only record of what the process was doing when it died
-            dump_on_exception("engine.train_batch")
+            # (RESOURCE_EXHAUSTED upgrades to a full OOM incident report)
+            dump_on_exception("engine.train_batch", e)
             raise
         self.tput_timer.stop()
         if self.telemetry is not None and self.telemetry.sentinel is not None:
@@ -1086,8 +1088,8 @@ class DeepSpeedTPUEngine:
                         with self.topology.mesh:
                             self.state = self._apply_step(self.state)
                         self._repin_opt_state()
-            except Exception:
-                dump_on_exception("engine.step")
+            except Exception as e:
+                dump_on_exception("engine.step", e)
                 raise
             self._acc_dirty = False  # buffer consumed and re-zeroed
             self.global_steps += 1
@@ -1173,6 +1175,65 @@ class DeepSpeedTPUEngine:
     def _observe_phase(self, name: str, dt: float) -> None:
         self._m_phase.observe(dt, phase=name)
 
+    def _wire_memory_ledger(self) -> None:
+        """Attach the TrainState's components to the process memory
+        ledger (telemetry/memory.py) so HBM is attributable by name.
+
+        Providers read ``self.state`` dynamically: the ledger sees the
+        post-donation buffers of the LATEST step, a parked engine
+        (``offload_states``) reports 0 device bytes, and host-offloaded
+        masters/moments report as host bytes.  Components cover the
+        whole TrainState — params (the fp32 master unless the optimizer
+        is host-offloaded, in which case the device copy is compute
+        dtype and the master is host-side), gradients, optimizer state,
+        and the scalar leaves — so the component sum equals the state's
+        structural bytes exactly.
+
+        Wiring first clears ALL training component names (a rebuilt
+        engine with a different offload config must not leave a stale
+        sibling's slot summing into the attribution), records what it
+        attached, and ``close()`` detaches exactly those — otherwise the
+        process-lifetime ledger would keep this engine's TrainState
+        alive through the provider closures."""
+        self._ledger_components = []
+        if self.telemetry is None or self.telemetry.ledger is None:
+            return
+        led = self.telemetry.ledger
+        for name in ("params", "master_params", "optimizer_state", "grads",
+                     "train_scalars"):
+            led.detach(name)
+
+        def _attach(name, provider, **kw):
+            led.attach(name, provider, **kw)
+            self._ledger_components.append((name, provider))
+
+        led.update_context(
+            zero_stage=self.config.zero_config.stage,
+            offload_optimizer=self.offload_optimizer is not None,
+            offload_param=self.config.zero_config.offload_param.enabled,
+            compute_dtype=self.compute_dtype.__name__,
+            gas=self.config.gradient_accumulation_steps or 1,
+            micro_batch=self.config.train_micro_batch_size_per_gpu)
+
+        def _state_part(attr):
+            return lambda: (None if self.state is None
+                            else getattr(self.state, attr))
+
+        if self.offload_optimizer is not None:
+            _attach("params", _state_part("params"))
+            _attach("master_params", lambda: {
+                "host": self.offload_optimizer.master_bytes()})
+            _attach("optimizer_state", lambda: {
+                "host": self.offload_optimizer.moment_bytes()})
+        else:
+            # no separate live copy: state.params IS the fp32 master
+            _attach("master_params", _state_part("params"))
+            _attach("optimizer_state", _state_part("opt_state"))
+        _attach("grads", _state_part("grad_acc"))
+        _attach("train_scalars", lambda: None if self.state is None else (
+            self.state.step, self.state.micro_step, self.state.loss_scale,
+            self.state.skipped_steps, self.state.global_grad_norm))
+
     @staticmethod
     def _batch_tokens(batch) -> int:
         """Token count of one (possibly gas-stacked) batch: the size of
@@ -1242,6 +1303,10 @@ class DeepSpeedTPUEngine:
         self._m_lr.set(self.get_lr()[0])
         self._m_grad_norm.set(float(self.state.global_grad_norm))
         self._m_loss_scale.set(self.loss_scale())
+        if tm.ledger is not None:
+            # structural attribution + watermarks -> gauges (host-side
+            # tree walk; boundary cadence keeps it off the hot path)
+            tm.ledger.publish()
         skipped = int(self.state.skipped_steps)
         if skipped > self._skipped_pub:
             self._m_skipped.inc(skipped - self._skipped_pub)
@@ -1290,6 +1355,17 @@ class DeepSpeedTPUEngine:
             self.telemetry.close()
         if self.monitor is not None:
             self.monitor.close()
+        # release our ledger slots AFTER the final export (so it still
+        # shows them) — the provider closures would otherwise keep this
+        # engine's TrainState reachable for the process lifetime.
+        # provider identity guards: slots a newer engine claimed stay.
+        if getattr(self, "_ledger_components", None):
+            from ..telemetry.memory import get_memory_ledger
+
+            led = get_memory_ledger()
+            for name, prov in self._ledger_components:
+                led.detach(name, provider=prov)
+            self._ledger_components = []
 
     def _report(self, loss) -> None:
         cfg = self.config

@@ -24,11 +24,31 @@ __all__ = ["see_memory_usage", "clip_grad_norm_", "flatten_tree",
 
 def see_memory_usage(message: str, force: bool = False) -> None:
     """Log device + host memory (reference runtime/utils.py
-    see_memory_usage, which prints torch.cuda stats + psutil)."""
+    see_memory_usage, which prints torch.cuda stats + psutil).
+
+    Re-homed onto the memory ledger: every call publishes the live
+    ``deepspeed_tpu_memory_bytes_in_use`` / ``_peak_bytes_in_use`` /
+    ``_bytes_limit`` gauges (no longer silently a no-op when
+    ``force=False``); ``force`` only gates the LOG LINE, whose format is
+    unchanged.  Degrades gracefully when the accelerator reports no
+    stats (bare CPU builds): gauges are left untouched and the log says
+    so instead of printing zeros."""
+    try:
+        from ..telemetry.memory import get_memory_ledger
+
+        # no-arg: the ledger publishes its own process-aggregate view so
+        # the gauges stay consistent with the ledger's residual math
+        get_memory_ledger().publish_stats()
+    except Exception:
+        pass  # telemetry must never break the caller
     if not force:
         return
     acc = get_accelerator()
     s = acc.memory_stats()
+    if not s:
+        logger.info(f"{message} | device memory stats unavailable on "
+                    f"accelerator '{acc.device_name()}'")
+        return
     used = s.get("bytes_in_use", 0) / 2**30
     peak = s.get("peak_bytes_in_use", 0) / 2**30
     limit = s.get("bytes_limit", 0) / 2**30

@@ -102,6 +102,18 @@ class ZenFlowOptimizer:
                  f"elements; topk_ratio={self.zf.topk_ratio} "
                  f"interval={self.zf.update_interval}")
 
+    # -- memory-ledger accounting (telemetry/memory.py providers) -----------
+    def master_bytes(self) -> int:
+        """Host RAM held by the fp32 master leaves."""
+        return int(sum(m.nbytes for m in self.master if m is not None))
+
+    def moment_bytes(self) -> int:
+        """Host RAM held by the Adam moments + accumulation buffers."""
+        total = 0
+        for bufs in (self._m, self._v, self._accum):
+            total += sum(int(b.nbytes) for b in bufs if b is not None)
+        return total
+
     # -- slow path ----------------------------------------------------------
     def _slow_pass(self, snap_master, snap_m, snap_v, snap_accum, snap_touched,
                    step, lr):

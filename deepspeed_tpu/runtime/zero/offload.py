@@ -113,6 +113,21 @@ class HostOffloadedOptimizer:
         log_dist(f"host-offload: {sum(m.size for m in self.master) / 1e6:.1f}M "
                  f"fp32 master elements in host RAM")
 
+    # -- memory-ledger accounting (telemetry/memory.py providers) -----------
+    def master_bytes(self) -> int:
+        """Host RAM held by the fp32 master leaves."""
+        return int(sum(m.nbytes for m in self.master if m is not None))
+
+    def moment_bytes(self) -> int:
+        """Host RAM held by RESIDENT optimizer moments (NVMe-spilled
+        leaves are on disk, not RAM, and count 0)."""
+        total = 0
+        for _name, d in self._moment_dicts():
+            for v in d.values():
+                if v is not None:
+                    total += int(v.nbytes)
+        return total
+
     def _moment_dicts(self):
         """Per-kernel moment buffers: Adam has m+v, Lion m only, Adagrad v
         only — spill/fetch whatever exists."""

@@ -23,6 +23,9 @@ from .exporter import (JSONLWriter, PrometheusFileExporter,
                        snapshot_metrics, to_prometheus_text)
 from .flight import (FlightRecorder, dump_on_exception, get_flight_recorder,
                      install_flight_recorder)
+from .memory import (MemoryLedger, get_memory_ledger, is_resource_exhausted,
+                     oom_hints, record_oom_incident, set_memory_ledger,
+                     top_live_buffers)
 from .mfu import (PEAK_BF16_FLOPS, mfu, peak_flops_for_device,
                   peak_flops_for_kind)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
@@ -43,6 +46,9 @@ __all__ = [
     "trace_dump", "get_span_recorder", "set_span_recorder", "configure_spans",
     "FlightRecorder", "get_flight_recorder", "install_flight_recorder",
     "dump_on_exception",
+    "MemoryLedger", "get_memory_ledger", "set_memory_ledger",
+    "is_resource_exhausted", "record_oom_incident", "oom_hints",
+    "top_live_buffers",
     "RecompileSentinel", "expect_recompile", "compile_counts",
     "PEAK_BF16_FLOPS", "peak_flops_for_kind", "peak_flops_for_device", "mfu",
     "StallWatchdog", "Telemetry",
@@ -74,6 +80,7 @@ class Telemetry:
         self.watchdog: Optional[StallWatchdog] = None
         self.flight: Optional[FlightRecorder] = None
         self.sentinel: Optional[RecompileSentinel] = None
+        self.ledger: Optional[MemoryLedger] = None
         self.export_interval = 1
         self.trace_annotations = True
         self._last_export: Optional[int] = None
@@ -98,6 +105,16 @@ class Telemetry:
             self.flight = FlightRecorder(path=fr.path, max_events=fr.events,
                                          registry=self.registry)
             install_flight_recorder(self.flight)
+        mem = getattr(config, "memory", None)
+        if mem is not None and getattr(mem, "enabled", False):
+            # process-default ledger: engines attach their components to
+            # it and flight dumps read it; the phase watch samples
+            # occupancy watermarks at span boundaries.  Our registry is
+            # passed so a FIRST-created ledger binds its gauges where
+            # this session's exporters will look.
+            self.ledger = get_memory_ledger(self.registry)
+            self.ledger.top_buffers = int(getattr(mem, "top_buffers", 10))
+            self.ledger.install_phase_watch()
         rs = getattr(config, "recompile_sentinel", None)
         if rs is not None and getattr(rs, "enabled", False):
             self.sentinel = RecompileSentinel(

@@ -24,8 +24,14 @@ File schema (one JSON object per line, same spirit as
   per ring span, oldest first; ``ts``/``dur`` in trace microseconds
   (the same clock ``trace_dump()`` uses, so the two artifacts align)
 * ``{"kind": "log", "ts", "name", ...}`` — one per ``note()`` event
+* ``{"kind": "memory", "ts", "components", "stats", "watermarks", ...}``
+  — the memory ledger's reading at dump time (telemetry/memory.py), so
+  every incident file answers memory questions too
 * ``{"kind": "snapshot", "ts", "metrics": {...}}`` — the registry at
-  dump time
+  dump time (the final record of a plain dump)
+* ``{"kind": "oom_incident", ...}`` — appended by OOM forensics
+  (``memory.record_oom_incident``): ledger breakdown, top live buffers,
+  actionable hints
 """
 
 from __future__ import annotations
@@ -70,11 +76,16 @@ class FlightRecorder:
         with self._lock:
             self._events.append(rec)
 
-    def dump(self, reason: str = "manual", path: Optional[str] = None) -> str:
+    def dump(self, reason: str = "manual", path: Optional[str] = None,
+             extra_records: Optional[list] = None) -> str:
         """Write the black box to ``path`` (default: a timestamped file
         under ``self.dir``) and return the file path.  The trigger kind
         (text before the first ``:`` of ``reason``) labels the dump
-        counter."""
+        counter.  Every dump also attaches a ``memory`` section (the
+        process memory ledger's reading: components, live stats,
+        watermarks) so incident files answer memory questions too;
+        ``extra_records`` appends caller records (the OOM incident
+        report) verbatim."""
         spans = (self._spans or get_span_recorder()).spans()
         with self._lock:
             events = list(self._events)
@@ -97,8 +108,20 @@ class FlightRecorder:
                 line(dict({"kind": "span"}, **sp.to_dict()))
             for ev in events:
                 line(dict({"kind": "log"}, **ev))
+            try:
+                # lazy: memory.py imports this module at top level.
+                # Before the snapshot: a plain dump keeps the registry
+                # snapshot as its final record (tools rely on that).
+                from .memory import get_memory_ledger
+
+                line(dict({"kind": "memory"},
+                          **get_memory_ledger().snapshot()))
+            except Exception:
+                pass  # the black box must be written even half-blind
             line({"kind": "snapshot", "ts": time.time(),
                   "metrics": snapshot_metrics(self.registry)})
+            for rec in (extra_records or []):
+                line(dict(rec))
         self._m_dumps.inc(trigger=reason.split(":", 1)[0])
         logger.warning(f"flight recorder: {len(spans)} spans + "
                        f"{len(events)} events + registry snapshot -> "
@@ -124,11 +147,32 @@ def install_flight_recorder(recorder: Optional[FlightRecorder]) -> None:
         _flight = recorder
 
 
-def dump_on_exception(where: str) -> Optional[str]:
+def dump_on_exception(where: str,
+                      exc: Optional[BaseException] = None) -> Optional[str]:
     """Best-effort dump from an exception path: never raises, returns
     the dump path or None when no recorder is installed (engines call
-    this unconditionally before re-raising)."""
+    this unconditionally before re-raising).
+
+    When ``exc`` rates as a device-memory exhaustion
+    (``memory.is_resource_exhausted``), the dump is upgraded to a full
+    OOM incident report — ledger breakdown, top live buffers, hints —
+    and is written even WITHOUT an installed recorder (an ephemeral one
+    is created): an OOM is too precious to lose to missing config."""
     fr = _flight
+    if exc is not None:
+        try:
+            from .memory import is_resource_exhausted, record_oom_incident
+
+            if is_resource_exhausted(exc):
+                path = record_oom_incident(where, exc, flight=fr)
+                if path is not None:
+                    return path
+                # forensics failed: fall through to the plain dump so an
+                # OOM still leaves SOME black box, as every exception did
+                # before forensics existed
+        except Exception as e:  # forensics must never mask the OOM
+            logger.error(f"flight recorder: OOM forensics from {where} "
+                         f"failed: {e}")
     if fr is None:
         return None
     try:

@@ -41,6 +41,31 @@ from typing import Any, Dict, List, Optional
 
 SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
+#: optional phase listener: ``fn(name, edge)`` with edge one of
+#: "enter"/"exit" (spans, PhaseTimer) or "point" (events).  Installed by
+#: the memory ledger to sample per-phase occupancy watermarks at span
+#: boundaries; None (the default) costs one attribute check per span.
+_phase_listener = None
+
+
+def set_phase_listener(fn) -> None:
+    global _phase_listener
+    _phase_listener = fn
+
+
+def get_phase_listener():
+    return _phase_listener
+
+
+def _notify_phase(name: str, edge: str) -> None:
+    fn = _phase_listener
+    if fn is None:
+        return
+    try:
+        fn(name, edge)
+    except Exception:  # a broken sampler must never break the traced code
+        pass
+
 #: one monotonic origin per process: every span timestamp is
 #: microseconds since import, so events from all threads share a
 #: timeline and the Chrome trace starts near 0
@@ -128,6 +153,7 @@ class SpanRecorder:
 
     def event(self, name: str, cat: str = "", **attrs) -> None:
         """Zero-duration point event (rendered as a sliver in Perfetto)."""
+        _notify_phase(name, "point")
         self.record(name, _now_us(), 0.0, cat=cat, **attrs)
 
     def begin(self, name: str, cat: str = "", **attrs) -> Optional[_Handle]:
@@ -150,7 +176,14 @@ class SpanRecorder:
         """Record the enclosed block; nests a profiler annotation so the
         same range is attributable in an XProf capture."""
         if not self.enabled:
-            yield
+            # the phase watch (memory watermarks) is orthogonal to span
+            # RECORDING: notify it even with the ring off, as event() and
+            # PhaseTimer already do
+            _notify_phase(name, "enter")
+            try:
+                yield
+            finally:
+                _notify_phase(name, "exit")
             return
         ann = None
         if self.profiler_annotations:
@@ -158,6 +191,7 @@ class SpanRecorder:
 
             ann = annotate(name)
             ann.__enter__()
+        _notify_phase(name, "enter")
         t0 = _now_us()
         try:
             yield
@@ -165,6 +199,7 @@ class SpanRecorder:
             dur = _now_us() - t0
             if ann is not None:
                 ann.__exit__(None, None, None)
+            _notify_phase(name, "exit")
             self.record(name, t0, dur, cat=cat, **attrs)
 
     # ------------------------------------------------------------ export

@@ -91,8 +91,9 @@ class PhaseTimer:
 
         self._ann = annotate(self.name)
         self._ann.__enter__()
-        from .spans import _now_us
+        from .spans import _notify_phase, _now_us
 
+        _notify_phase(self.name, "enter")
         self._t0_us = _now_us()
         self._t0 = time.perf_counter()
         return self
@@ -104,8 +105,9 @@ class PhaseTimer:
         self._ann.__exit__(*exc)
         if self.sink is not None:
             self.sink(self.name, dt)
-        from .spans import get_span_recorder
+        from .spans import _notify_phase, get_span_recorder
 
+        _notify_phase(self.name, "exit")
         rec = get_span_recorder()
         if rec.enabled:
             rec.record(self.name, self._t0_us, dt * 1e6, cat="phase",

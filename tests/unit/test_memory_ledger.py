@@ -1,0 +1,456 @@
+"""Memory ledger and OOM forensics tests.
+
+Covers: structural byte attribution (device/host split, explicit byte
+dicts, informational components, the unattributed residual), per-phase
+peak watermarks off span/PhaseTimer boundaries (including monotonicity
+of the exit log), the engine's TrainState attribution across ZeRO
+stages and host offload, ``see_memory_usage``'s always-on gauge
+publication with the empty-stats CPU fallback, the serving engine's KV
+page-pool occupancy gauges, and the RESOURCE_EXHAUSTED incident-dump
+schema (hints + ledger breakdown through the flight recorder).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, MemoryLedger,
+                                     MetricsRegistry, get_memory_ledger,
+                                     is_resource_exhausted, oom_hints,
+                                     set_memory_ledger)
+from deepspeed_tpu.telemetry.spans import set_phase_listener
+
+
+class FakeAccelerator:
+    """Scripted ``memory_stats`` so watermark/residual math is exact."""
+
+    def __init__(self, stats=None):
+        self.stats = stats if stats is not None else {
+            "bytes_in_use": 1000, "peak_bytes_in_use": 1500,
+            "bytes_limit": 4000}
+
+    def aggregate_memory_stats(self):
+        return dict(self.stats)
+
+    def memory_stats(self, device_index=None):
+        return dict(self.stats)
+
+
+@pytest.fixture
+def fresh_registry():
+    from deepspeed_tpu.telemetry import get_registry, set_registry
+
+    old = get_registry()
+    reg = MetricsRegistry()
+    set_registry(reg)
+    yield reg
+    set_registry(old)
+
+
+@pytest.fixture
+def fresh_ledger(fresh_registry):
+    """Install a fresh default ledger (own registry via fresh_registry);
+    restore the old one and drop any phase listener installed here."""
+    old = get_memory_ledger()
+    led = MemoryLedger(registry=fresh_registry,
+                       accelerator=FakeAccelerator())
+    set_memory_ledger(led)
+    yield led
+    set_phase_listener(None)
+    set_memory_ledger(old)
+
+
+def _structural_bytes(tree) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            total += sum(s.data.nbytes for s in leaf.addressable_shards)
+        except Exception:
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+# ----------------------------- structural attribution ------------------------
+def test_component_attribution_and_residual(fresh_ledger):
+    led = fresh_ledger
+    tree = {"w": jnp.zeros((8, 8), jnp.float32), "host": np.zeros((4,), np.float32)}
+    led.attach("state", lambda: tree)
+    led.attach("explicit", lambda: {"device": 100, "host": 7})
+    led.attach("info", lambda: {"device": 50}, informational=True)
+    led.attach("broken", lambda: 1 / 0)  # provider errors count 0, not crash
+    report = led.publish()
+    comp = report["components"]
+    dev_w = _structural_bytes(tree["w"])  # replicated: counts every shard
+    assert comp["state"] == {"device": dev_w, "host": 16,
+                             "informational": False}
+    assert comp["explicit"] == {"device": 100, "host": 7,
+                                "informational": False}
+    assert comp["info"]["informational"] is True
+    assert comp["broken"] == {"device": 0, "host": 0, "informational": False}
+    # informational components are published but NOT attributed
+    assert report["attributed_device_bytes"] == dev_w + 100
+    assert report["attributed_host_bytes"] == 16 + 7
+    assert report["unattributed_bytes"] == 1000 - (dev_w + 100)
+    g = led.registry.get("deepspeed_tpu_memory_component_bytes")
+    assert g.value(component="state", space="device") == dev_w
+    assert g.value(component="info", space="device") == 50
+    assert led.registry.get(
+        "deepspeed_tpu_memory_bytes_in_use").value() == 1000
+    assert led.registry.get(
+        "deepspeed_tpu_memory_unattributed_bytes").value() == \
+        report["unattributed_bytes"]
+    # detach zeroes the gauge rows and leaves the sums honest
+    led.detach("explicit")
+    assert g.value(component="explicit", space="device") == 0
+    assert led.collect()["attributed_device_bytes"] == dev_w
+
+
+def test_host_placed_arrays_count_as_device_on_cpu(fresh_ledger):
+    """On the CPU backend the default memory space IS host memory:
+    plain arrays must land in the device column (the accelerator's
+    default space), not be misread as offloaded."""
+    x = jnp.ones((4, 4), jnp.float32)
+    fresh_ledger.attach("x", lambda: x)
+    row = fresh_ledger.collect()["components"]["x"]
+    assert row["device"] == _structural_bytes(x) and row["host"] == 0
+
+
+# ----------------------------- phase watermarks ------------------------------
+def test_phase_watermarks_from_spans(fresh_ledger):
+    from deepspeed_tpu.telemetry.spans import SpanRecorder, set_span_recorder
+
+    led = fresh_ledger
+    acc = led._acc
+    old_rec = None
+    try:
+        from deepspeed_tpu.telemetry.spans import get_span_recorder
+
+        old_rec = get_span_recorder()
+        set_span_recorder(SpanRecorder(ring_size=64))
+        led.install_phase_watch()
+        from deepspeed_tpu.telemetry.spans import record_event, span
+
+        acc.stats = {"bytes_in_use": 100, "peak_bytes_in_use": 100}
+        with span("forward"):
+            # occupancy spikes inside the phase; the process peak moved,
+            # so the new high-water mark is attributed to this phase
+            acc.stats = {"bytes_in_use": 80, "peak_bytes_in_use": 300}
+        with span("not_watched"):
+            pass
+        record_event("backward")  # point sample
+        acc.stats = {"bytes_in_use": 150, "peak_bytes_in_use": 350}
+        with span("optimizer_step"):
+            acc.stats = {"bytes_in_use": 120, "peak_bytes_in_use": 350}
+        marks = led.watermarks()
+        assert marks["forward"] == 300  # the in-phase peak, not the exit use
+        assert marks["backward"] == 80  # point sample of bytes_in_use
+        assert marks["optimizer_step"] == 150  # enter occupancy was highest
+        assert "not_watched" not in marks
+        # exit log carries the process peak: monotone within the step
+        peaks = [p for _n, p in led.phase_exit_log()]
+        assert peaks == sorted(peaks)
+        led.publish()
+        g = led.registry.get("deepspeed_tpu_memory_phase_peak_bytes")
+        assert g.value(phase="forward") == 300
+        led.reset_watermarks()
+        assert led.watermarks() == {} and led.phase_exit_log() == []
+    finally:
+        set_span_recorder(old_rec)
+
+
+def test_phase_watch_through_phase_timer(fresh_ledger):
+    from deepspeed_tpu.telemetry.tracing import PhaseTimer
+
+    led = fresh_ledger
+    led.install_phase_watch()
+    led._acc.stats = {"bytes_in_use": 222, "peak_bytes_in_use": 222}
+    with PhaseTimer("decode", sink=lambda n, dt: None, batch=2):
+        pass
+    assert led.watermarks()["decode"] == 222
+    led.uninstall_phase_watch()
+    led._acc.stats = {"bytes_in_use": 999, "peak_bytes_in_use": 999}
+    with PhaseTimer("decode", sink=lambda n, dt: None):
+        pass
+    assert led.watermarks()["decode"] == 222  # watch removed
+
+
+# ----------------------------- see_memory_usage ------------------------------
+def test_see_memory_usage_always_publishes(fresh_ledger):
+    from deepspeed_tpu.runtime.utils import see_memory_usage
+
+    led = fresh_ledger
+    see_memory_usage("probe", force=False)  # no longer a silent no-op
+    assert led.registry.get(
+        "deepspeed_tpu_memory_bytes_in_use").value() == 1000
+    assert led.registry.get(
+        "deepspeed_tpu_memory_peak_bytes_in_use").value() == 1500
+    # empty stats (bare-CPU accelerator): graceful, gauges untouched
+    led._acc.stats = {}
+    see_memory_usage("probe2", force=True)  # force path must not crash
+    assert led.registry.get(
+        "deepspeed_tpu_memory_bytes_in_use").value() == 1000
+
+
+# ----------------------------- OOM detection + forensics ---------------------
+def test_is_resource_exhausted():
+    assert is_resource_exhausted(MemoryError("KV pool exhausted"))
+    assert is_resource_exhausted(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate 123."))
+    assert is_resource_exhausted(RuntimeError("hbm: out of memory"))
+    assert not is_resource_exhausted(ValueError("shapes mismatch"))
+    assert not is_resource_exhausted(None)
+
+
+def test_oom_hints_cover_context():
+    report = {"components": {
+        "optimizer_state": {"device": 0, "host": 0},
+        "master_params": {"device": 1000, "host": 0},
+        "kv_pool": {"device": 5000, "host": 0},
+        "kv_prefix_pinned": {"device": 600, "host": 0}},
+        "bytes_in_use": 10000, "unattributed_bytes": 4000}
+    hints = oom_hints({"zero_stage": 1, "offload_optimizer": False,
+                       "compute_dtype": "float32", "gas": 1,
+                       "kv_quant": False}, report)
+    text = " ".join(hints)
+    for needle in ("zero_optimization.stage", "offload_optimizer", "bf16",
+                   "KV page pool", "kv_quant", "prefix_cache_pages",
+                   "unattributed"):
+        assert needle in text, f"missing hint about {needle}: {hints}"
+    # no context at all still yields a fallback hint
+    assert oom_hints({}, {"components": {}, "bytes_in_use": 0,
+                          "unattributed_bytes": 0})
+
+
+def test_oom_incident_dump_schema(tmp_path, fresh_ledger):
+    from deepspeed_tpu.telemetry.flight import (dump_on_exception,
+                                                install_flight_recorder)
+
+    led = fresh_ledger
+    led.attach("params", lambda: {"device": 4096})
+    led.update_context(zero_stage=0, offload_optimizer=False)
+    fr = FlightRecorder(path=str(tmp_path), registry=led.registry)
+    err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1 GiB")
+    install_flight_recorder(fr)
+    try:
+        path = dump_on_exception("engine.train_batch", err)
+    finally:
+        install_flight_recorder(None)
+    assert path is not None and "oom" in path
+    recs = [json.loads(line) for line in open(path)]
+    kinds = [r["kind"] for r in recs]
+    assert kinds[0] == "flight_header"
+    assert "memory" in kinds  # every dump carries the ledger section
+    inc = next(r for r in recs if r["kind"] == "oom_incident")
+    assert inc["where"] == "engine.train_batch"
+    assert "RESOURCE_EXHAUSTED" in inc["error"]
+    assert inc["ledger"]["components"]["params"]["device"] == 4096
+    assert inc["memory_stats"]["bytes_in_use"] == 1000
+    assert inc["hints"] and isinstance(inc["hints"], list)
+    assert led.registry.get(
+        "deepspeed_tpu_memory_oom_incidents_total").value(
+        where="engine.train_batch") == 1
+
+
+def test_non_oom_exception_keeps_plain_dump(tmp_path, fresh_ledger):
+    from deepspeed_tpu.telemetry.flight import (dump_on_exception,
+                                                install_flight_recorder)
+
+    fr = FlightRecorder(path=str(tmp_path), registry=fresh_ledger.registry)
+    install_flight_recorder(fr)
+    try:
+        path = dump_on_exception("engine.step", ValueError("not memory"))
+    finally:
+        install_flight_recorder(None)
+    recs = [json.loads(line) for line in open(path)]
+    kinds = [r["kind"] for r in recs]
+    assert "oom_incident" not in kinds
+    assert "memory" in kinds  # the snapshot section rides every dump
+
+
+# ----------------------------- engine wiring ---------------------------------
+@pytest.mark.parametrize("stage", [0, 3])
+def test_engine_trainstate_attribution(stage, fresh_ledger):
+    import deepspeed_tpu
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": stage},
+                "telemetry": {"enabled": True}})
+    engine.train_batch(random_batch(batch_size=8, gas=1, seed=0))
+    report = fresh_ledger.publish()
+    comp = report["components"]
+    got = sum(comp[c]["device"] + comp[c]["host"]
+              for c in ("master_params", "optimizer_state", "grads",
+                        "train_scalars"))
+    assert got == _structural_bytes(engine.state)
+    assert comp["master_params"]["device"] > 0
+    assert report["watermarks"].get("train_batch", 0) > 0
+    ctx = fresh_ledger.context
+    assert ctx["zero_stage"] == stage and ctx["offload_optimizer"] is False
+
+
+def test_engine_offload_host_attribution(fresh_ledger):
+    import deepspeed_tpu
+    from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "offload_optimizer": {"device": "cpu"}},
+                "telemetry": {"enabled": True}})
+    engine.train_batch(random_batch(batch_size=8, gas=1, seed=0))
+    comp = fresh_ledger.collect()["components"]
+    off = engine.offload_optimizer
+    assert comp["master_params"]["host"] == off.master_bytes() > 0
+    assert comp["optimizer_state"]["host"] == off.moment_bytes() > 0
+    # the device side still sums exactly to the TrainState
+    dev = sum(comp[c]["device"]
+              for c in ("params", "grads", "train_scalars"))
+    assert dev == _structural_bytes(engine.state)
+    assert fresh_ledger.context["offload_optimizer"] is True
+
+
+# ----------------------------- serving pool gauges ---------------------------
+def test_engine_v2_pool_gauges_and_kv_attribution(fresh_ledger,
+                                                  fresh_registry):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig,
+                                            RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=64)
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=16, max_seqs=2,
+        max_pages_per_seq=4, enable_prefix_cache=True))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, model.config.vocab_size, 9).tolist()
+               for _ in range(2)]
+    eng.generate_all([RaggedRequest(prompt_ids=p, max_new_tokens=3)
+                      for p in prompts])
+    used = fresh_registry.get("deepspeed_tpu_serving_kv_pages_used")
+    free = fresh_registry.get("deepspeed_tpu_serving_kv_pages_free")
+    pinned = fresh_registry.get("deepspeed_tpu_serving_kv_pages_pinned")
+    assert used.value() == eng.allocator.used_pages
+    assert free.value() == eng.allocator.free_pages
+    assert pinned.value() == eng.allocator.lru_pages
+    assert used.value() + free.value() == eng.block.num_pages
+    # retired sequences parked their registered pages in the LRU
+    assert pinned.value() > 0
+    # admission/preemption events carry the pool occupancy
+    from deepspeed_tpu.telemetry.spans import get_span_recorder
+
+    admits = [s for s in get_span_recorder().spans() if s.name == "admit"]
+    assert admits and {"pages_used", "pages_free",
+                       "pages_pinned"} <= set(admits[-1].attrs)
+    # ledger: pool + weights attributed exactly; pinned slice informational
+    comp = fresh_ledger.collect()["components"]
+    assert comp["kv_pool"]["device"] == _structural_bytes(eng._pools)
+    assert comp["serving_params"]["device"] == _structural_bytes(eng.params)
+    per_page = _structural_bytes(eng._pools) // (eng.block.num_pages + 1)
+    assert comp["kv_prefix_pinned"]["device"] == \
+        per_page * eng.allocator.lru_pages
+    assert comp["kv_prefix_pinned"]["informational"] is True
+
+
+def test_engine_rebuild_and_close_release_ledger_slots(fresh_ledger):
+    """An offload engine attaches a 'params' slot; a non-offload rebuild
+    must clear it (or attribution double-counts), and close() must
+    release the closures that would pin the TrainState — unless a newer
+    engine already owns the name (provider identity guard)."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import simple_mlp_spec
+
+    e1, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "offload_optimizer": {"device": "cpu"}},
+                "telemetry": {"enabled": True}})
+    assert "params" in fresh_ledger.collect()["components"]
+    e2, *_ = deepspeed_tpu.initialize(
+        model=simple_mlp_spec(),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "telemetry": {"enabled": True}})
+    comp = fresh_ledger.collect()["components"]
+    assert "params" not in comp  # e1's offload-only slot was cleared
+    got = sum(comp[c]["device"] + comp[c]["host"]
+              for c in ("master_params", "optimizer_state", "grads",
+                        "train_scalars"))
+    assert got == _structural_bytes(e2.state)
+    # e1.close() must NOT detach the names e2 now owns
+    e1.close()
+    assert "master_params" in fresh_ledger.collect()["components"]
+    e2.close()
+    assert not any(
+        c in fresh_ledger.collect()["components"]
+        for c in ("params", "master_params", "optimizer_state", "grads",
+                  "train_scalars"))
+    e2.close()  # idempotent
+
+
+def test_phase_watch_survives_disabled_span_ring(fresh_ledger):
+    """Watermarks ride span boundaries even with span RECORDING off —
+    the ring and the phase watch are orthogonal."""
+    from deepspeed_tpu.telemetry.spans import SpanRecorder, set_span_recorder
+
+    old = None
+    try:
+        from deepspeed_tpu.telemetry.spans import get_span_recorder
+
+        old = get_span_recorder()
+        rec = SpanRecorder(ring_size=32, enabled=False)
+        set_span_recorder(rec)
+        fresh_ledger.install_phase_watch()
+        fresh_ledger._acc.stats = {"bytes_in_use": 77,
+                                   "peak_bytes_in_use": 77}
+        with rec.span("forward"):
+            pass
+        assert fresh_ledger.watermarks()["forward"] == 77
+        assert rec.spans() == []  # nothing recorded, only observed
+    finally:
+        set_span_recorder(old)
+
+
+def test_oom_forensics_failure_falls_back_to_plain_dump(tmp_path,
+                                                        fresh_ledger,
+                                                        monkeypatch):
+    """If the incident report itself fails, the plain exception dump
+    must still be written (the pre-forensics guarantee)."""
+    from deepspeed_tpu.telemetry import flight as flight_mod
+    from deepspeed_tpu.telemetry import memory as memory_mod
+
+    fr = FlightRecorder(path=str(tmp_path), registry=fresh_ledger.registry)
+    flight_mod.install_flight_recorder(fr)
+    monkeypatch.setattr(memory_mod, "record_oom_incident",
+                        lambda *a, **k: None)
+    try:
+        path = flight_mod.dump_on_exception(
+            "engine.step", RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    finally:
+        flight_mod.install_flight_recorder(None)
+    assert path is not None and "exception" in path
+
+
+def test_allocator_occupancy_properties():
+    from deepspeed_tpu.inference.v2.ragged import BlockAllocator
+
+    a = BlockAllocator(8)
+    assert (a.used_pages, a.free_pages, a.lru_pages) == (0, 8, 0)
+    pages = a.alloc(3)
+    assert (a.used_pages, a.free_pages, a.lru_pages) == (3, 5, 0)
+    a.register(pages[0], b"key0")
+    a.free(pages)
+    # the registered page parks in the LRU; the others return to free
+    assert (a.used_pages, a.free_pages, a.lru_pages) == (0, 8, 1)
+    a.alloc(8)  # pool-wide alloc evicts the LRU page too
+    assert (a.used_pages, a.free_pages, a.lru_pages) == (8, 0, 0)

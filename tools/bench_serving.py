@@ -98,6 +98,11 @@ def main() -> None:
     reduction = (st_off["prefill_computed_tokens"]
                  / max(st_on["prefill_computed_tokens"], 1))
     dev = jax.devices()[0]
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    # peak HBM alongside tokens/s: process-aggregate accelerator stats
+    # (on CPU fallback this is host RSS — still the capacity signal)
+    mem_stats = get_accelerator().aggregate_memory_stats()
     result = {
         "metric": f"llama-{size} shared-prefix serving tok/s with prefix "
                   f"cache (prefix={n_prefix}, suffix={n_suffix}, gen={gen}, "
@@ -118,6 +123,8 @@ def main() -> None:
                   "evictions": int(st_on["cache_evictions"])},
         "identical_generations": identical,
         "mismatched_requests": mismatched,
+        "peak_hbm_bytes": int(mem_stats.get("peak_bytes_in_use", 0)),
+        "hbm_bytes_in_use": int(mem_stats.get("bytes_in_use", 0)),
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }

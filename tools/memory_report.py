@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Memory ledger / OOM-forensics demo CLI.
+
+``--demo`` runs the memory-observability path end-to-end on a tiny CPU
+model and verifies every acceptance property:
+
+* **Attribution exactness** — after a few training steps (fused +
+  incremental, so forward/backward/optimizer_step watermarks populate),
+  the ledger's training component sum (master params + optimizer state
+  + grads + scalars) must equal the structural bytes of the engine's
+  TrainState EXACTLY, and after a serving run the ``kv_pool`` /
+  ``serving_params`` components must equal the structural bytes of the
+  KV page pool and the weight copy.
+* **Watermark monotonicity** — the per-phase exit samples of the
+  process peak are non-decreasing within a step.
+* **Pool gauges** — the serving KV occupancy gauges agree with the
+  allocator's used/free/pinned counts.
+* **OOM forensics** — a simulated XLA RESOURCE_EXHAUSTED inside
+  ``engine.train_batch`` must produce a flight-recorder incident JSONL
+  holding the ledger breakdown, raw ``memory_stats()``, top live
+  buffers, and actionable hints.
+
+Writes ``memory_report.json`` (the ledger reading) plus the incident
+dump under ``--out``, prints ONE JSON summary line, and exits non-zero
+when any check fails — the acceptance gate for the memory subsystem.
+
+Knobs: ``--out DIR`` (default ./memory_demo), ``--steps N`` training
+steps (default 4), ``--serve-requests N`` (default 3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: record kinds a memory incident dump must contain
+REQUIRED_INCIDENT_KINDS = ("flight_header", "memory", "oom_incident")
+
+#: training components whose sum must equal the TrainState's bytes
+TRAIN_COMPONENTS = ("master_params", "optimizer_state", "grads",
+                    "train_scalars")
+
+
+def _mlp_spec(hidden: int = 16, nlayers: int = 2):
+    """Tiny MLP ModelSpec (mirrors tests/unit/simple_model.py, which
+    tools must not import)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.module import ModelSpec
+
+    def init_params(rng):
+        keys = jax.random.split(rng, nlayers)
+        return {f"layer_{i}": {
+            "w": jax.random.normal(k, (hidden, hidden)) * 0.1,
+            "b": jnp.zeros((hidden,))} for i, k in enumerate(keys)}
+
+    def forward(params, x):
+        for i in range(nlayers):
+            layer = params[f"layer_{i}"]
+            x = x @ layer["w"] + layer["b"]
+            if i < nlayers - 1:
+                x = jax.nn.relu(x)
+        return x
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return jnp.mean((forward(params, x) - y) ** 2)
+
+    return ModelSpec(init_params, loss_fn)
+
+
+def _structural_bytes(tree) -> int:
+    """Independent structural measurement the ledger must match: sum of
+    every leaf's addressable-shard nbytes."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            total += sum(s.data.nbytes for s in leaf.addressable_shards)
+        except Exception:
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def _train_demo(out_dir: str, steps: int):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=_mlp_spec(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "steps_per_print": 2,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "telemetry": {
+                "enabled": True,
+                "flight_recorder": {"path": os.path.join(out_dir, "flight")},
+            },
+        })
+    B = engine.config.train_batch_size
+    hidden = 16
+    rng = np.random.RandomState(0)
+
+    def batch(gas_dim=True):
+        x = rng.randn(B, hidden).astype(np.float32)
+        y = x * 0.5
+        if gas_dim:
+            return (jnp.asarray(x[None]), jnp.asarray(y[None]))
+        return (jnp.asarray(x), jnp.asarray(y))
+
+    for _ in range(steps):  # fused path: train_batch watermark
+        engine.train_batch(batch())
+    for _ in range(2):  # incremental path: forward/optimizer_step marks
+        loss = engine.forward(batch(gas_dim=False))
+        engine.backward(loss)
+        engine.step()
+    return engine
+
+
+def _serving_demo(n_requests: int):
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceConfig,
+                                                      RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=128)
+    eng = InferenceEngineV2(model, RaggedInferenceConfig(
+        page_size=16, num_pages=64, max_seqs=4, max_pages_per_seq=8,
+        enable_prefix_cache=True))
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    prefix = rng.randint(1, vocab, 32).tolist()
+    eng.generate_all([RaggedRequest(
+        prompt_ids=prefix + rng.randint(1, vocab, 8).tolist(),
+        max_new_tokens=4)])
+    eng.generate_all([RaggedRequest(
+        prompt_ids=prefix + rng.randint(1, vocab, 8).tolist(),
+        max_new_tokens=4) for _ in range(max(1, n_requests - 1))])
+    return eng
+
+
+def _force_oom(engine):
+    """Simulate an XLA RESOURCE_EXHAUSTED inside the compiled step: the
+    engine's exception path must route it through OOM forensics."""
+
+    def _raise(*_a, **_k):
+        raise RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            "9437184 bytes.")
+
+    engine._train_batch = _raise
+    try:
+        engine.train_batch((np.zeros((1, 2, 16), np.float32),
+                            np.zeros((1, 2, 16), np.float32)))
+    except RuntimeError:
+        return True  # propagated, as it must
+    return False
+
+
+def _verify_incident(flight_dir: str):
+    """Find the oom dump and check the forensics schema."""
+    problems = []
+    dumps = sorted(glob.glob(os.path.join(flight_dir, "flight_*oom*.jsonl")))
+    if not dumps:
+        return None, ["no oom incident dump written under " + flight_dir]
+    path = dumps[-1]
+    recs = [json.loads(line) for line in open(path)]
+    kinds = {r.get("kind") for r in recs}
+    for k in REQUIRED_INCIDENT_KINDS:
+        if k not in kinds:
+            problems.append(f"incident dump missing a {k!r} record")
+    inc = next((r for r in recs if r.get("kind") == "oom_incident"), {})
+    if not inc.get("hints"):
+        problems.append("oom_incident carries no hints")
+    if not inc.get("ledger", {}).get("components"):
+        problems.append("oom_incident carries no ledger breakdown")
+    if "memory_stats" not in inc:
+        problems.append("oom_incident carries no raw memory_stats")
+    if inc.get("where") != "engine.train_batch":
+        problems.append(f"oom_incident where={inc.get('where')!r}, "
+                        "expected 'engine.train_batch'")
+    return path, problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="run the tiny-CPU end-to-end demo workload")
+    ap.add_argument("--out", default="./memory_demo")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--serve-requests", type=int, default=3)
+    args = ap.parse_args(argv)
+    if not args.demo:
+        ap.error("only --demo mode is implemented; pass --demo")
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+    problems = []
+
+    from deepspeed_tpu.telemetry import get_memory_ledger, get_registry
+
+    engine = _train_demo(out_dir, args.steps)
+    led = get_memory_ledger()
+
+    # ---- training attribution is exact ---------------------------------
+    report = led.publish()
+    comp = report["components"]
+    train_sum = sum(comp[c]["device"] + comp[c]["host"]
+                    for c in TRAIN_COMPONENTS if c in comp)
+    train_expected = _structural_bytes(engine.state)
+    if train_sum != train_expected:
+        problems.append(f"training component sum {train_sum} != structural "
+                        f"TrainState bytes {train_expected}")
+
+    # ---- phase watermarks: present and monotone within the step --------
+    marks = report["watermarks"]
+    for phase in ("train_batch", "forward", "optimizer_step"):
+        if marks.get(phase, 0) <= 0:
+            problems.append(f"no {phase} watermark recorded")
+    exit_peaks = [p for _name, p in led.phase_exit_log()]
+    if not exit_peaks:
+        problems.append("empty phase exit log")
+    elif any(a > b for a, b in zip(exit_peaks, exit_peaks[1:])):
+        problems.append(f"phase exit peaks not monotone: {exit_peaks}")
+
+    # ---- serving attribution + pool gauges -----------------------------
+    serve = _serving_demo(args.serve_requests)
+    report = led.publish()
+    comp = report["components"]
+    kv_expected = _structural_bytes(serve._pools)
+    if comp.get("kv_pool", {}).get("device") != kv_expected:
+        problems.append(f"kv_pool component {comp.get('kv_pool')} != "
+                        f"structural pool bytes {kv_expected}")
+    params_expected = _structural_bytes(serve.params)
+    if comp.get("serving_params", {}).get("device") != params_expected:
+        problems.append(f"serving_params component "
+                        f"{comp.get('serving_params')} != structural "
+                        f"weight bytes {params_expected}")
+    reg = get_registry()
+    gauge_view = {
+        "used": reg.get("deepspeed_tpu_serving_kv_pages_used").value(),
+        "free": reg.get("deepspeed_tpu_serving_kv_pages_free").value(),
+        "pinned": reg.get("deepspeed_tpu_serving_kv_pages_pinned").value()}
+    alloc_view = {"used": serve.allocator.used_pages,
+                  "free": serve.allocator.free_pages,
+                  "pinned": serve.allocator.lru_pages}
+    if {k: int(v) for k, v in gauge_view.items()} != alloc_view:
+        problems.append(f"pool gauges {gauge_view} != allocator "
+                        f"{alloc_view}")
+    for phase in ("prefill", "decode"):
+        if report["watermarks"].get(phase, 0) <= 0:
+            problems.append(f"no {phase} watermark recorded")
+
+    # ---- ledger report artifact ----------------------------------------
+    report_path = os.path.join(out_dir, "memory_report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=2, default=float)
+    back = json.load(open(report_path))
+    if set(TRAIN_COMPONENTS) - set(back.get("components", {})):
+        problems.append("memory_report.json is missing training components")
+
+    # ---- forced OOM -> incident dump -----------------------------------
+    if not _force_oom(engine):
+        problems.append("simulated RESOURCE_EXHAUSTED did not propagate")
+    incident_path, inc_problems = _verify_incident(
+        os.path.join(out_dir, "flight"))
+    problems += inc_problems
+
+    oom_total = reg.get(
+        "deepspeed_tpu_memory_oom_incidents_total").total()
+    summary = {
+        "report_path": report_path,
+        "incident_path": incident_path,
+        "train_component_bytes": train_sum,
+        "train_structural_bytes": train_expected,
+        "kv_pool_bytes": kv_expected,
+        "bytes_in_use": report["bytes_in_use"],
+        "unattributed_bytes": report["unattributed_bytes"],
+        "watermarks": report["watermarks"],
+        "pool_pages": alloc_view,
+        "oom_incidents": oom_total,
+        "problems": problems,
+        "ok": not problems,
+    }
+    print(json.dumps(summary, default=float))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -166,6 +166,8 @@ REQUIRED_FAMILIES = (
     "deepspeed_tpu_serving_decode_seconds_bucket",  # latency histograms
     "deepspeed_tpu_comm_ops_total",               # comms per-op totals
     "deepspeed_tpu_comm_bytes_total",
+    "deepspeed_tpu_memory_bytes_in_use",          # memory ledger gauges
+    "deepspeed_tpu_memory_component_bytes",
 )
 
 
@@ -195,6 +197,11 @@ def main(argv=None) -> int:
     if tm.jsonl is not None:
         tm.jsonl.emit("demo_complete", steps=args.steps,
                       serve_requests=args.serve_requests)
+    from deepspeed_tpu.telemetry import get_memory_ledger
+
+    # read the ledger BEFORE close(): close releases the engine's
+    # component slots (they would otherwise pin the TrainState forever)
+    mem = get_memory_ledger().collect()
     engine.close()  # final forced export + handle release
 
     # ---- verify the artifacts ------------------------------------------
@@ -225,6 +232,13 @@ def main(argv=None) -> int:
         "mfu": reg.get("deepspeed_tpu_train_mfu").value(),
         "decode_latency_s": dec.percentiles() if dec.count() else None,
         "prefix_hit_rate": cache["prefix_hit_rate"],
+        "memory": {
+            "bytes_in_use": mem["bytes_in_use"],
+            "unattributed_bytes": mem["unattributed_bytes"],
+            "components": {k: v["device"] + v["host"]
+                           for k, v in mem["components"].items()},
+            "watermarks": mem["watermarks"],
+        },
         "missing_required": missing,
         "lint_errors": lint_errors,
         "bad_runtime_names": bad_names,
