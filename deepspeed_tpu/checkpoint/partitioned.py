@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -45,15 +46,24 @@ def _leaf_items(state: Any):
 
 def save_partitioned(engine, save_dir: str, tag: str,
                      client_state: Optional[dict] = None,
-                     checkpoint_engine=None) -> str:
+                     checkpoint_engine=None,
+                     keep_n: Optional[int] = None) -> str:
     """Each process writes its addressable shards (one file per process —
-    the analogue of per-dp-rank optim_states files)."""
+    the analogue of per-dp-rank optim_states files).
+
+    All ranks write into the ``tmp.<tag>`` staging dir; after the save
+    barrier rank 0 finalizes the verified atomic commit (checksum
+    manifest over every rank's files, fsync, atomic rename, ``latest``
+    pointer, GC) — see ``resilience/commit.py``."""
+    from ..resilience.commit import begin_commit, finalize_commit, staging_path
     from ..runtime.checkpoint_engine.engines import NumpyCheckpointEngine
 
     ce = checkpoint_engine or NumpyCheckpointEngine()
     rank = jax.process_index()
-    path = os.path.join(save_dir, tag)
-    os.makedirs(path, exist_ok=True)
+    if rank == 0:
+        begin_commit(save_dir, tag)
+    comm.barrier("stage-prep")
+    path = staging_path(save_dir, tag)
 
     arrays: Dict[str, np.ndarray] = {}
     index: Dict[str, Any] = {}
@@ -75,12 +85,22 @@ def save_partitioned(engine, save_dir: str, tag: str,
             else:
                 bf16 = False
             arrays[skey] = data
+            # per-array checksum (forensics: WHICH shard flipped — the
+            # commit manifest's per-file CRCs gate loading); buffer
+            # protocol, no .tobytes() copy
+            crc = zlib.crc32(np.ascontiguousarray(data)) & 0xFFFFFFFF
             entries.append({"key": skey, "start": [s[0] for s in norm],
-                            "stop": [s[1] for s in norm], "bf16": bf16})
+                            "stop": [s[1] for s in norm], "bf16": bf16,
+                            "crc32": crc})
         index[key] = {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
                       "shards": entries}
 
     ce.save(arrays, os.path.join(path, SHARD_FILE.format(rank=rank).replace(".npz", "")))
+    # decoupled/async engines: join the background write BEFORE the
+    # commit barrier — a failure here is attributed to THIS tag (the
+    # owning step boundary), and the manifest below must checksum
+    # fully-written files
+    ce.commit(tag)
     with open(os.path.join(path, INDEX_FILE.format(rank=rank)), "w") as f:
         json.dump(index, f)
     if rank == 0:
@@ -98,11 +118,41 @@ def save_partitioned(engine, save_dir: str, tag: str,
         }
         with open(os.path.join(path, META_FILE), "w") as f:
             json.dump(meta, f, indent=2, default=str)
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(tag)
     comm.barrier("partitioned-save")
-    log_dist(f"saved partitioned checkpoint {path}")
-    return path
+    final = os.path.join(save_dir, tag)
+    if rank == 0:
+        finalize_commit(save_dir, tag, keep_n=keep_n, meta={
+            "global_steps": engine.global_steps,
+            "world": jax.process_count(),
+            "mesh": dict(engine.topology.axis_sizes),
+        })
+    comm.barrier("partitioned-commit")
+    log_dist(f"saved partitioned checkpoint {final}")
+    return final
+
+
+def _load_shard_arrays(base: str) -> Dict[str, np.ndarray]:
+    """Load one rank's shard file regardless of which checkpoint engine
+    wrote it: ``<base>.npz`` (sync/decoupled Numpy layout) or a
+    ``<base>/`` directory with ``manifest.json`` + per-tensor bins
+    (FastCheckpointEngine layout).  Reads directly (np.fromfile) so the
+    universal/fp32 CLI tools need no AIO engine."""
+    if os.path.isdir(base):
+        with open(os.path.join(base, "manifest.json")) as f:
+            manifest = json.load(f)
+        out = {}
+        for key, info in manifest.items():
+            dtype = np.dtype(info["dtype"])
+            shape = tuple(info["shape"])
+            if info.get("empty") or 0 in shape:
+                out[key] = np.empty(shape, dtype)
+            else:
+                out[key] = np.fromfile(os.path.join(base, info["file"]),
+                                       dtype).reshape(shape)
+        return out
+    from ..runtime.checkpoint_engine.engines import NumpyCheckpointEngine
+
+    return NumpyCheckpointEngine().load(base)
 
 
 def _assemble(path: str, keys: Optional[List[str]] = None,
@@ -112,15 +162,12 @@ def _assemble(path: str, keys: Optional[List[str]] = None,
     ``.params`` must not materialize optimizer moments (2-3x the bytes)."""
     import glob
 
-    from ..runtime.checkpoint_engine.engines import NumpyCheckpointEngine
-
-    ce = NumpyCheckpointEngine()
     full: Dict[str, np.ndarray] = {}
     for idx_file in sorted(glob.glob(os.path.join(path, "shard_index_rank_*.json"))):
         rank = int(os.path.basename(idx_file).split("_rank_")[1].split(".")[0])
         with open(idx_file) as f:
             index = json.load(f)
-        arrays = ce.load(os.path.join(path, SHARD_FILE.format(rank=rank).replace(".npz", "")))
+        arrays = _load_shard_arrays(os.path.join(path, SHARD_FILE.format(rank=rank).replace(".npz", "")))
         for key, info in index.items():
             if keys is not None and key not in keys:
                 continue
@@ -146,11 +193,12 @@ def load_partitioned(engine, load_dir: str, tag: Optional[str] = None,
     import jax.numpy as jnp
 
     if tag is None:
-        latest = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' in {load_dir}")
+        from ..resilience.commit import resolve_tag
+
+        tag, _report = resolve_tag(load_dir)
+        if tag is None:
+            logger.warning(f"no loadable checkpoint in {load_dir}")
             return None, {}
-        tag = open(latest).read().strip()
     path = os.path.join(load_dir, tag)
     with open(os.path.join(path, META_FILE)) as f:
         meta = json.load(f)

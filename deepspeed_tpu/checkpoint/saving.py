@@ -41,7 +41,14 @@ def _flat_with_paths(tree: Any) -> Dict[str, Any]:
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                    client_state: Optional[dict] = None) -> str:
+                    client_state: Optional[dict] = None,
+                    keep_n: Optional[int] = None) -> str:
+    """Save through the verified atomic commit protocol
+    (``resilience/commit.py``): files land in a ``tmp.<tag>`` staging
+    dir, a checksum manifest is written, and one atomic rename commits
+    — a mid-write crash can never leave a loadable-looking torn tag."""
+    from ..resilience.commit import array_checksums, checkpoint_commit
+
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.join(save_dir, tag)
     if jax.process_count() > 1:
@@ -52,7 +59,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "multi-host partitioned checkpointing is not yet implemented")
     comm.barrier("pre-save")
     if jax.process_index() == 0:
-        os.makedirs(path, exist_ok=True)
         flat = _flat_with_paths(engine.state)
         arrays = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
         # bfloat16 has no numpy dtype; store as uint16 view + dtype note
@@ -61,21 +67,27 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             if v.dtype.name == "bfloat16":
                 arrays[k] = v.view(np.uint16)
                 dtypes[k] = "bfloat16"
-        np.savez(os.path.join(path, MODEL_FILE), **arrays)
-        meta = {
-            "tag": tag,
+        commit_meta = {
             "global_steps": engine.global_steps,
-            "micro_steps": engine.micro_steps,
-            "lr_scheduler": engine.lr_scheduler.state_dict()
-            if hasattr(engine.lr_scheduler, "state_dict") else None,
-            "client_state": client_state or {},
-            "bfloat16_keys": dtypes,
-            "zero_stage": engine.config.zero_config.stage,
+            "world": jax.process_count(),
+            "mesh": dict(engine.topology.axis_sizes),
+            "array_crc32": array_checksums(arrays),
         }
-        with open(os.path.join(path, META_FILE), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
-        with open(os.path.join(save_dir, LATEST), "w") as f:
-            f.write(tag)
+        with checkpoint_commit(save_dir, tag, meta=commit_meta,
+                               keep_n=keep_n) as staging:
+            np.savez(os.path.join(staging, MODEL_FILE), **arrays)
+            meta = {
+                "tag": tag,
+                "global_steps": engine.global_steps,
+                "micro_steps": engine.micro_steps,
+                "lr_scheduler": engine.lr_scheduler.state_dict()
+                if hasattr(engine.lr_scheduler, "state_dict") else None,
+                "client_state": client_state or {},
+                "bfloat16_keys": dtypes,
+                "zero_stage": engine.config.zero_config.stage,
+            }
+            with open(os.path.join(staging, META_FILE), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
     comm.barrier("post-save")
     log_dist(f"saved checkpoint {path}")
     return path
@@ -85,12 +97,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True) -> Tuple[Optional[str], dict]:
     if tag is None:
-        latest = os.path.join(load_dir, LATEST)
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
+        from ..resilience.commit import resolve_tag
+
+        tag, _report = resolve_tag(load_dir)
+        if tag is None:
+            logger.warning(f"no loadable checkpoint in {load_dir}; "
+                           "nothing loaded")
             return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
     path = os.path.join(load_dir, tag)
     with open(os.path.join(path, META_FILE)) as f:
         meta = json.load(f)

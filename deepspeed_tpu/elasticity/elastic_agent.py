@@ -18,11 +18,14 @@ guarantee.
 
 from __future__ import annotations
 
+import random
 import subprocess
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
+from ..resilience.preemption import (EXIT_RESUMABLE,
+                                     NON_RESUMABLE_EXIT_CODES)
 from ..utils.logging import logger
 from .elasticity import compute_elastic_config  # noqa: F401  (re-export)
 
@@ -31,14 +34,36 @@ DEFAULT_COORD_PORT = 29500
 
 class ElasticAgent:
     """Launcher watchdog: relaunch-on-failure with per-attempt host
-    re-discovery (reference DSElasticAgent intent)."""
+    re-discovery (reference DSElasticAgent intent).
+
+    Exit-code policy (``resilience/preemption.py`` contract):
+
+    * ``EXIT_RESUMABLE`` (75) — a preemption-watcher exit after an
+      emergency save: relaunch immediately WITHOUT consuming the
+      failure budget (a preemption is not a failure), bounded by
+      ``max_preemption_restarts`` so a pathological always-75 script
+      cannot loop forever.
+    * non-resumable codes (config/usage errors, default
+      ``NON_RESUMABLE_EXIT_CODES``) — stop immediately: a relaunch
+      would fail identically.
+    * anything else non-zero — a crash: retry up to ``max_restarts``
+      with exponential backoff + jitter (``restart_delay_s`` is the
+      base, doubled per consecutive failure, capped at
+      ``max_restart_delay_s``) so a crash-looping fleet does not
+      hammer the rendezvous/filesystem in lockstep.
+    """
 
     def __init__(self, hostfile: Optional[str] = None, include: str = "",
                  exclude: str = "", max_restarts: int = 3,
                  master_addr: Optional[str] = None,
                  master_port: int = DEFAULT_COORD_PORT, ssh_port: int = 22,
                  restart_delay_s: float = 1.0,
-                 export_env: Optional[Dict[str, str]] = None):
+                 max_restart_delay_s: float = 60.0,
+                 backoff_jitter: float = 0.25,
+                 non_resumable_exit_codes: Optional[Iterable[int]] = None,
+                 max_preemption_restarts: int = 16,
+                 export_env: Optional[Dict[str, str]] = None,
+                 seed: Optional[int] = None):
         self.hostfile = hostfile
         self.include = include
         self.exclude = exclude
@@ -46,10 +71,19 @@ class ElasticAgent:
         self.master_addr = master_addr
         self.master_port = master_port
         self.ssh_port = ssh_port
-        self.restart_delay_s = restart_delay_s
+        self.restart_delay_s = float(restart_delay_s)
+        self.max_restart_delay_s = float(max_restart_delay_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.non_resumable_exit_codes = set(
+            NON_RESUMABLE_EXIT_CODES if non_resumable_exit_codes is None
+            else non_resumable_exit_codes)
+        self.max_preemption_restarts = int(max_preemption_restarts)
         self.export_env = export_env
         self.attempts = 0
+        self.preemptions = 0
         self.world_sizes: List[int] = []  # per-attempt world size (observability)
+        self.delays: List[float] = []  # per-restart backoff actually slept
+        self._rand = random.Random(seed)
 
     def _hosts(self) -> "OrderedDict[str, int]":
         """Re-read the hostfile every attempt: a resize between attempts is
@@ -69,27 +103,81 @@ class ElasticAgent:
             rc = rc or p.returncode
         return rc
 
+    def _backoff_delay(self, consecutive_failures: int) -> float:
+        """Exponential backoff + jitter: base * 2^(failures-1), capped,
+        then up to ``backoff_jitter`` of random spread on top."""
+        delay = min(self.max_restart_delay_s,
+                    self.restart_delay_s * (2 ** max(0, consecutive_failures - 1)))
+        return delay * (1.0 + self.backoff_jitter * self._rand.random())
+
+    def _note(self, **fields) -> None:
+        """Per-attempt record through the telemetry event ring when a
+        flight recorder is installed (black-box evidence of the restart
+        history survives into incident dumps)."""
+        try:
+            from ..telemetry.flight import get_flight_recorder
+
+            fr = get_flight_recorder()
+            if fr is not None:
+                fr.note("elastic_attempt", **fields)
+        except Exception:
+            pass
+
     def run(self, script: str, script_args: Optional[List[str]] = None) -> int:
         from ..launcher.runner import build_launch_commands
 
         script_args = list(script_args or [])
-        rc = 1
-        for attempt in range(self.max_restarts + 1):
+        failures = 0
+        self.attempts = 0
+        self.preemptions = 0
+        while True:
             hosts = self._hosts()
-            self.attempts = attempt + 1
+            self.attempts += 1
             self.world_sizes.append(len(hosts))
+            self._note(attempt=self.attempts, world=len(hosts),
+                       failures=failures, preemptions=self.preemptions)
             cmds = build_launch_commands(
                 hosts, script, script_args, self.master_addr,
                 self.master_port, export_env=self.export_env,
                 ssh_port=self.ssh_port)
-            if attempt:
+            if self.attempts > 1:
                 logger.warning(
-                    f"elastic agent: restart {attempt}/{self.max_restarts} "
-                    f"with {len(hosts)} host(s)")
+                    f"elastic agent: relaunch (attempt {self.attempts}, "
+                    f"{failures}/{self.max_restarts} failures, "
+                    f"{self.preemptions} preemptions) with "
+                    f"{len(hosts)} host(s)")
             rc = self._run_attempt(cmds)
             if rc == 0:
                 return 0
-            logger.warning(f"elastic agent: attempt {attempt + 1} exited rc={rc}")
-            if attempt < self.max_restarts:
-                time.sleep(self.restart_delay_s)
-        return rc
+            self._note(attempt=self.attempts, world=len(hosts), rc=rc)
+            if rc == EXIT_RESUMABLE:
+                # preemption-watcher exit after an emergency save: not a
+                # failure — relaunch to auto-resume, budget untouched
+                self.preemptions += 1
+                if self.preemptions > self.max_preemption_restarts:
+                    logger.error(
+                        f"elastic agent: {self.preemptions} preemption exits "
+                        "exceed max_preemption_restarts; giving up")
+                    return rc
+                logger.warning(
+                    f"elastic agent: resumable exit rc={rc} (preemption "
+                    f"{self.preemptions}); relaunching to auto-resume")
+                continue
+            if rc in self.non_resumable_exit_codes:
+                logger.error(
+                    f"elastic agent: non-resumable exit rc={rc} (config/"
+                    "usage error class); NOT relaunching — a restart "
+                    "would fail identically")
+                return rc
+            failures += 1
+            logger.warning(f"elastic agent: attempt {self.attempts} "
+                           f"exited rc={rc} (failure {failures}/"
+                           f"{self.max_restarts})")
+            if failures > self.max_restarts:
+                return rc
+            delay = self._backoff_delay(failures)
+            self.delays.append(delay)
+            if delay > 0:
+                logger.warning(f"elastic agent: backing off {delay:.2f}s "
+                               "before relaunch")
+                time.sleep(delay)

@@ -24,6 +24,16 @@ import numpy as np
 from ...utils.logging import log_dist, logger
 
 
+class CheckpointSaveError(RuntimeError):
+    """A (possibly background) checkpoint write failed.  Carries the
+    failed path so an async failure surfacing later is attributed to
+    the save that OWNED it, not whichever step happened to join."""
+
+    def __init__(self, msg: str, path: Optional[str] = None):
+        super().__init__(msg)
+        self.path = path
+
+
 class CheckpointEngine:
     def save(self, arrays: Dict[str, np.ndarray], path: str) -> None:
         raise NotImplementedError
@@ -59,14 +69,29 @@ class FastCheckpointEngine(CheckpointEngine):
         os.makedirs(path, exist_ok=True)
         manifest = {}
         for i, (key, arr) in enumerate(arrays.items()):
-            arr = np.ascontiguousarray(arr)
-            fname = f"t{i:05d}.bin"
-            manifest[key] = {"file": fname, "dtype": str(arr.dtype),
-                             "shape": list(arr.shape)}
-            self.aio.async_pwrite(arr, os.path.join(path, fname))
+            shape = list(np.shape(arr))  # before ascontiguousarray: it
+            arr = np.ascontiguousarray(arr)  # promotes 0-d to (1,)
+            entry = {"dtype": str(arr.dtype), "shape": shape}
+            if arr.size == 0:
+                # zero-size arrays round-trip explicitly via the
+                # manifest alone — a 0-byte AIO write is ambiguous
+                # (indistinguishable from a torn file) and wasteful
+                entry["empty"] = True
+            else:
+                fname = f"t{i:05d}.bin"
+                entry["file"] = fname
+                self.aio.async_pwrite(arr, os.path.join(path, fname))
+            manifest[key] = entry
         self.aio.drain()
-        with open(os.path.join(path, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
+        # tmp-file + fsync + atomic rename (resilience/commit.py's
+        # primitive): a crash after the data writes but mid-manifest
+        # must not leave an undetectably half-described directory —
+        # the manifest either fully exists or not at all
+        # (no manifest = no checkpoint)
+        from ...resilience.commit import atomic_write_text
+
+        atomic_write_text(os.path.join(path, "manifest.json"),
+                          json.dumps(manifest))
 
     def load(self, path):
         with open(os.path.join(path, "manifest.json")) as f:
@@ -75,8 +100,11 @@ class FastCheckpointEngine(CheckpointEngine):
         arrs = []
         for key, info in manifest.items():
             arr = np.empty(info["shape"], np.dtype(info["dtype"]))
-            self.aio.async_pread(arr.reshape(-1).view(np.uint8)
-                                 if arr.size else arr, os.path.join(path, info["file"]))
+            if info.get("empty") or arr.size == 0:
+                out[key] = arr  # no backing file by contract
+                continue
+            self.aio.async_pread(arr.reshape(-1).view(np.uint8),
+                                 os.path.join(path, info["file"]))
             arrs.append((key, arr))
         self.aio.drain()
         for key, arr in arrs:
@@ -92,32 +120,52 @@ class DecoupledCheckpointEngine(CheckpointEngine):
         self.inner = inner or NumpyCheckpointEngine()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        #: path of the save the in-flight (or last-joined) thread owns —
+        #: error attribution must name IT, not the save that joins
+        self._inflight_path: Optional[str] = None
 
     def save(self, arrays, path):
-        self.commit("previous")  # one in flight at a time
+        # one in flight at a time: join the previous save first.  If it
+        # failed, the error raised HERE names the previous save's
+        # tag/path (self._inflight_path), so the failure is attributed
+        # to the step that owned it — not silently blamed on this one.
+        self._join_inflight()
         snapshot = {k: np.array(v, copy=True) for k, v in arrays.items()}
+        self._inflight_path = path
 
         def _run():
             try:
                 self.inner.save(snapshot, path)
-            except BaseException as e:  # surfaced at commit
+            except BaseException as e:  # surfaced at the owning commit
                 self._error = e
 
         self._thread = threading.Thread(target=_run, daemon=True)
         self._thread.start()
 
     def load(self, path):
-        self.commit("pre-load")
+        self._join_inflight()
         return self.inner.load(path)
 
     def commit(self, tag: str) -> bool:
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-            if self._error is not None:
-                err, self._error = self._error, None
-                raise err
+        """Join the in-flight write (the owning step boundary calls this
+        with ITS tag before the commit-protocol finalize)."""
+        self._join_inflight(tag=tag)
         return True
+
+    def _join_inflight(self, tag: Optional[str] = None) -> None:
+        if self._thread is None:
+            return
+        self._thread.join()
+        self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            failed = self._inflight_path
+            self._inflight_path = None
+            raise CheckpointSaveError(
+                f"decoupled checkpoint: background save of '{failed}'"
+                f"{f' (committing tag {tag!r})' if tag else ''} "
+                f"failed: {err!r}", path=failed) from err
+        self._inflight_path = None
 
 
 class NebulaCheckpointEngine(DecoupledCheckpointEngine):

@@ -400,6 +400,41 @@ class CheckpointConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class ResilienceConfig(ConfigModel):
+    """``resilience`` block: preemption-aware emergency checkpoints,
+    verified atomic commits, auto-resume and checkpoint-I/O retries
+    (see ``deepspeed_tpu/resilience/`` and ``docs/RESILIENCE.md``).
+
+    ``save_dir`` is both where emergency checkpoints go and where
+    ``auto_resume`` looks for the latest *verified* checkpoint on
+    engine startup.  ``keep_n`` bounds the committed tags kept on disk
+    (partial ``tmp.*`` staging dirs are always garbage-collected).
+    ``watch_signals`` installs SIGTERM/SIGINT handlers for the
+    preemption watcher (off for embedded/test use — ``notify()`` still
+    works)."""
+
+    enabled: bool = False
+    save_dir: str = ""
+    auto_resume: bool = True
+    emergency_save: bool = True
+    keep_n: int = 3
+    io_retries: int = 3
+    io_retry_base_s: float = 0.1
+    watch_signals: bool = True
+
+    def validate(self) -> None:
+        if self.keep_n < 1:
+            raise ValueError(f"resilience.keep_n must be >= 1, got {self.keep_n}")
+        if self.io_retries < 0:
+            raise ValueError("resilience.io_retries must be >= 0")
+        if self.enabled and (self.auto_resume or self.emergency_save) \
+                and not self.save_dir:
+            raise ValueError(
+                "resilience.enabled with auto_resume/emergency_save needs "
+                "resilience.save_dir (where checkpoints live)")
+
+
+@dataclasses.dataclass
 class HybridEngineConfig(ConfigModel):
     """hybrid_engine block (reference runtime/hybrid_engine.py config):
     RLHF-style flip-flopping between training and generation on one copy
@@ -463,6 +498,7 @@ class DeepSpeedConfig:
     checkpoint: CheckpointConfig
     compression: GradientCompressionConfig
     hybrid_engine: HybridEngineConfig
+    resilience: ResilienceConfig
     zero_allow_untested_optimizer: bool
     gradient_accumulation_dtype: str
 
@@ -515,6 +551,7 @@ class DeepSpeedConfig:
         self.checkpoint = CheckpointConfig.from_dict(g("checkpoint"))
         self.compression = GradientCompressionConfig.from_dict(g("gradient_compression"))
         self.hybrid_engine = HybridEngineConfig.from_dict(g("hybrid_engine"))
+        self.resilience = ResilienceConfig.from_dict(g("resilience"))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")

@@ -230,6 +230,15 @@ class DeepSpeedTPUEngine:
                 from ..compile.backend import PASS_REGISTRY
 
                 PASS_REGISTRY["offload_params"](self)
+        # resilience (docs/RESILIENCE.md): preemption watcher + startup
+        # auto-resume from the latest VERIFIED checkpoint.  Last in init:
+        # the resume reshards into the fully-built engine (any mesh/stage).
+        self.resilience = None
+        if config.resilience.enabled:
+            from ..resilience import ResilienceManager
+
+            self.resilience = ResilienceManager(config.resilience)
+            self.resilience.maybe_auto_resume(self)
         log_dist(f"DeepSpeedTPUEngine initialized: zero_stage={config.zero_config.stage} "
                  f"dtype={self.compute_dtype.__name__} mesh={self.topology.axis_sizes} "
                  f"micro_bs={config.train_micro_batch_size_per_gpu} "
@@ -1036,6 +1045,11 @@ class DeepSpeedTPUEngine:
         if self.telemetry is not None:
             self._report_telemetry(loss, batch, time.perf_counter() - t0)
         self._report(loss)
+        if self.resilience is not None:
+            # pending preemption notice -> emergency save + resumable
+            # exit, honored HERE (a consistent step boundary), never
+            # mid-step (raises PreemptionInterrupt, a SystemExit)
+            self.resilience.at_step_boundary(self)
         return loss
 
     def forward(self, batch):
@@ -1100,6 +1114,8 @@ class DeepSpeedTPUEngine:
             if self.telemetry is not None:
                 self._report_telemetry(self._cached_loss, None)
             self._report(self._cached_loss)
+            if self.resilience is not None:
+                self.resilience.at_step_boundary(self)
         self.timers(STEP_GLOBAL_TIMER).stop()
         if self.flops_profiler is not None:
             self.flops_profiler.stop_profile_maybe(self.global_steps)
@@ -1355,6 +1371,10 @@ class DeepSpeedTPUEngine:
             self.telemetry.close()
         if self.monitor is not None:
             self.monitor.close()
+        if self.resilience is not None:
+            # restore the previous signal handlers — a later engine (or
+            # the embedding process) owns SIGTERM/SIGINT again
+            self.resilience.close()
         # release our ledger slots AFTER the final export (so it still
         # shows them) — the provider closures would otherwise keep this
         # engine's TrainState reachable for the process lifetime.
@@ -1407,36 +1427,60 @@ class DeepSpeedTPUEngine:
         tag = tag or f"global_step{self.global_steps}"
         if partitioned is None:
             partitioned = jax.process_count() > 1
-        with span("checkpoint_save", cat="ckpt", tag=tag,
-                  partitioned=partitioned):
+        rcfg = self.config.resilience
+        keep_n = rcfg.keep_n if rcfg.enabled else None
+
+        def _save():
             if partitioned:
                 from ..checkpoint.partitioned import save_partitioned
                 from .checkpoint_engine.engines import make_checkpoint_engine
 
                 return save_partitioned(
                     self, save_dir, tag, client_state or {},
-                    checkpoint_engine=make_checkpoint_engine(self.config))
+                    checkpoint_engine=make_checkpoint_engine(self.config),
+                    keep_n=keep_n)
             from ..checkpoint.saving import save_checkpoint
 
             return save_checkpoint(self, save_dir, tag=tag,
-                                   client_state=client_state or {})
+                                   client_state=client_state or {},
+                                   keep_n=keep_n)
+
+        with span("checkpoint_save", cat="ckpt", tag=tag,
+                  partitioned=partitioned):
+            if rcfg.enabled and rcfg.io_retries:
+                from ..resilience.commit import io_retry
+
+                # a failed+retried save restages from scratch (the
+                # commit protocol resets tmp.<tag>), so retry is safe
+                return io_retry(_save, retries=rcfg.io_retries,
+                                base_delay_s=rcfg.io_retry_base_s,
+                                what=f"checkpoint save '{tag}'")
+            return _save()
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
+        """Verified load: the tag is resolved through the resilience
+        commit protocol — checksums checked, corrupt newest tags
+        counted + skipped in favor of the previous good one (explicit
+        corrupt tags raise ``CorruptCheckpointError``); legacy
+        checkpoints without a manifest load unverified."""
         import os
 
         from ..checkpoint.partitioned import META_FILE, load_partitioned
         from ..checkpoint.saving import load_checkpoint
+        from ..resilience.commit import resolve_tag
 
-        resolved = tag
+        resolved, _report = resolve_tag(load_dir, tag)
         if resolved is None:
-            latest = os.path.join(load_dir, "latest")
-            if os.path.exists(latest):
-                resolved = open(latest).read().strip()
-        with span("checkpoint_load", cat="ckpt", tag=resolved or ""):
-            if resolved and os.path.exists(
-                    os.path.join(load_dir, resolved, META_FILE)):
+            # resolution already walked (and incident-logged) every
+            # candidate; re-entering the loaders would re-resolve and
+            # double-count the corruption metric
+            logger.warning(f"no loadable checkpoint in {load_dir}; "
+                           "nothing loaded")
+            return None, {}
+        with span("checkpoint_load", cat="ckpt", tag=resolved):
+            if os.path.exists(os.path.join(load_dir, resolved, META_FILE)):
                 return load_partitioned(self, load_dir, tag=resolved)
-            return load_checkpoint(self, load_dir, tag=tag)
+            return load_checkpoint(self, load_dir, tag=resolved)
 
     # batch-size accessors (reference engine API)
     def train_micro_batch_size_per_gpu(self) -> int:

@@ -244,7 +244,11 @@ def test_offload_fp16_dynamic_scaling_survives_overflow():
     losses = [float(engine.train_batch(random_batch(batch_size=16,
                                                     seed=i % 4, gas=1)))
               for i in range(8)]
-    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # seed-matched epochs (seeds cycle 0-3): losses[0:4] and losses[4:8]
+    # see the same batches — the raw losses[-1] < losses[0] comparison
+    # of two DIFFERENT batches was env-numerics-dependent and flaked
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[4:8]) < np.mean(losses[0:4]), losses
     scale_before = float(engine.state.loss_scale.cur_scale)
     step_before = int(engine.state.step)
     params_before = jax.tree_util.tree_map(np.asarray, engine.state.params)

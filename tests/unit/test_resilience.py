@@ -1,0 +1,484 @@
+"""Resilience subsystem tests (deepspeed_tpu/resilience/): verified
+atomic commits, corruption fallback, preemption watcher + emergency
+save, auto-resume, I/O retry, chaos injectors, and the elastic agent's
+exit-code/backoff policy.  See docs/RESILIENCE.md."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
+from deepspeed_tpu.resilience import (CorruptCheckpointError,
+                                      PreemptionInterrupt, chaos,
+                                      metrics as res_metrics)
+from deepspeed_tpu.resilience.commit import (MANIFEST, begin_commit,
+                                             checkpoint_commit, gc_tags,
+                                             io_retry, list_tags,
+                                             resolve_tag, verify_tag)
+from deepspeed_tpu.resilience.preemption import (EXIT_CONFIG, EXIT_RESUMABLE,
+                                                 PreemptionWatcher,
+                                                 exit_code_for_exception)
+from deepspeed_tpu.runtime.checkpoint_engine.engines import (
+    CheckpointEngine, CheckpointSaveError, DecoupledCheckpointEngine,
+    FastCheckpointEngine, NumpyCheckpointEngine)
+from tests.unit.simple_model import random_batch, simple_mlp_spec
+
+
+def _engine(resilience=None, checkpoint=None, stage=0):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+    }
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    if checkpoint is not None:
+        cfg["checkpoint"] = checkpoint
+    engine, *_ = deepspeed_tpu.initialize(model=simple_mlp_spec(), config=cfg)
+    return engine
+
+
+def _params_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y)),
+            rtol=1e-6), a, b)
+
+
+def _train(engine, steps, start=0):
+    return [float(engine.train_batch(random_batch(batch_size=8,
+                                                  seed=(start + i) % 3, gas=1)))
+            for i in range(steps)]
+
+
+# ------------------------------------------------------------ commit protocol
+def test_commit_layout_and_verification(tmp_path, devices8):
+    e = _engine()
+    _train(e, 2)
+    path = e.save_checkpoint(str(tmp_path))
+    assert os.path.isdir(path) and path.endswith("global_step2")
+    assert os.path.exists(os.path.join(path, MANIFEST))
+    # no staging debris; latest pointer committed atomically
+    assert not [f for f in os.listdir(tmp_path) if f.startswith("tmp.")]
+    assert open(tmp_path / "latest").read().strip() == "global_step2"
+    report = verify_tag(str(tmp_path), "global_step2")
+    assert report["ok"] and report["verified"] and not report["problems"]
+    # manifest carries step/world/mesh metadata + per-array checksums
+    man = chaos.read_manifest(str(tmp_path), "global_step2")
+    assert man["meta"]["global_steps"] == 2
+    assert man["meta"]["world"] == 1
+    assert "data" in man["meta"]["mesh"]
+    assert man["meta"]["array_crc32"]
+    assert all("crc32" in info for info in man["files"].values())
+
+
+def test_unfinalized_staging_is_invisible_and_gced(tmp_path, devices8):
+    # simulate a crash strictly before the commit point: staged files
+    # exist, no rename happened
+    staging = begin_commit(str(tmp_path), "crashed")
+    with open(os.path.join(staging, "model.bin"), "wb") as f:
+        f.write(b"x" * 128)
+    tag, report = resolve_tag(str(tmp_path))
+    assert tag is None and not report["ok"]
+    # the next successful save garbage-collects the partial staging dir
+    e = _engine()
+    _train(e, 1)
+    e.save_checkpoint(str(tmp_path))
+    assert not [f for f in os.listdir(tmp_path) if f.startswith("tmp.")]
+    tag, _ = resolve_tag(str(tmp_path))
+    assert tag == "global_step1"
+
+
+def test_partial_staging_from_chaos_is_never_a_candidate(tmp_path):
+    chaos.make_partial_staging(str(tmp_path), "t9")
+    assert list_tags(str(tmp_path)) == []
+    removed = gc_tags(str(tmp_path))
+    assert removed == ["tmp.t9"]
+
+
+def test_gc_keep_n(tmp_path, devices8):
+    e = _engine(resilience={"enabled": True, "save_dir": str(tmp_path),
+                            "auto_resume": False, "emergency_save": False,
+                            "keep_n": 2, "watch_signals": False})
+    for _ in range(4):
+        _train(e, 1)
+        e.save_checkpoint(str(tmp_path))
+    tags = list_tags(str(tmp_path))
+    assert tags == ["global_step4", "global_step3"]
+    assert open(tmp_path / "latest").read().strip() == "global_step4"
+
+
+def test_bitflip_detected_counted_and_fallback(tmp_path, devices8):
+    e1 = _engine()
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    good_params = jax.tree_util.tree_map(np.asarray, e1.state.params)
+    _train(e1, 1, start=1)
+    e1.save_checkpoint(str(tmp_path))
+    chaos.bitflip_array(str(tmp_path), "global_step2", seed=3)
+
+    before = res_metrics.corrupt_checkpoints_total().total()
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step1")
+    assert e2.global_steps == 1
+    _params_equal(e2.state.params, good_params)
+    assert res_metrics.corrupt_checkpoints_total().total() == before + 1
+
+
+def test_torn_manifest_falls_back(tmp_path, devices8):
+    e1 = _engine()
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    _train(e1, 1, start=1)
+    e1.save_checkpoint(str(tmp_path))
+    chaos.tear_manifest(str(tmp_path), "global_step2")
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step1") and e2.global_steps == 1
+
+
+def test_explicit_corrupt_tag_raises(tmp_path, devices8):
+    e1 = _engine()
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    chaos.bitflip_array(str(tmp_path), "global_step1", seed=0)
+    e2 = _engine()
+    with pytest.raises(CorruptCheckpointError, match="global_step1"):
+        e2.load_checkpoint(str(tmp_path), tag="global_step1")
+
+
+def test_stale_latest_pointer_falls_back(tmp_path, devices8):
+    e1 = _engine()
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    chaos.corrupt_latest_pointer(str(tmp_path))
+    before = res_metrics.corrupt_checkpoints_total().total()
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step1")
+    # a dangling pointer is a lookup failure, not data corruption
+    assert res_metrics.corrupt_checkpoints_total().total() == before
+
+
+def test_explicit_missing_tag_is_not_corruption(tmp_path, devices8):
+    e1 = _engine()
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    before = res_metrics.corrupt_checkpoints_total().total()
+    e2 = _engine()
+    with pytest.raises(FileNotFoundError, match="no_such_tag"):
+        e2.load_checkpoint(str(tmp_path), tag="no_such_tag")
+    assert res_metrics.corrupt_checkpoints_total().total() == before
+
+
+def test_foreign_subdirs_survive_gc_and_resolution(tmp_path, devices8):
+    # a save_dir that also holds non-checkpoint dirs (tensorboard/,
+    # logs/): GC must never delete them, resolution must never load them
+    logs = tmp_path / "tensorboard"
+    logs.mkdir()
+    (logs / "events.out").write_text("not a checkpoint")
+    e = _engine(resilience={"enabled": True, "save_dir": str(tmp_path),
+                            "auto_resume": False, "emergency_save": False,
+                            "keep_n": 1, "watch_signals": False})
+    for _ in range(3):
+        _train(e, 1)
+        e.save_checkpoint(str(tmp_path))
+    assert (logs / "events.out").exists()  # keep_n GC left it alone
+    assert list_tags(str(tmp_path)) == ["global_step3"]
+    chaos.corrupt_latest_pointer(str(tmp_path), target="tensorboard")
+    tag, _ = resolve_tag(str(tmp_path))
+    assert tag == "global_step3"  # the foreign dir is not a candidate
+
+
+def test_manifest_entry_without_crc_is_reported_not_crash(tmp_path, devices8):
+    e = _engine()
+    _train(e, 1)
+    e.save_checkpoint(str(tmp_path))
+    man_path = tmp_path / "global_step1" / MANIFEST
+    man = json.loads(man_path.read_text())
+    next(iter(man["files"].values())).pop("crc32")  # version-skewed entry
+    man_path.write_text(json.dumps(man))
+    report = verify_tag(str(tmp_path), "global_step1")
+    assert not report["ok"] and report["problems"]  # reported, no TypeError
+
+
+def test_legacy_checkpoint_without_manifest_loads_unverified(tmp_path, devices8):
+    e1 = _engine()
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    os.remove(tmp_path / "global_step1" / MANIFEST)
+    report = verify_tag(str(tmp_path), "global_step1")
+    assert report["ok"] and not report["verified"]
+    e2 = _engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step1") and e2.global_steps == 1
+
+
+# --------------------------------------------------------- checkpoint engines
+@pytest.mark.parametrize("ckpt_cfg", [
+    {},                                   # sync NumpyCheckpointEngine
+    {"parallel_write_pipeline": True},    # FastCheckpointEngine (AIO)
+    {"async_save": True},                 # DecoupledCheckpointEngine
+], ids=["sync", "fast", "decoupled"])
+def test_engine_roundtrip_every_checkpoint_engine_kind(tmp_path, devices8,
+                                                       ckpt_cfg):
+    e1 = _engine(checkpoint=ckpt_cfg, stage=2)
+    _train(e1, 2)
+    e1.save_checkpoint(str(tmp_path), partitioned=True)
+    report = verify_tag(str(tmp_path), "global_step2")
+    assert report["ok"] and report["verified"]
+    e2 = _engine(checkpoint=ckpt_cfg, stage=2)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and e2.global_steps == 2
+    _params_equal(e1.state.params, e2.state.params)
+    _train(e2, 1)  # loaded state trains on
+
+
+def test_fast_engine_zero_size_arrays_roundtrip(tmp_path):
+    ce = FastCheckpointEngine(thread_count=2)
+    arrays = {"empty1d": np.empty((0,), np.float32),
+              "empty2d": np.empty((3, 0), np.int32),
+              "scalar": np.float32(7.0).reshape(()),
+              "normal": np.arange(12, dtype=np.float32).reshape(3, 4)}
+    ce.save(arrays, str(tmp_path / "fast"))
+    out = ce.load(str(tmp_path / "fast"))
+    for k, v in arrays.items():
+        assert out[k].shape == v.shape and out[k].dtype == v.dtype, k
+        np.testing.assert_array_equal(out[k], v)
+    # zero-size entries are manifest-only (no ambiguous 0-byte files)
+    with open(tmp_path / "fast" / "manifest.json") as f:
+        man = json.load(f)
+    assert man["empty1d"].get("empty") and "file" not in man["empty1d"]
+
+
+def test_fast_engine_manifest_written_atomically(tmp_path):
+    ce = FastCheckpointEngine(thread_count=2)
+    ce.save({"a": np.ones(8, np.float32)}, str(tmp_path / "fast"))
+    files = os.listdir(tmp_path / "fast")
+    assert "manifest.json" in files
+    assert not [f for f in files if ".tmp." in f], files
+
+
+class _FailingInner(CheckpointEngine):
+    def save(self, arrays, path):
+        raise IOError(f"disk on fire while writing {path}")
+
+
+class _RecordingInner(CheckpointEngine):
+    def __init__(self):
+        self.events = []
+
+    def save(self, arrays, path):
+        import time
+
+        self.events.append(("start", path))
+        time.sleep(0.1)
+        self.events.append(("end", path))
+
+    def load(self, path):
+        return {}
+
+
+def test_decoupled_failure_attributed_to_owning_save(tmp_path):
+    ce = DecoupledCheckpointEngine(inner=_FailingInner())
+    ce.save({"x": np.ones(4, np.float32)}, str(tmp_path / "first_ckpt"))
+    # the failure surfaces at the next boundary, naming the save that
+    # OWNED it (first_ckpt) — not the save that happened to join
+    with pytest.raises(CheckpointSaveError, match="first_ckpt") as ei:
+        ce.save({"x": np.ones(4, np.float32)}, str(tmp_path / "second_ckpt"))
+    assert ei.value.path == str(tmp_path / "first_ckpt")
+    assert "second_ckpt" not in str(ei.value)
+    # the engine recovered: the error was consumed, next commit is clean
+    assert ce.commit("after") is True
+
+
+def test_decoupled_commit_reports_owning_tag(tmp_path):
+    ce = DecoupledCheckpointEngine(inner=_FailingInner())
+    ce.save({"x": np.ones(4, np.float32)}, str(tmp_path / "ck"))
+    with pytest.raises(CheckpointSaveError, match="tag 'step7'"):
+        ce.commit("step7")
+
+
+def test_decoupled_one_in_flight_contract(tmp_path):
+    inner = _RecordingInner()
+    ce = DecoupledCheckpointEngine(inner=inner)
+    ce.save({"x": np.ones(4, np.float32)}, str(tmp_path / "a"))
+    ce.save({"x": np.ones(4, np.float32)}, str(tmp_path / "b"))
+    ce.commit("final")
+    # writes never interleave: a fully ends before b starts
+    assert inner.events == [("start", str(tmp_path / "a")),
+                            ("end", str(tmp_path / "a")),
+                            ("start", str(tmp_path / "b")),
+                            ("end", str(tmp_path / "b"))]
+
+
+# ------------------------------------------------- preemption + auto-resume
+def test_preemption_emergency_save_and_resumable_exit(tmp_path, devices8):
+    res = {"enabled": True, "save_dir": str(tmp_path), "keep_n": 4,
+           "watch_signals": False}
+    e = _engine(resilience=res)
+    _train(e, 2)
+    before = res_metrics.emergency_saves_total().total()
+    chaos.simulate_preemption(e.resilience)
+    # honored at the NEXT step boundary: the step completes, then the
+    # engine emergency-saves and exits resumable
+    with pytest.raises(PreemptionInterrupt) as ei:
+        e.train_batch(random_batch(batch_size=8, seed=0, gas=1))
+    assert ei.value.code == EXIT_RESUMABLE
+    assert res_metrics.emergency_saves_total().total() == before + 1
+    report = verify_tag(str(tmp_path), "emergency_step3")
+    assert report["ok"] and report["verified"]
+    assert open(tmp_path / "latest").read().strip() == "emergency_step3"
+
+    # a PreemptionInterrupt is a SystemExit: it must NOT be swallowed by
+    # generic except-Exception handlers in user loops
+    assert isinstance(ei.value, SystemExit)
+
+    # relaunch: a fresh engine auto-resumes from the emergency tag
+    restores_before = res_metrics.restores_total().total()
+    e2 = _engine(resilience=res)
+    assert e2.global_steps == 3
+    _params_equal(e.state.params, e2.state.params)
+    assert res_metrics.restores_total().total() == restores_before + 1
+    _train(e2, 1, start=3)  # resumed state trains on
+
+
+def test_auto_resume_fresh_start_when_empty(tmp_path, devices8):
+    e = _engine(resilience={"enabled": True, "save_dir": str(tmp_path / "none"),
+                            "watch_signals": False})
+    assert e.global_steps == 0
+    _train(e, 1)
+
+
+def test_auto_resume_skips_corrupt_newest(tmp_path, devices8):
+    res = {"enabled": True, "save_dir": str(tmp_path), "auto_resume": True,
+           "emergency_save": False, "watch_signals": False}
+    e1 = _engine(resilience=res)
+    assert e1.global_steps == 0
+    _train(e1, 1)
+    e1.save_checkpoint(str(tmp_path))
+    _train(e1, 1, start=1)
+    e1.save_checkpoint(str(tmp_path))
+    chaos.bitflip_array(str(tmp_path), "global_step2", seed=1)
+    e2 = _engine(resilience=res)
+    assert e2.global_steps == 1  # newest skipped, previous good tag used
+
+
+def test_io_retry_rides_out_flaky_fs(tmp_path, devices8):
+    res = {"enabled": True, "save_dir": str(tmp_path), "auto_resume": False,
+           "emergency_save": False, "io_retries": 3,
+           "io_retry_base_s": 0.01, "watch_signals": False}
+    e = _engine(resilience=res)
+    _train(e, 1)
+    before = res_metrics.io_retries_total().total()
+    chaos.install_io_fault(chaos.FlakyIO(fail_ops=2))
+    try:
+        path = e.save_checkpoint(str(tmp_path))
+    finally:
+        chaos.install_io_fault(None)
+    assert os.path.isdir(path)
+    assert verify_tag(str(tmp_path), "global_step1")["ok"]
+    assert res_metrics.io_retries_total().total() == before + 2
+
+
+def test_io_retry_gives_up_after_budget():
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        io_retry(always_fails, retries=2, base_delay_s=0.0)
+    assert len(calls) == 3  # 1 try + 2 retries
+
+
+def test_preemption_watcher_notify_is_sticky_and_clearable():
+    w = PreemptionWatcher(install_signals=False)
+    assert w.requested is None
+    w.notify("chaos:test")
+    w.notify("second")  # first reason wins
+    assert w.requested == "chaos:test"
+    w.clear()
+    assert w.requested is None
+
+
+def test_exit_code_contract():
+    assert exit_code_for_exception(ValueError("bad config")) == EXIT_CONFIG
+    assert exit_code_for_exception(RuntimeError("boom")) == 1
+    assert exit_code_for_exception(PreemptionInterrupt()) == EXIT_RESUMABLE
+    assert exit_code_for_exception(SystemExit()) == 0  # bare sys.exit()
+    assert exit_code_for_exception(SystemExit("msg")) == 1
+    assert exit_code_for_exception(SystemExit(7)) == 7
+
+
+# ------------------------------------------------------------- elastic agent
+def _scripted_agent(rcs, **kw):
+    agent = ElasticAgent(restart_delay_s=kw.pop("restart_delay_s", 0.0), **kw)
+    seq = list(rcs)
+
+    def fake_attempt(cmds):
+        return seq.pop(0)
+
+    agent._run_attempt = fake_attempt
+    return agent
+
+
+def test_agent_exponential_backoff_with_jitter(monkeypatch):
+    agent = _scripted_agent([1, 1, 1, 1], restart_delay_s=1.0,
+                            max_restarts=3, backoff_jitter=0.5, seed=0)
+    slept = []
+    monkeypatch.setattr("time.sleep", lambda s: slept.append(s))
+    rc = agent.run("train.py")
+    assert rc == 1 and agent.attempts == 4
+    assert len(slept) == 3
+    for i, s in enumerate(slept):
+        base = 1.0 * (2 ** i)
+        assert base <= s <= base * 1.5, (i, s)  # doubled + bounded jitter
+
+
+def test_agent_stops_on_non_resumable_exit():
+    agent = _scripted_agent([EXIT_CONFIG, 0], max_restarts=5)
+    rc = agent.run("train.py")
+    assert rc == EXIT_CONFIG
+    assert agent.attempts == 1  # config errors are NOT relaunched
+
+
+def test_agent_resumable_exit_does_not_consume_budget():
+    # preempt, preempt, crash, then success — with max_restarts=1 the
+    # crash is the only draw on the failure budget
+    agent = _scripted_agent([EXIT_RESUMABLE, EXIT_RESUMABLE, 1, 0],
+                            max_restarts=1)
+    rc = agent.run("train.py")
+    assert rc == 0
+    assert agent.attempts == 4
+    assert agent.preemptions == 2
+
+
+def test_agent_caps_preemption_relaunches():
+    agent = _scripted_agent([EXIT_RESUMABLE] * 4, max_restarts=5,
+                            max_preemption_restarts=2)
+    rc = agent.run("train.py")
+    assert rc == EXIT_RESUMABLE
+    assert agent.preemptions == 3  # 2 relaunches + the one that gave up
+
+
+def test_agent_logs_attempts_to_event_ring():
+    from deepspeed_tpu.telemetry import (FlightRecorder,
+                                         install_flight_recorder)
+
+    fr = FlightRecorder()
+    install_flight_recorder(fr)
+    try:
+        agent = _scripted_agent([1, 0], max_restarts=2)
+        assert agent.run("train.py") == 0
+        events = [e for e in fr._events if e["name"] == "elastic_attempt"]
+        assert len(events) >= 2
+        assert events[0]["world"] == 1 and events[0]["attempt"] == 1
+    finally:
+        install_flight_recorder(None)

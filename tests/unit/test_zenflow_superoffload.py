@@ -94,7 +94,12 @@ def test_zenflow_engine_trains():
     assert isinstance(engine.offload_optimizer, ZenFlowOptimizer)
     losses = [float(engine.train_batch(random_batch(batch_size=16, seed=i % 4, gas=1)))
               for i in range(12)]
-    assert losses[-1] < losses[0]
+    # seed-matched epochs: batches cycle seeds 0-3, so losses[0:4] and
+    # losses[8:12] see the SAME batches — compare epoch means, not the
+    # raw losses[-1] < losses[0] of two different random batches (that
+    # comparison is env-numerics-dependent and flaked on some hosts)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[8:12]) < np.mean(losses[0:4]), losses
 
 
 def test_superoffload_engine_matches_plain_offload():
