@@ -291,6 +291,13 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
         # the 0.54 comparator was measured under the zero1-style dense
         # regime; flag it so non-default rungs aren't read as regressions
         result["comparator_note"] = "vs_baseline divides by the 0.54 zero1 comparator"
+    # "comparable": may this artifact be read against the TPU baseline
+    # trajectory (BASELINE.md / BENCH_r02)?  A CPU run — deliberate or a
+    # probe-timeout fallback to the tiny model — measures different
+    # hardware AND a different rung, so it must stamp itself out of the
+    # perf trajectory instead of silently masquerading as a regression
+    # (BENCH_r03–r05 did exactly that; ROADMAP item 5).
+    result["comparable"] = jax.default_backend() != "cpu"
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         # gate on backend: a leaked env var must not mislabel a real TPU run

@@ -13,6 +13,6 @@ device steps — same split as the reference's C++ atom-builder vs CUDA
 kernels.
 """
 
-from .ragged import (BlockAllocator, KVBlockConfig, PagedKVCache,  # noqa: F401
-                     PrefixCache)
+from .ragged import (BlockAllocator, KVBlockConfig, KVPageBundle,  # noqa: F401
+                     PagedKVCache, PrefixCache)
 from .engine_v2 import InferenceEngineV2, RaggedInferenceConfig, RaggedRequest  # noqa: F401

@@ -32,14 +32,17 @@ from ...runtime.config_utils import ConfigModel
 from ...runtime.precision import cast_tree
 from ...telemetry import get_registry
 from ...telemetry.compile_sentinel import RecompileSentinel
+from ...telemetry.compile_sentinel import \
+    expect_recompile as sentinel_expect_recompile
 from ...telemetry.flight import dump_on_exception
 from ...telemetry.spans import begin_span, end_span, record_event
 from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
-from .model_runner import (paged_copy_page, paged_decode, paged_prefill,
-                           paged_prefill_chunk)
-from .ragged import (BlockAllocator, KVBlockConfig, PagedKVCache, PrefixCache,
-                     SequenceState)
+from .model_runner import (paged_copy_page, paged_decode, paged_gather_pages,
+                           paged_prefill, paged_prefill_chunk,
+                           paged_scatter_pages)
+from .ragged import (BlockAllocator, KVBlockConfig, KVPageBundle, PagedKVCache,
+                     PrefixCache, SequenceState)
 
 
 @dataclasses.dataclass
@@ -185,6 +188,8 @@ class InferenceEngineV2:
 
         self._queue: List[SequenceState] = []
         self._slots: List[Optional[SequenceState]] = [None] * block.max_seqs
+        #: set by drain(): the engine is retiring, put() refuses admissions
+        self._draining = False
         # host mirror of the device page tables, trash-filled
         self._page_table = np.full((block.max_seqs, block.max_pages_per_seq),
                                    block.trash_page, dtype=np.int32)
@@ -413,6 +418,9 @@ class InferenceEngineV2:
     # -- request API ---------------------------------------------------------
     def put(self, request: RaggedRequest) -> int:
         """Queue a request; returns its uid."""
+        if self._draining:
+            raise RuntimeError("engine is draining/retired: no new "
+                               "admissions (route to another replica)")
         uid = request.uid if request.uid is not None else next(self._uid)
         n = len(request.prompt_ids)
         if n == 0:
@@ -436,6 +444,238 @@ class InferenceEngineV2:
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for admission (what the queue-depth gauge
+        publishes) — the router's load signal."""
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        """Occupied decode slots (what the batch-occupancy gauge
+        publishes, un-normalized)."""
+        return sum(1 for s in self._slots if s is not None)
+
+    def inflight_uids(self) -> List[int]:
+        """uids of every unfinished request this engine owns: admitted
+        (in a slot) first, then queued."""
+        return ([s.uid for s in self._slots if s is not None]
+                + [s.uid for s in self._queue])
+
+    def ready_uids(self) -> List[int]:
+        """uids of admitted sequences that are decode-ready (prefill
+        complete, first token sampled) — the migration candidates a
+        disaggregated router streams from prefill to decode replicas."""
+        return [s.uid for s in self._slots
+                if s is not None and self._ready_to_decode(s)]
+
+    # -- KV-page migration (export / import / release) -----------------------
+    def _find_slotted(self, uid: int) -> SequenceState:
+        seq = next((s for s in self._slots
+                    if s is not None and s.uid == uid), None)
+        if seq is None:
+            raise KeyError(f"uid {uid} is not in a decode slot (queued or "
+                           "unknown sequences have no KV pages to export)")
+        return seq
+
+    def export_sequence(self, uid: int) -> KVPageBundle:
+        """Serialize an admitted sequence's KV pages + scheduling state
+        into a :class:`KVPageBundle` (host arrays, bit-exact).  The
+        sequence KEEPS running here — callers release it only after a
+        successful import elsewhere, so a failed handoff loses nothing."""
+        seq = self._find_slotted(uid)
+        ps = self.block.page_size
+        immutable = seq.prefilled // ps  # pages never written again
+        keys = list(seq.page_keys[:min(immutable, len(seq.page_keys))])
+        bundle = KVPageBundle(
+            uid=seq.uid, tokens=list(seq.tokens), prompt_len=seq.prompt_len,
+            max_new_tokens=seq.max_new_tokens, temperature=seq.temperature,
+            eos_id=seq.eos_id, prefilled=seq.prefilled,
+            decode_entry=seq.decode_entry, page_size=ps, page_keys=keys,
+            src_pages=self.allocator.export_meta(seq.pages),
+            arrays=paged_gather_pages(self._pools, seq.pages),
+            model_sig=(self.cfg.n_layers, self.cfg.kv_heads,
+                       self.cfg.head_dim),
+            kv_quant=bool(self.config.kv_quant), dtype=self.config.dtype)
+        # the gather runs op-by-op outside the step programs: announce
+        # its compiles so no sentinel flags them as steady-state
+        sentinel_expect_recompile("kv_export")
+        record_event("kv_export", cat="serve", uid=uid,
+                     pages=len(seq.pages), tokens=len(seq.tokens))
+        return bundle
+
+    def _check_bundle(self, b: KVPageBundle) -> None:
+        sig = (self.cfg.n_layers, self.cfg.kv_heads, self.cfg.head_dim)
+        if tuple(b.model_sig) != sig:
+            raise ValueError(f"bundle model_sig {tuple(b.model_sig)} != "
+                             f"engine {sig}")
+        if b.page_size != self.block.page_size:
+            raise ValueError(f"bundle page_size {b.page_size} != "
+                             f"{self.block.page_size}")
+        if bool(b.kv_quant) != bool(self.config.kv_quant):
+            raise ValueError("kv_quant mismatch between bundle and engine")
+        if str(b.dtype) != str(self.config.dtype):
+            # checked here, not just in the fresh-page scatter: an
+            # all-adopted import never scatters, and sharing pages
+            # across precisions would silently break bit-identity
+            raise ValueError(f"bundle dtype {b.dtype!r} != engine dtype "
+                             f"{self.config.dtype!r}")
+        if b.n_pages > self.block.max_pages_per_seq:
+            raise ValueError(f"bundle spans {b.n_pages} pages > "
+                             f"max_pages_per_seq {self.block.max_pages_per_seq}")
+        if len(b.tokens) >= self.max_seq_len:
+            raise ValueError(f"bundle length {len(b.tokens)} >= max_seq_len "
+                             f"{self.max_seq_len}: nothing left to decode")
+        ready = (b.generated > 0 or b.decode_entry) \
+            and b.prefilled >= len(b.tokens) - 1
+        if not ready:
+            raise ValueError(
+                "bundle is not decode-ready (mid-prefill handoff is not "
+                "supported: re-dispatch the request instead)")
+
+    def import_sequence(self, bundle: KVPageBundle) -> bool:
+        """Adopt a migrated sequence: place its KV pages in this pool
+        (sharing content-matched registered pages instead of copying —
+        ref-count adoption) and schedule it straight into a decode slot.
+
+        Returns ``False`` — with the engine untouched — when no slot or
+        not enough pages are free (the caller tries another replica);
+        raises ``ValueError`` on genuine incompatibility (different
+        model geometry / page size / kv_quant / dtype)."""
+        self._check_bundle(bundle)
+        slot = next((i for i, s in enumerate(self._slots) if s is None), None)
+        if slot is None:
+            return False
+        n = bundle.n_pages
+        keys = list(bundle.page_keys)
+        adopt_keys: List[Any] = [None] * n
+        if self.prefix_cache is not None:
+            for j, k in enumerate(keys[:n]):
+                adopt_keys[j] = k
+        try:
+            pages, reused = self.allocator.adopt(adopt_keys)
+        except MemoryError:
+            return False
+        fresh = [j for j, r in enumerate(reused) if not r]
+        if fresh:
+            # dtype mismatches raise inside the scatter — but only after
+            # pages were allocated; free them so a refused import does
+            # not leak pool capacity
+            try:
+                self._pools = paged_scatter_pages(
+                    self._pools, [pages[j] for j in fresh],
+                    {k: v[:, fresh] for k, v in bundle.arrays.items()})
+            except ValueError:
+                self.allocator.free(pages)
+                raise
+            # op-by-op scatter outside the step programs (see export)
+            sentinel_expect_recompile("kv_import")
+        if self.prefix_cache is not None:
+            # publish freshly-written FULL pages locally (first writer
+            # wins) so the importing replica's cache warms too; adopted
+            # pages are already registered here
+            for j in fresh:
+                if j < len(keys):
+                    self.allocator.register(pages[j], keys[j])
+        seq = SequenceState(
+            uid=bundle.uid, tokens=list(bundle.tokens),
+            prompt_len=bundle.prompt_len,
+            max_new_tokens=bundle.max_new_tokens,
+            temperature=bundle.temperature, eos_id=bundle.eos_id,
+            slot=slot, pages=pages, prefilled=bundle.prefilled,
+            decode_entry=bundle.decode_entry, page_keys=keys,
+            registered_upto=len(keys))
+        seq.admit_order = next(self._admit_counter)
+        self._slots[slot] = seq
+        self._page_table[slot, :] = self.block.trash_page
+        self._page_table[slot, :len(pages)] = pages
+        now = time.perf_counter()
+        # TTFT belongs to the exporting engine (it sampled the first
+        # token); local TPOT accounting restarts at the handoff
+        self._req_meta[bundle.uid] = {
+            "t0": now, "t_first": now if bundle.generated > 0 else None,
+            "t_last": now, "n": bundle.generated,
+            "span": begin_span("request_migrated", cat="serve",
+                               uid=bundle.uid, tokens=len(bundle.tokens),
+                               adopted_pages=sum(reused))}
+        record_event("kv_import", cat="serve", uid=bundle.uid, slot=slot,
+                     pages=n, adopted=sum(reused),
+                     **self._pool_occupancy())
+        self._publish_pool_gauges()
+        return True
+
+    def release_sequence(self, uid: int, reason: str = "migrated") -> None:
+        """Drop an admitted sequence WITHOUT finishing it (its pages are
+        freed, its request span closed) — the source side of a completed
+        migration, after ``import_sequence`` succeeded elsewhere."""
+        seq = self._find_slotted(uid)
+        self.allocator.free(seq.pages)
+        self._page_table[seq.slot, :] = self.block.trash_page
+        self._slots[seq.slot] = None
+        seq.slot, seq.pages = -1, []
+        m = self._req_meta.pop(uid, None)
+        if m is not None:
+            end_span(m["span"], released=reason, generated=m["n"])
+        self._publish_pool_gauges()
+
+    # -- replica retirement --------------------------------------------------
+    def drain(self, max_steps: int = 10_000) -> Dict[str, Any]:
+        """Stop admission and run every ADMITTED sequence to completion.
+
+        Returns ``{"finished": {uid: SequenceState}, "pending":
+        [SequenceState, ...]}``: ``finished`` holds the final states
+        (full token lists, ``done`` flags) of the sequences that were
+        in flight; ``pending`` are queued-but-never-admitted requests,
+        returned UN-RUN for the caller to re-dispatch elsewhere.  After
+        ``drain()`` the engine refuses new ``put()`` calls — this is
+        clean replica retirement (``close()`` alone would drop in-flight
+        work)."""
+        self._draining = True
+        pending = list(self._queue)
+        self._queue.clear()
+        for s in pending:
+            m = self._req_meta.pop(s.uid, None)
+            if m is not None:
+                end_span(m["span"], requeued=True)
+        inflight = {s.uid: s for s in self._slots if s is not None}
+        steps = 0
+        while any(s is not None for s in self._slots) or self._queue:
+            if steps >= max_steps:
+                logger.warning("engine_v2.drain: max_steps reached with "
+                               "work pending")
+                break
+            self.step()
+            steps += 1
+        self._m_queue.set(len(self._queue))
+        record_event("engine_drain", cat="serve", finished=len(inflight),
+                     requeued=len(pending), steps=steps)
+        return {"finished": inflight, "pending": pending}
+
+    def abort_all(self, reason: str = "abort") -> List[int]:
+        """Free every queued and admitted request WITHOUT running them
+        (pages released, request spans closed); returns their uids.
+        The hard-stop half of retirement — used after KV migration has
+        moved what it could off a preempted replica, and by ``close()``
+        so dropped work is never silent."""
+        uids = [s.uid for s in self._queue]
+        self._queue.clear()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            self.allocator.free(s.pages)
+            self._page_table[i, :] = self.block.trash_page
+            self._slots[i] = None
+            s.slot, s.pages = -1, []
+            uids.append(s.uid)
+        for uid in uids:
+            m = self._req_meta.pop(uid, None)
+            if m is not None:
+                end_span(m["span"], aborted=reason, generated=m["n"])
+        if uids:
+            self._m_queue.set(0)
+            self._publish_pool_gauges()
+        return uids
 
     # -- scheduling ----------------------------------------------------------
     def _bucket(self, n: int) -> int:
@@ -815,7 +1055,18 @@ class InferenceEngineV2:
     def close(self) -> None:
         """Release this engine's memory-ledger slots (provider identity
         guards: slots a newer co-located engine claimed stay attached).
-        Idempotent; safe without the ledger enabled."""
+        Idempotent; safe without the ledger enabled.
+
+        In-flight/queued requests are NOT finished by close(): they are
+        aborted LOUDLY (warning + closed request spans) — call
+        ``drain()`` first for clean retirement that runs admitted
+        sequences to completion and hands queued ones back."""
+        dropped = self.abort_all(reason="close")
+        if dropped:
+            logger.warning(
+                f"engine_v2.close: aborted {len(dropped)} unfinished "
+                f"request(s) (uids {dropped[:8]}{'…' if len(dropped) > 8 else ''}) "
+                "— call drain() before close() to retire cleanly")
         comps = getattr(self, "_ledger_components", [])
         if comps:
             from ...telemetry.memory import get_memory_ledger

@@ -176,6 +176,40 @@ def paged_copy_page(pools, src, dst):
         lambda a: a.at[:, dst].set(a[:, src]), pools)
 
 
+def paged_gather_pages(pools, pages):
+    """Host copy of the given pool pages (KV export): one numpy array
+    per pool leaf, shaped ``[L, n_pages, page_size, KVH, D]`` in the
+    pool's exact dtype (bf16 round-trips through ml_dtypes) — the
+    device half of KV-page migration and, later, host-RAM spill."""
+    import numpy as np
+
+    rows = jnp.asarray(np.asarray(pages, np.int32))
+    return {name: np.asarray(leaf[:, rows]) for name, leaf in pools.items()}
+
+
+def paged_scatter_pages(pools, pages, arrays):
+    """Write host page arrays (``paged_gather_pages`` layout) into pool
+    rows ``pages`` (KV import).  Dtypes must match the pool exactly —
+    a silent cast would break the bit-identical import contract.  Runs
+    op-by-op outside jit (imports happen between steps, off the hot
+    path); returns the updated pools dict."""
+    import numpy as np
+
+    if set(arrays) != set(pools):
+        raise ValueError(f"pool leaves {sorted(pools)} != bundle leaves "
+                         f"{sorted(arrays)} (kv_quant mismatch?)")
+    rows = jnp.asarray(np.asarray(pages, np.int32))
+    out = {}
+    for name, leaf in pools.items():
+        src = arrays[name]
+        if jnp.dtype(leaf.dtype) != jnp.dtype(src.dtype):
+            raise ValueError(f"pool leaf {name!r} dtype {leaf.dtype} != "
+                             f"bundle dtype {src.dtype}: import must be "
+                             "bit-identical, refusing to cast")
+        out[name] = leaf.at[:, rows].set(jnp.asarray(src))
+    return out
+
+
 def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
                         ids, chunk_rows, prev_table, start, n
                         ) -> Tuple[jnp.ndarray, Any]:

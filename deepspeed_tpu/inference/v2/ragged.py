@@ -150,6 +150,51 @@ class BlockAllocator:
                 else:
                     self._free.append(p)
 
+    def adopt(self, keys: Sequence[Optional[Any]]
+              ) -> Tuple[List[int], List[bool]]:
+        """Import-side page placement with **ref-count adoption** (the KV
+        migration refactor): for each position, when ``keys[j]`` is
+        already registered locally the existing page is *shared* (+1 ref)
+        instead of duplicated — content-chain keys are content
+        addresses, so the local page holds bit-identical KV and the
+        imported sequence can map it directly.  Unmatched positions (or
+        ``None`` keys — partial tail pages, cache-off imports) get fresh
+        pages for the caller to fill from the bundle's arrays.
+
+        All-or-nothing: insufficient capacity raises ``MemoryError``
+        BEFORE any refcount moves, so a failed import leaves the
+        allocator untouched.  Returns ``(pages, reused)`` where
+        ``reused[j]`` says position ``j`` adopted a local page (its
+        content must NOT be overwritten)."""
+        matched = [self._by_key.get(k) if k is not None else None
+                   for k in keys]
+        # matched pages at refcount 0 sit in the LRU: counted in
+        # free_pages but claimed by share(), not alloc() (same exactness
+        # rule as engine_v2._admit)
+        lru_matched = sum(1 for p in matched
+                          if p is not None and self._ref[p] == 0)
+        need = sum(1 for p in matched if p is None)
+        if need > self.free_pages - lru_matched:
+            raise MemoryError(
+                f"KV import needs {need} fresh pages "
+                f"(+{lru_matched} adopted from the LRU), only "
+                f"{self.free_pages - lru_matched} allocatable")
+        # share FIRST: matched LRU pages must be protected from being
+        # evicted by the alloc() calls below
+        for p in matched:
+            if p is not None:
+                self.share(p)
+        fresh = iter(self.alloc(need))
+        pages = [p if p is not None else next(fresh) for p in matched]
+        return pages, [p is not None for p in matched]
+
+    def export_meta(self, pages: Sequence[int]) -> List[Dict[str, Any]]:
+        """Block-table metadata for a page list (serialization side of
+        KV migration): per page, its id, refcount, and registered
+        content key (None for unregistered/private pages)."""
+        return [{"page": int(p), "refcount": self._ref[p],
+                 "key": self._key_of.get(p)} for p in pages]
+
     # -- prefix-cache registry ----------------------------------------------
     def register(self, page: int, key: Any) -> bool:
         """Publish ``page`` as the cached page for ``key``.  First writer
@@ -280,6 +325,52 @@ class PagedKVCache:
                     "k_scale": jnp.zeros(sshape, jnp.float32),
                     "v_scale": jnp.zeros(sshape, jnp.float32)}
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+@dataclasses.dataclass
+class KVPageBundle:
+    """Serialized KV pages + block-table metadata of one in-flight
+    sequence — the unit of **KV-page migration** between engines
+    (prefill→decode disaggregation, replica drain) and, later, of
+    host-RAM spill of cold pages.
+
+    ``arrays`` holds one host array per pool leaf (``k``/``v`` and,
+    under kv_quant, their scales), shaped ``[L, n_pages, page_size,
+    KVH, D]`` in the pool's exact dtype — import is bit-identical by
+    contract.  ``page_keys`` covers only the *immutable* leading full
+    pages (index < ``prefilled // page_size``): those are the pages an
+    importing engine may adopt by content key instead of copying; later
+    pages (partial tails, copy-on-write duplicates about to be
+    rewritten) are always transferred by value.  ``src_pages`` is the
+    exporting allocator's block-table metadata (``export_meta``) —
+    informational, page ids are meaningless across pools."""
+
+    uid: int
+    tokens: List[int]
+    prompt_len: int
+    max_new_tokens: int
+    temperature: float
+    eos_id: Optional[int]
+    #: tokens of the prefix whose KV is already written in ``arrays``
+    prefilled: int
+    #: fully-cached prompt mid-handoff: enters through the decode program
+    decode_entry: bool
+    page_size: int
+    page_keys: List[Any]
+    src_pages: List[Dict[str, Any]]
+    arrays: Dict[str, Any]
+    #: (n_layers, kv_heads, head_dim) — pools must agree to import
+    model_sig: Tuple[int, int, int]
+    kv_quant: bool
+    dtype: str
+
+    @property
+    def n_pages(self) -> int:
+        return next(iter(self.arrays.values())).shape[1]
+
+    @property
+    def generated(self) -> int:
+        return len(self.tokens) - self.prompt_len
 
 
 @dataclasses.dataclass

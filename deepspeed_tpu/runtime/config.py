@@ -15,6 +15,7 @@ import json
 from typing import Any, Dict, Optional
 
 from .config_utils import AUTO, ConfigModel
+from ..serving.config import ServingConfig
 from ..utils.logging import logger
 
 TRAIN_BATCH_SIZE = "train_batch_size"
@@ -499,6 +500,7 @@ class DeepSpeedConfig:
     compression: GradientCompressionConfig
     hybrid_engine: HybridEngineConfig
     resilience: ResilienceConfig
+    serving: ServingConfig
     zero_allow_untested_optimizer: bool
     gradient_accumulation_dtype: str
 
@@ -552,6 +554,9 @@ class DeepSpeedConfig:
         self.compression = GradientCompressionConfig.from_dict(g("gradient_compression"))
         self.hybrid_engine = HybridEngineConfig.from_dict(g("hybrid_engine"))
         self.resilience = ResilienceConfig.from_dict(g("resilience"))
+        # fleet front tier (serving/config.py): router + replica pools;
+        # parsed here so one ds-config json describes the whole process
+        self.serving = ServingConfig.from_dict(g("serving"))
 
         if self.fp16.enabled and self.bf16.enabled:
             raise ValueError("fp16 and bf16 cannot both be enabled")

@@ -1,0 +1,34 @@
+"""Serving fleet: the front tier above ``inference/v2`` engines.
+
+A router with prefix-cache-affinity placement, prefill/decode
+disaggregation with KV-page migration, and replica lifecycle handling
+(drain / health / re-dispatch on death or preemption) — see
+docs/SERVING.md "Fleet serving".
+
+``ServingConfig`` imports eagerly (``runtime/config.py`` parses the
+``serving`` block); the router/replica/transfer machinery loads lazily
+so config parsing never pulls in jax-facing engine code.
+"""
+
+from .config import ServingConfig  # noqa: F401
+
+_LAZY = {
+    "FleetRouter": "router", "build_fleet": "router",
+    "affinity_key": "router", "hrw_score": "router",
+    "pick_replica": "router",
+    "EngineReplica": "replica", "ROLE_PREFILL": "replica",
+    "ROLE_DECODE": "replica", "ROLE_MIXED": "replica",
+    "migrate_sequence": "kv_transfer", "bundle_to_bytes": "kv_transfer",
+    "bundle_from_bytes": "kv_transfer",
+}
+
+__all__ = ["ServingConfig"] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,68 @@
+"""``serving`` config block: the multi-replica fleet front tier.
+
+Parsed by ``runtime/config.py`` like every other block (a top-level
+``"serving"`` key in the ds-config json) and consumed by
+``serving/router.py``'s :class:`FleetRouter` / ``build_fleet``.  The
+per-engine knobs (page pool geometry, chunked prefill, prefix cache)
+stay in ``RaggedInferenceConfig``; this block only describes the fleet
+ABOVE the engines: pool sizes, routing policy, and failure handling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..runtime.config_utils import ConfigModel
+
+
+@dataclasses.dataclass
+class ServingConfig(ConfigModel):
+    """Fleet topology + routing policy (docs/SERVING.md "Fleet
+    serving")."""
+
+    enabled: bool = False
+    #: replicas that admit new requests and run (chunked) prefill
+    prefill_replicas: int = 1
+    #: replicas that continue decoding migrated sequences
+    decode_replicas: int = 2
+    #: prefill/decode disaggregation: ready sequences stream their KV
+    #: pages from prefill to decode replicas.  False = one mixed pool of
+    #: ``prefill_replicas + decode_replicas`` replicas, no migration.
+    disaggregated: bool = True
+    #: prompt pages hashed into the affinity key (PR 1 content-hash
+    #: chain): more pages = finer-grained placement, fewer = broader
+    #: prefix families co-located on one replica's prefix cache
+    affinity_pages: int = 4
+    #: least-loaded fallback threshold: the affinity choice is overridden
+    #: when its load exceeds the least-loaded candidate's by MORE than
+    #: this many requests (queue depth + occupied slots)
+    load_gap: int = 4
+    #: give up re-running a request after this many replica losses
+    max_redispatch: int = 3
+    #: chunked prefill size for prefill-pool replicas (tokens, rounded up
+    #: to page_size by the engine); 0 = inherit the engine config
+    prefill_chunk: int = 0
+    #: step budget for ``InferenceEngineV2.drain`` during retirement
+    drain_max_steps: int = 10_000
+
+    def validate(self) -> None:
+        if self.prefill_replicas < 0 or self.decode_replicas < 0:
+            raise ValueError("serving replica counts must be >= 0")
+        if self.prefill_replicas + self.decode_replicas < 1:
+            raise ValueError("serving needs at least one replica")
+        if self.disaggregated and self.enabled and (
+                self.prefill_replicas < 1 or self.decode_replicas < 1):
+            raise ValueError(
+                "serving.disaggregated needs >= 1 prefill AND >= 1 decode "
+                "replica (set disaggregated=false for a mixed pool)")
+        if self.affinity_pages < 1:
+            raise ValueError("serving.affinity_pages must be >= 1")
+        if self.load_gap < 1:
+            raise ValueError("serving.load_gap must be >= 1")
+        if self.max_redispatch < 0:
+            raise ValueError("serving.max_redispatch must be >= 0")
+        if self.drain_max_steps < 1:
+            raise ValueError("serving.drain_max_steps must be >= 1")
+
+
+__all__ = ["ServingConfig"]

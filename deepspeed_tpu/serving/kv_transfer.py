@@ -1,0 +1,131 @@
+"""KV-page transfer between engine replicas.
+
+The disaggregation hot path is :func:`migrate_sequence` — export a
+decode-ready sequence's KV pages + block-table metadata from one engine
+(``InferenceEngineV2.export_sequence``), import them into another with
+ref-count adoption (``import_sequence`` / ``BlockAllocator.adopt``),
+and release the source only after the import committed, so a failed
+handoff never loses the request.
+
+For replicas in one process (the CPU drill, single-host multi-engine)
+the bundle's host arrays move by reference.  For cross-process /
+cross-host transport, :func:`bundle_to_bytes` / :func:`bundle_from_bytes`
+give a self-describing wire format (json header + raw little-endian
+page arrays) — the same serialization a host-RAM spill of cold pages
+will reuse.  Bit-exactness is the contract end to end: dtypes are
+carried exactly (bf16 via ml_dtypes) and the importing engine refuses
+to cast.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any
+
+import numpy as np
+
+from ..inference.v2.ragged import KVPageBundle
+from ..utils.logging import logger
+
+#: wire-format magic + version: bump on any layout change
+_MAGIC = b"DSTPUKV1"
+
+
+def migrate_sequence(src_engine: Any, dst_engine: Any, uid: int) -> int:
+    """Move one decode-ready sequence from ``src_engine`` to
+    ``dst_engine``.  Returns the number of KV pages moved (truthy) on
+    success; 0 when the destination has no capacity (the sequence keeps
+    running on the source — a failed handoff loses nothing).
+    Incompatible engines (different model geometry / page size) raise
+    ``ValueError`` — that is a fleet-construction bug, not load."""
+    bundle = src_engine.export_sequence(uid)
+    if not dst_engine.import_sequence(bundle):
+        return 0
+    src_engine.release_sequence(uid, reason="migrated")
+    return bundle.n_pages
+
+
+def _dtype_name(arr: np.ndarray) -> str:
+    return arr.dtype.name  # "bfloat16" round-trips through ml_dtypes
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
+    """Serialize a bundle for cross-process transport: magic, a json
+    header (metadata + per-leaf shape/dtype, page keys hex-encoded),
+    then each leaf's raw C-order bytes in header order."""
+    leaves = sorted(bundle.arrays)
+    header = {
+        "uid": bundle.uid, "tokens": list(map(int, bundle.tokens)),
+        "prompt_len": bundle.prompt_len,
+        "max_new_tokens": bundle.max_new_tokens,
+        "temperature": bundle.temperature, "eos_id": bundle.eos_id,
+        "prefilled": bundle.prefilled, "decode_entry": bundle.decode_entry,
+        "page_size": bundle.page_size,
+        "page_keys": [k.hex() if isinstance(k, bytes) else k
+                      for k in bundle.page_keys],
+        "src_pages": [{"page": m["page"], "refcount": m["refcount"],
+                       "key": (m["key"].hex()
+                               if isinstance(m.get("key"), bytes) else None)}
+                      for m in bundle.src_pages],
+        "model_sig": list(bundle.model_sig), "kv_quant": bundle.kv_quant,
+        "dtype": bundle.dtype,
+        "leaves": [{"name": n, "shape": list(bundle.arrays[n].shape),
+                    "dtype": _dtype_name(bundle.arrays[n])}
+                   for n in leaves],
+    }
+    buf = io.BytesIO()
+    hdr = json.dumps(header).encode()
+    buf.write(_MAGIC)
+    buf.write(len(hdr).to_bytes(8, "little"))
+    buf.write(hdr)
+    for n in leaves:
+        buf.write(np.ascontiguousarray(bundle.arrays[n]).tobytes())
+    return buf.getvalue()
+
+
+def bundle_from_bytes(data: bytes) -> KVPageBundle:
+    """Inverse of :func:`bundle_to_bytes` (bit-identical arrays)."""
+    if data[:len(_MAGIC)] != _MAGIC:
+        raise ValueError("not a serialized KVPageBundle (bad magic)")
+    off = len(_MAGIC)
+    hlen = int.from_bytes(data[off:off + 8], "little")
+    off += 8
+    header = json.loads(data[off:off + hlen].decode())
+    off += hlen
+    arrays = {}
+    for leaf in header["leaves"]:
+        dt = _np_dtype(leaf["dtype"])
+        n = int(np.prod(leaf["shape"])) * dt.itemsize
+        arrays[leaf["name"]] = np.frombuffer(
+            data[off:off + n], dtype=dt).reshape(leaf["shape"]).copy()
+        off += n
+    if off != len(data):
+        logger.warning(f"bundle_from_bytes: {len(data) - off} trailing "
+                       "bytes ignored")
+    return KVPageBundle(
+        uid=header["uid"], tokens=list(header["tokens"]),
+        prompt_len=header["prompt_len"],
+        max_new_tokens=header["max_new_tokens"],
+        temperature=header["temperature"], eos_id=header["eos_id"],
+        prefilled=header["prefilled"], decode_entry=header["decode_entry"],
+        page_size=header["page_size"],
+        page_keys=[bytes.fromhex(k) if isinstance(k, str) else k
+                   for k in header["page_keys"]],
+        src_pages=[{"page": m["page"], "refcount": m["refcount"],
+                    "key": (bytes.fromhex(m["key"])
+                            if m.get("key") else None)}
+                   for m in header["src_pages"]],
+        arrays=arrays, model_sig=tuple(header["model_sig"]),
+        kv_quant=header["kv_quant"], dtype=header["dtype"])
+
+
+__all__ = ["migrate_sequence", "bundle_to_bytes", "bundle_from_bytes"]

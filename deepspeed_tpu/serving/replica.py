@@ -1,0 +1,95 @@
+"""One engine replica as the router sees it.
+
+Wraps an :class:`InferenceEngineV2` with the fleet-level state the
+router schedules on: a **role** (``prefill`` / ``decode`` / ``mixed`` —
+a placement *preference*, not a capability gate: any engine can do
+both, which is what makes lossless fallback possible when a pool
+empties), a **health** state (alive / retired), and a PR-5
+:class:`PreemptionWatcher` so a maintenance notice or SIGTERM-style
+signal against one replica turns into graceful drain-and-migrate
+instead of dropped streams.
+
+``load()`` is the router's least-loaded signal: queue depth + occupied
+decode slots — the same quantities the engine publishes as the
+``deepspeed_tpu_serving_queue_depth`` / ``_batch_occupancy`` gauges, read
+directly so the N co-located replicas (which share one process-global
+gauge) stay individually observable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..resilience.preemption import PreemptionWatcher
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_MIXED = "mixed"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED)
+
+
+class EngineReplica:
+    """A named engine + fleet-level lifecycle state."""
+
+    def __init__(self, name: str, engine: Any, role: str = ROLE_MIXED):
+        if role not in ROLES:
+            raise ValueError(f"replica role {role!r} not in {ROLES}")
+        self.name = name
+        self.engine = engine
+        self.role = role
+        #: signal/maintenance-notice injection point (PR 5): the router
+        #: polls ``preempted`` each pump and retires the replica
+        #: gracefully.  No process-level signal handlers here — N
+        #: replicas share one process in the CPU drill, and a real
+        #: deployment installs per-process watchers in the replica's
+        #: launcher instead.
+        self.watcher = PreemptionWatcher(install_signals=False)
+        #: False after a hard death (chaos ``kill()``): engine state —
+        #: including every in-flight KV page — is gone
+        self.alive = True
+        #: True once drained/evacuated: keeps its slot in the fleet
+        #: table for observability but takes no work
+        self.retired = False
+
+    # -- scheduling signals --------------------------------------------------
+    @property
+    def preempted(self) -> bool:
+        return self.watcher.requested is not None
+
+    def accepts_new(self) -> bool:
+        """Can this replica take NEW admissions right now?"""
+        return self.alive and not self.retired and not self.preempted
+
+    def load(self) -> int:
+        """Queue depth + occupied decode slots (see module docstring)."""
+        return self.engine.queue_depth + self.engine.active_count
+
+    def kv_free_fraction(self) -> float:
+        """Allocatable fraction of the KV page pool — the pool-occupancy
+        signal (same quantity as the ``_kv_pages_free`` gauge)."""
+        a = self.engine.allocator
+        return a.free_pages / max(1, a.num_pages)
+
+    # -- lifecycle -----------------------------------------------------------
+    def step(self) -> Dict[int, Dict[str, Any]]:
+        return self.engine.step() if self.engine.has_work() else {}
+
+    def kill(self) -> None:
+        """Chaos hook: simulate an unannounced replica death (process
+        gone, KV pages unrecoverable).  The router re-dispatches its
+        in-flight requests on the next pump."""
+        self.alive = False
+
+    def health(self) -> Dict[str, Any]:
+        h = {"role": self.role, "alive": self.alive, "retired": self.retired,
+             "preempted": self.watcher.requested or "",
+             "load": self.load() if self.alive else -1}
+        if self.alive:
+            h.update(queue_depth=self.engine.queue_depth,
+                     active=self.engine.active_count,
+                     kv_free_fraction=round(self.kv_free_fraction(), 4))
+        return h
+
+
+__all__ = ["EngineReplica", "ROLE_PREFILL", "ROLE_DECODE", "ROLE_MIXED",
+           "ROLES"]

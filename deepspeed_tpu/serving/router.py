@@ -1,0 +1,502 @@
+"""Fleet router: prefix-cache-affinity request scheduling across N
+engine replicas, prefill/decode disaggregation, and failure handling.
+
+One :class:`~..inference.v2.InferenceEngineV2` is one process-worth of
+serving; the ROADMAP's millions-of-users scale needs a front tier above
+many of them.  This router is that tier, host-side and device-free:
+
+* **Placement** — requests are routed by *prefix-cache affinity*:
+  the affinity key is the PR-1 content-hash chain over the prompt's
+  leading full pages (``PrefixCache.chain_key``), so requests sharing a
+  system prompt / few-shot template land on the replica whose prefix
+  cache already holds those pages.  Rendezvous (highest-random-weight)
+  hashing keeps the mapping deterministic and stable as replicas come
+  and go; a **least-loaded fallback** (driven by the same queue-depth /
+  occupancy quantities the serving gauges publish) overrides affinity
+  when the favorite is more than ``load_gap`` requests hotter than the
+  coolest candidate.
+* **Disaggregation** — prefill-role replicas run (chunked) prefill;
+  the moment a sequence is decode-ready its KV pages stream to a
+  decode-role replica (``kv_transfer.migrate_sequence``, ref-count
+  adoption on import).  If no decode replica has capacity the sequence
+  simply keeps decoding where it is — roles are preferences, so the
+  fleet degrades to mixed serving instead of losing work.
+* **Lifecycle** — a replica death (chaos ``kill()``) re-dispatches its
+  in-flight requests (prompt + tokens emitted so far, greedy streams
+  stay bit-identical); a PR-5 preemption notice triggers graceful
+  evacuation: decode-ready sequences migrate with their KV, the rest
+  re-dispatch, and the replica retires without dropping a stream.
+
+Everything observable flows through the ``deepspeed_tpu_serving_fleet_*``
+metric family and ``fleet_*`` trace events (docs/SERVING.md catalog).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..inference.v2.engine_v2 import RaggedRequest
+from ..inference.v2.ragged import PrefixCache
+from ..telemetry import get_registry
+from ..telemetry.spans import record_event
+from ..utils.logging import logger
+from .config import ServingConfig
+from .kv_transfer import migrate_sequence
+from .replica import ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica
+
+
+# -- pure routing policy (unit-testable without engines) ---------------------
+def affinity_key(prompt_ids: Sequence[int], page_size: int,
+                 affinity_pages: int = 4) -> bytes:
+    """Affinity key of a prompt: the PR-1 content-hash chain
+    (``PrefixCache.chain_key``) over its leading full pages, capped at
+    ``affinity_pages``.  Prompts shorter than one page hash whole —
+    still deterministic, still groups identical prompts."""
+    n_full = min(len(prompt_ids) // page_size, max(1, affinity_pages))
+    if n_full == 0:
+        return PrefixCache.chain_key(None, prompt_ids)
+    key: Optional[bytes] = None
+    for j in range(n_full):
+        key = PrefixCache.chain_key(
+            key, prompt_ids[j * page_size:(j + 1) * page_size])
+    return key  # type: ignore[return-value]
+
+
+def hrw_score(key: bytes, name: str) -> int:
+    """Rendezvous weight of (request key, replica name): deterministic,
+    uniform, and stable — removing one replica only re-homes the keys
+    that mapped to it."""
+    return int.from_bytes(
+        hashlib.sha256(key + b"\x00" + name.encode()).digest()[:8], "big")
+
+
+def pick_replica(key: bytes, candidates: Sequence[Any], load_gap: int
+                 ) -> Tuple[Any, str]:
+    """Choose among ``candidates`` (objects with ``.name`` and
+    ``.load()``): the HRW-affinity favorite unless it is more than
+    ``load_gap`` requests hotter than the least-loaded candidate, in
+    which case the least-loaded one (ties broken by name, so the choice
+    is deterministic).  Returns ``(replica, "affinity"|"least_loaded")``."""
+    if not candidates:
+        raise ValueError("no candidate replicas")
+    favorite = max(candidates, key=lambda r: (hrw_score(key, r.name), r.name))
+    loads = {r.name: r.load() for r in candidates}
+    coolest = min(loads.values())
+    if loads[favorite.name] - coolest <= load_gap:
+        return favorite, "affinity"
+    least = min(candidates, key=lambda r: (loads[r.name], r.name))
+    return least, "least_loaded"
+
+
+class _RequestRecord:
+    """Router-side view of one request across replica hops."""
+
+    __slots__ = ("request", "replica", "emitted", "done", "failed",
+                 "redispatches")
+
+    def __init__(self, request: RaggedRequest):
+        self.request = request
+        self.replica: Optional[str] = None  # current owner
+        self.emitted: List[int] = []        # tokens streamed so far
+        self.done = False
+        self.failed = False
+        self.redispatches = 0
+
+
+class FleetRouter:
+    """Front tier over a list of :class:`EngineReplica`.
+
+    Drive it like an engine: ``submit()`` requests, ``step()`` (one
+    pump of the whole fleet) until done — or ``run_all()`` for batch
+    use.  All replicas must share weights and page geometry (greedy
+    streams are then bit-identical to a single engine, kill or no
+    kill)."""
+
+    def __init__(self, replicas: Sequence[EngineReplica],
+                 config: Optional[ServingConfig] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        ps = {r.engine.block.page_size for r in replicas}
+        if len(ps) != 1:
+            raise ValueError(f"replicas disagree on page_size: {ps} — "
+                             "KV migration needs one geometry")
+        self.config = config or ServingConfig()
+        self.replicas: Dict[str, EngineReplica] = {r.name: r for r in replicas}
+        self._page_size = ps.pop()
+        self._requests: Dict[int, _RequestRecord] = {}
+        self._uid = itertools.count()
+        self._init_metrics()
+        self._publish()
+
+    # -- telemetry -----------------------------------------------------------
+    def _init_metrics(self) -> None:
+        reg = get_registry()
+        self._m_live = reg.gauge(
+            "deepspeed_tpu_serving_fleet_replicas_live",
+            "replicas accepting work (alive, not retired/preempted)")
+        self._m_inflight = reg.gauge(
+            "deepspeed_tpu_serving_fleet_inflight_requests",
+            "submitted requests not yet finished")
+        self._m_requests = reg.counter(
+            "deepspeed_tpu_serving_fleet_requests_total",
+            "requests submitted to the router")
+        self._m_affinity = reg.counter(
+            "deepspeed_tpu_serving_fleet_affinity_routed_total",
+            "placements that followed prefix-cache affinity")
+        self._m_least = reg.counter(
+            "deepspeed_tpu_serving_fleet_least_loaded_routed_total",
+            "placements that fell back to the least-loaded replica")
+        self._m_migrations = reg.counter(
+            "deepspeed_tpu_serving_fleet_migrations_total",
+            "sequences streamed prefill -> decode (KV-page migration)")
+        self._m_migrated_pages = reg.counter(
+            "deepspeed_tpu_serving_fleet_migrated_pages_total",
+            "KV pages moved by migration")
+        self._m_migration_failures = reg.counter(
+            "deepspeed_tpu_serving_fleet_migration_failures_total",
+            "migrations refused for capacity (sequence stayed put)")
+        self._m_redispatch = reg.counter(
+            "deepspeed_tpu_serving_fleet_redispatches_total",
+            "in-flight requests re-run after a replica loss")
+        self._m_deaths = reg.counter(
+            "deepspeed_tpu_serving_fleet_replica_deaths_total",
+            "replicas lost without warning")
+        self._m_preempt = reg.counter(
+            "deepspeed_tpu_serving_fleet_replica_preemptions_total",
+            "replicas evacuated after a preemption notice")
+        self._m_drains = reg.counter(
+            "deepspeed_tpu_serving_fleet_drains_total",
+            "replica retirements via engine drain")
+        self._m_failed = reg.counter(
+            "deepspeed_tpu_serving_fleet_failed_requests_total",
+            "requests abandoned after max_redispatch replica losses")
+
+    def _publish(self) -> None:
+        self._m_live.set(sum(1 for r in self.replicas.values()
+                             if r.accepts_new()))
+        self._m_inflight.set(sum(1 for rec in self._requests.values()
+                                 if not rec.done))
+
+    # -- placement -----------------------------------------------------------
+    def _candidates(self, phase: str) -> List[EngineReplica]:
+        """Replicas that can take ``phase`` work, role-preferred with a
+        lossless fallback to ANY accepting replica when the preferred
+        pool is empty (e.g. every prefill replica died)."""
+        roles = (phase, ROLE_MIXED)
+        pref = [r for r in self.replicas.values()
+                if r.accepts_new() and r.role in roles]
+        if pref:
+            return pref
+        return [r for r in self.replicas.values() if r.accepts_new()]
+
+    def _route(self, prompt_ids: Sequence[int]) -> Tuple[EngineReplica, str]:
+        cands = self._candidates(ROLE_PREFILL)
+        if not cands:
+            raise RuntimeError("no live replica accepts work")
+        key = affinity_key(prompt_ids, self._page_size,
+                           self.config.affinity_pages)
+        chosen, via = pick_replica(key, cands, self.config.load_gap)
+        (self._m_affinity if via == "affinity" else self._m_least).inc()
+        return chosen, via
+
+    # -- request API ---------------------------------------------------------
+    def submit(self, request: RaggedRequest) -> int:
+        """Route + enqueue one request; returns the router-level uid its
+        stream is keyed by (stable across migrations/re-dispatch)."""
+        uid = next(self._uid)
+        rec = _RequestRecord(request)
+        self._requests[uid] = rec
+        target, via = self._route(request.prompt_ids)
+        target.engine.put(RaggedRequest(
+            prompt_ids=list(request.prompt_ids),
+            max_new_tokens=request.max_new_tokens,
+            temperature=request.temperature, eos_id=request.eos_id, uid=uid))
+        rec.replica = target.name
+        self._m_requests.inc()
+        record_event("fleet_route", cat="serve", uid=uid,
+                     replica=target.name, via=via,
+                     prompt_tokens=len(request.prompt_ids))
+        self._publish()
+        return uid
+
+    def has_work(self) -> bool:
+        return any(not rec.done for rec in self._requests.values())
+
+    # -- failure handling ----------------------------------------------------
+    def _redispatch(self, uid: int, charge: bool = True) -> None:
+        """Re-run an unfinished request elsewhere.  ``charge=False`` is
+        for planned retirements (drain handbacks): the request was not
+        lost to a replica failure, so it neither consumes the
+        ``max_redispatch`` replica-loss budget nor counts in the
+        re-dispatch metric."""
+        rec = self._requests[uid]
+        if rec.done:
+            return
+        remaining = rec.request.max_new_tokens - len(rec.emitted)
+        if remaining <= 0:
+            rec.done = True
+            return
+        if charge:
+            rec.redispatches += 1
+            if rec.redispatches > self.config.max_redispatch:
+                rec.done = rec.failed = True
+                rec.replica = None
+                self._m_failed.inc()
+                logger.error(f"fleet: request {uid} abandoned after "
+                             f"{rec.redispatches - 1} re-dispatches")
+                return
+        # continuation prompt = original prompt + tokens already
+        # streamed: greedy decoding is deterministic, so the re-run
+        # continues the stream bit-identically (the same recompute
+        # contract engine preemption relies on)
+        prompt = list(rec.request.prompt_ids) + list(rec.emitted)
+        cands = self._candidates(ROLE_PREFILL)
+        if not cands:
+            rec.done = rec.failed = True
+            self._m_failed.inc()
+            logger.error(f"fleet: request {uid} lost — no live replicas")
+            return
+        key = affinity_key(prompt, self._page_size,
+                           self.config.affinity_pages)
+        target, _via = pick_replica(key, cands, self.config.load_gap)
+        target.engine.put(RaggedRequest(
+            prompt_ids=prompt, max_new_tokens=remaining,
+            temperature=rec.request.temperature,
+            eos_id=rec.request.eos_id, uid=uid))
+        rec.replica = target.name
+        if charge:
+            self._m_redispatch.inc()
+        record_event("fleet_redispatch", cat="serve", uid=uid,
+                     replica=target.name, emitted=len(rec.emitted),
+                     attempt=rec.redispatches, planned=not charge)
+
+    def _owned_uids(self, name: str) -> List[int]:
+        return [uid for uid, rec in self._requests.items()
+                if rec.replica == name and not rec.done]
+
+    def _reap_dead(self) -> None:
+        for r in self.replicas.values():
+            if r.alive or r.retired:
+                continue
+            r.retired = True
+            lost = self._owned_uids(r.name)
+            self._m_deaths.inc()
+            record_event("fleet_replica_death", cat="serve",
+                         replica=r.name, inflight=len(lost))
+            logger.warning(f"fleet: replica {r.name} died with "
+                           f"{len(lost)} in-flight request(s); "
+                           "re-dispatching")
+            for uid in lost:
+                self._redispatch(uid)
+
+    def _reap_preempted(self) -> None:
+        for r in self.replicas.values():
+            if not (r.alive and not r.retired and r.preempted):
+                continue
+            self._m_preempt.inc()
+            record_event("fleet_replica_preempted", cat="serve",
+                         replica=r.name, reason=r.watcher.requested)
+            logger.warning(f"fleet: replica {r.name} preempted "
+                           f"({r.watcher.requested}); evacuating")
+            self._evacuate(r)
+
+    def _evacuate(self, r: EngineReplica) -> None:
+        """Graceful retirement: decode-ready sequences migrate with
+        their KV pages; everything else (queued, mid-prefill) is
+        re-dispatched; the replica ends retired with an empty engine."""
+        for uid in list(r.engine.ready_uids()):
+            # keep trying the rest on failure: a long sequence that fits
+            # nowhere must not force shorter ones into full recompute
+            self._try_migrate(uid, r)
+        leftovers = r.engine.abort_all(reason="evacuate")
+        r.retired = True
+        record_event("fleet_retire", cat="serve", replica=r.name,
+                     redispatched=len(leftovers))
+        for uid in leftovers:
+            self._redispatch(uid)
+
+    # -- disaggregation ------------------------------------------------------
+    def _decode_targets(self, src: EngineReplica) -> List[EngineReplica]:
+        return [r for r in self.replicas.values()
+                if r is not src and r.accepts_new()
+                and r.role in (ROLE_DECODE, ROLE_MIXED)]
+
+    def _try_migrate(self, uid: int, src: EngineReplica) -> bool:
+        rec = self._requests.get(uid)
+        targets = sorted(self._decode_targets(src),
+                         key=lambda r: (r.load(), r.name))
+        for dst in targets:
+            moved = migrate_sequence(src.engine, dst.engine, uid)
+            if moved:
+                if rec is not None:
+                    rec.replica = dst.name
+                self._m_migrations.inc()
+                self._m_migrated_pages.inc(moved)
+                record_event("fleet_migrate", cat="serve", uid=uid,
+                             src=src.name, dst=dst.name, pages=moved)
+                return True
+        self._m_migration_failures.inc()
+        return False
+
+    def _pump_migrations(self) -> None:
+        """Stream decode-ready sequences off prefill-role replicas.
+        Runs BEFORE the engines step, so a sequence whose prefill
+        finished last pump never decodes on the prefill pool."""
+        for r in self.replicas.values():
+            if r.role != ROLE_PREFILL or not r.alive or r.retired:
+                continue
+            if not self._decode_targets(r):
+                # decode pool gone: keep decoding here (mixed fallback)
+                # without burning a migration-failure count per pump
+                continue
+            for uid in list(r.engine.ready_uids()):
+                self._try_migrate(uid, r)
+
+    # -- the fleet pump ------------------------------------------------------
+    def step(self) -> Dict[int, Dict[str, Any]]:
+        """One pump: reap failures, migrate ready sequences, step every
+        replica.  Returns ``{uid: {"tokens": [...], "done": bool}}``
+        keyed by router uids — the same shape as ``engine.step()``."""
+        self._reap_dead()
+        self._reap_preempted()
+        if self.config.disaggregated:
+            self._pump_migrations()
+        out: Dict[int, Dict[str, Any]] = {}
+        for r in self.replicas.values():
+            if not (r.alive and not r.retired):
+                continue
+            for uid, rec_out in r.step().items():
+                rec = self._requests.get(uid)
+                if rec is None:
+                    continue
+                rec.emitted.extend(rec_out["tokens"])
+                if rec_out["done"]:
+                    rec.done = True
+                    rec.replica = None
+                merged = out.setdefault(uid, {"tokens": [], "done": False})
+                merged["tokens"].extend(rec_out["tokens"])
+                merged["done"] = rec_out["done"]
+        self._publish()
+        return out
+
+    def run_all(self, requests: Sequence[RaggedRequest],
+                max_steps: int = 10_000) -> Dict[int, List[int]]:
+        """Convenience: submit + pump to completion; returns full
+        generations keyed by router uid (submission order)."""
+        uids = [self.submit(r) for r in requests]
+        for _ in range(max_steps):
+            if not self.has_work():
+                break
+            self.step()
+        else:
+            logger.warning("fleet run_all: max_steps reached with work "
+                           "pending")
+        return {u: list(self._requests[u].emitted) for u in uids}
+
+    # -- lifecycle / observability ------------------------------------------
+    def kill_replica(self, name: str) -> None:
+        """Chaos hook: unannounced death; next ``step()`` re-dispatches."""
+        self.replicas[name].kill()
+
+    def retire_replica(self, name: str, migrate: bool = True) -> None:
+        """Planned retirement.  ``migrate=True`` evacuates (KV migration
+        + re-dispatch, nothing recomputed locally); ``migrate=False``
+        drains in place — the engine finishes its admitted sequences and
+        hands queued ones back for re-dispatch."""
+        r = self.replicas[name]
+        if not r.alive or r.retired:
+            return
+        if migrate:
+            self._evacuate(r)
+            return
+        result = r.engine.drain(max_steps=self.config.drain_max_steps)
+        self._m_drains.inc()
+        unfinished: List[int] = []
+        for uid, seq in result["finished"].items():
+            rec = self._requests.get(uid)
+            if rec is None:
+                continue
+            # seq.tokens = engine prompt + everything generated there;
+            # the engine prompt already contained rec.emitted from hops
+            # before this one
+            new = seq.tokens[len(rec.request.prompt_ids) + len(rec.emitted):]
+            rec.emitted.extend(int(t) for t in new)
+            if seq.done:
+                rec.done = True
+                rec.replica = None
+            else:
+                # drain hit drain_max_steps: the sequence is alive but
+                # its replica is retiring — hand it elsewhere, else it
+                # is stranded forever on a replica step() skips
+                unfinished.append(uid)
+        r.retired = True
+        if unfinished:
+            # free the stragglers' pages/spans in the retiring engine
+            # before re-running them elsewhere
+            r.engine.abort_all(reason="drain_timeout")
+        for uid in unfinished:
+            self._redispatch(uid, charge=False)
+        for seq in result["pending"]:
+            self._redispatch(seq.uid, charge=False)
+        self._publish()
+
+    def request_state(self, uid: int) -> Dict[str, Any]:
+        rec = self._requests[uid]
+        return {"emitted": list(rec.emitted), "done": rec.done,
+                "failed": rec.failed, "replica": rec.replica,
+                "redispatches": rec.redispatches}
+
+    def health(self) -> Dict[str, Any]:
+        return {name: r.health() for name, r in self.replicas.items()}
+
+
+def build_fleet(model: Any, serving: Optional[ServingConfig] = None,
+                engine_config: Any = None, params: Any = None,
+                seed: int = 0) -> FleetRouter:
+    """Construct a disaggregated fleet over one weight copy.
+
+    Prefill replicas get ``serving.prefill_chunk`` chunked prefill (when
+    set); decode replicas keep the base engine config.  With
+    ``disaggregated=False`` every replica is mixed and no migration
+    runs."""
+    import dataclasses as _dc
+
+    import jax
+
+    from ..inference.v2 import InferenceEngineV2, RaggedInferenceConfig
+
+    serving = serving or ServingConfig()
+    base = engine_config or RaggedInferenceConfig()
+    if params is None:
+        params = model.init_params(jax.random.PRNGKey(seed))
+    replicas: List[EngineReplica] = []
+    if serving.disaggregated:
+        pf_cfg = base
+        if serving.prefill_chunk > 0:
+            pf_cfg = _dc.replace(base, prefill_chunk=serving.prefill_chunk)
+        for i in range(serving.prefill_replicas):
+            replicas.append(EngineReplica(
+                f"prefill{i}",
+                InferenceEngineV2(model, pf_cfg, params=params, seed=seed),
+                role=ROLE_PREFILL))
+        for i in range(serving.decode_replicas):
+            replicas.append(EngineReplica(
+                f"decode{i}",
+                InferenceEngineV2(model, base, params=params, seed=seed),
+                role=ROLE_DECODE))
+    else:
+        for i in range(serving.prefill_replicas + serving.decode_replicas):
+            replicas.append(EngineReplica(
+                f"replica{i}",
+                InferenceEngineV2(model, base, params=params, seed=seed),
+                role=ROLE_MIXED))
+    return FleetRouter(replicas, serving)
+
+
+__all__ = ["FleetRouter", "build_fleet", "affinity_key", "hrw_score",
+           "pick_replica"]

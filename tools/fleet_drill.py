@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Fleet drill: prove routed disaggregated serving is lossless and
+bit-identical under replica failure.
+
+``--demo`` runs the whole serving-fleet story on CPU with a tiny fp32
+llama (greedy decoding), against a single-engine control on the same
+weights:
+
+* **Disaggregation leg** — 1 prefill + 2 decode replicas; requests are
+  routed by prefix-cache-affinity hashing, chunk-prefilled on the
+  prefill replica, and their KV pages migrate to decode replicas
+  (ref-count adoption on import).
+* **Kill leg** — one decode replica is hard-killed mid-stream (its
+  engine state, including every in-flight KV page, is gone).  The
+  router re-dispatches the lost streams; every request must complete
+  and every stream must be **bit-identical** to the single-engine
+  control.
+* **Preemption leg** — a second wave of requests; the surviving decode
+  replica gets a PR-5 maintenance notice mid-stream.  The router
+  evacuates it (KV migration where possible, re-dispatch otherwise);
+  streams again complete bit-identically, with the fleet degraded to
+  the prefill replica decoding as a mixed fallback.
+* **Metric-name lint** — the run registers the
+  ``deepspeed_tpu_serving_fleet_*`` family, then
+  ``tools/check_metric_names.py`` must pass over the tree and see it.
+
+Writes ``fleet_drill.json`` under ``--out``, prints ONE JSON summary
+line, and exits non-zero when any check fails — the acceptance gate for
+the serving-fleet subsystem.
+
+Knobs: ``--out DIR`` (default ./fleet_drill_demo), ``--requests N``
+(default 6), ``--new-tokens N`` (default 10).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_DIR = os.path.dirname(_TOOLS_DIR)
+sys.path.insert(0, _REPO_DIR)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PAGE_SIZE = 8
+PREFIX_TOKENS = 16  # two full pages shared per request family
+
+
+def _check(checks, name, ok, detail=""):
+    checks.append({"check": name, "ok": bool(ok), "detail": str(detail)})
+    print(f"  [{'ok' if ok else 'FAIL'}] {name}"
+          + (f" — {detail}" if detail else ""))
+    return ok
+
+
+def _build(n_requests: int, new_tokens: int):
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceConfig,
+                                            RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.serving import ServingConfig, build_fleet
+
+    model = llama_model("tiny", max_seq_len=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    base = RaggedInferenceConfig(dtype="fp32", page_size=PAGE_SIZE,
+                                 num_pages=64, max_seqs=4,
+                                 max_pages_per_seq=12,
+                                 enable_prefix_cache=True)
+    serving = ServingConfig(enabled=True, prefill_replicas=1,
+                            decode_replicas=2, disaggregated=True,
+                            affinity_pages=2, prefill_chunk=PAGE_SIZE)
+    fleet = build_fleet(model, serving, engine_config=base, params=params)
+
+    rng = np.random.RandomState(7)
+    vocab = model.config.vocab_size
+    prefix = list(rng.randint(0, vocab, PREFIX_TOKENS))
+
+    def make_requests(n, salt):
+        rq = np.random.RandomState(100 + salt)
+        return [RaggedRequest(
+            prompt_ids=prefix + list(rq.randint(0, vocab, 3 + i)),
+            max_new_tokens=new_tokens) for i in range(n)]
+
+    def control_run(requests):
+        """Fresh single engine on the same weights; greedy, so the
+        fleet must reproduce these streams token-for-token."""
+        eng = InferenceEngineV2(model, base, params=params)
+        got = eng.generate_all([RaggedRequest(
+            prompt_ids=list(r.prompt_ids),
+            max_new_tokens=r.max_new_tokens) for r in requests])
+        eng.close()
+        return [got[i] for i in range(len(requests))]
+
+    return fleet, make_requests, control_run
+
+
+def run_demo(out: str, n_requests: int, new_tokens: int) -> int:
+    from deepspeed_tpu.telemetry import get_registry
+
+    shutil.rmtree(out, ignore_errors=True)
+    os.makedirs(out)
+    print(f"fleet drill: {n_requests} requests x {new_tokens} tokens, "
+          f"1 prefill + 2 decode replicas -> {out}")
+    fleet, make_requests, control_run = _build(n_requests, new_tokens)
+    reg = get_registry()
+
+    def counter(name):
+        return reg.counter(name, "").total()
+
+    checks = []
+
+    # ---- leg 1: disaggregated serving + mid-stream decode-replica kill
+    reqs = make_requests(n_requests, salt=1)
+    want = control_run(reqs)
+    uids = [fleet.submit(r) for r in reqs]
+    mid_stream = False
+    for _ in range(200):
+        fleet.step()
+        states = [fleet.request_state(u) for u in uids]
+        on_decode = [s for s in states if (s["replica"] or "").startswith("decode")]
+        if on_decode and all(1 <= len(s["emitted"]) < new_tokens
+                             for s in states):
+            mid_stream = True
+            break
+    _check(checks, "streams_mid_flight_on_decode_pool", mid_stream,
+           f"{len([1 for s in states if s['replica']])} placed")
+    hosts = {}
+    for u in uids:
+        rep = fleet.request_state(u)["replica"] or ""
+        if rep.startswith("decode"):
+            hosts[rep] = hosts.get(rep, 0) + 1
+    victim = max(hosts, key=hosts.get) if hosts else "decode0"
+    d0, r0 = counter("deepspeed_tpu_serving_fleet_replica_deaths_total"), \
+        counter("deepspeed_tpu_serving_fleet_redispatches_total")
+    print(f"  killing {victim} mid-stream "
+          f"(hosting {hosts.get(victim, 0)} stream(s))")
+    fleet.kill_replica(victim)
+    for _ in range(400):
+        if not fleet.has_work():
+            break
+        fleet.step()
+    got = [fleet.request_state(u)["emitted"] for u in uids]
+    _check(checks, "all_streams_complete_after_kill",
+           not fleet.has_work()
+           and all(not fleet.request_state(u)["failed"] for u in uids))
+    _check(checks, "kill_leg_bit_identical_to_single_engine",
+           got == want,
+           f"{sum(g == w for g, w in zip(got, want))}/{len(want)} match")
+    _check(checks, "replica_death_detected",
+           counter("deepspeed_tpu_serving_fleet_replica_deaths_total") == d0 + 1)
+    _check(checks, "streams_recovered_via_redispatch",
+           counter("deepspeed_tpu_serving_fleet_redispatches_total") > r0,
+           f"{counter('deepspeed_tpu_serving_fleet_redispatches_total') - r0} "
+           "re-dispatched")
+    _check(checks, "kv_migrations_ran",
+           counter("deepspeed_tpu_serving_fleet_migrations_total")
+           >= n_requests,
+           f"{counter('deepspeed_tpu_serving_fleet_migrations_total')} "
+           "migrations, "
+           f"{counter('deepspeed_tpu_serving_fleet_migrated_pages_total')} "
+           "pages")
+
+    # ---- leg 2: preemption notice on the surviving decode replica
+    reqs2 = make_requests(max(2, n_requests // 2), salt=2)
+    want2 = control_run(reqs2)
+    uids2 = [fleet.submit(r) for r in reqs2]
+    for _ in range(3):
+        fleet.step()
+    survivors = [n for n, r in fleet.replicas.items()
+                 if r.alive and not r.retired and r.role == "decode"]
+    p0 = counter("deepspeed_tpu_serving_fleet_replica_preemptions_total")
+    if survivors:
+        print(f"  preemption notice -> {survivors[0]}")
+        fleet.replicas[survivors[0]].watcher.notify("maintenance-sim")
+    for _ in range(400):
+        if not fleet.has_work():
+            break
+        fleet.step()
+    got2 = [fleet.request_state(u)["emitted"] for u in uids2]
+    _check(checks, "preempted_replica_evacuated",
+           bool(survivors)
+           and counter("deepspeed_tpu_serving_fleet_replica_preemptions_total")
+           == p0 + 1, survivors)
+    _check(checks, "preempt_leg_bit_identical_to_single_engine",
+           got2 == want2,
+           f"{sum(g == w for g, w in zip(got2, want2))}/{len(want2)} match")
+
+    # ---- metric-name lint over the tree (fleet family included)
+    import check_metric_names as lint
+
+    errors = lint.check(_REPO_DIR)
+    fleet_names = sorted(n for n in lint.collect(_REPO_DIR)
+                         if n.startswith("deepspeed_tpu_serving_fleet_"))
+    _check(checks, "check_metric_names_passes", not errors,
+           errors[:3] if errors else f"{len(fleet_names)} fleet metrics")
+    _check(checks, "fleet_metric_family_registered", len(fleet_names) >= 8,
+           fleet_names[:4])
+
+    ok = all(c["ok"] for c in checks)
+    summary = {"demo": "fleet_drill", "ok": ok, "out": out,
+               "requests": n_requests + len(reqs2),
+               "victim": victim, "health": fleet.health(),
+               "fleet_metrics": fleet_names, "checks": checks}
+    with open(os.path.join(out, "fleet_drill.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps({k: v for k, v in summary.items()
+                      if k not in ("checks", "health", "fleet_metrics")}))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="run the disaggregation + kill + preemption drill "
+                         "on a tiny CPU model")
+    ap.add_argument("--out", default="./fleet_drill_demo")
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--new-tokens", type=int, default=10)
+    args = ap.parse_args(argv)
+    if not args.demo:
+        ap.print_help()
+        return 2
+    if args.requests < 2 or args.new_tokens < 4:
+        ap.error("need --requests >= 2 and --new-tokens >= 4 for a "
+                 "meaningful mid-stream kill")
+    return run_demo(os.path.abspath(args.out), args.requests, args.new_tokens)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
