@@ -16,3 +16,5 @@ kernels.
 from .ragged import (BlockAllocator, KVBlockConfig, KVPageBundle,  # noqa: F401
                      PagedKVCache, PrefixCache)
 from .engine_v2 import InferenceEngineV2, RaggedInferenceConfig, RaggedRequest  # noqa: F401
+from .speculative import (DraftModelProposer, NgramProposer,  # noqa: F401
+                          SpeculativeConfig)

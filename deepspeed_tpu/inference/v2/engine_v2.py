@@ -29,20 +29,20 @@ import numpy as np
 
 from ...models.transformer import TransformerConfig
 from ...runtime.config_utils import ConfigModel
-from ...runtime.precision import cast_tree
 from ...telemetry import get_registry
 from ...telemetry.compile_sentinel import RecompileSentinel
 from ...telemetry.compile_sentinel import \
     expect_recompile as sentinel_expect_recompile
 from ...telemetry.flight import dump_on_exception
-from ...telemetry.spans import begin_span, end_span, record_event
+from ...telemetry.spans import begin_span, end_span, record_event, span
 from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
 from .model_runner import (paged_copy_page, paged_decode, paged_gather_pages,
                            paged_prefill, paged_prefill_chunk,
-                           paged_scatter_pages)
+                           paged_scatter_pages, paged_verify)
 from .ragged import (BlockAllocator, KVBlockConfig, KVPageBundle, PagedKVCache,
                      PrefixCache, SequenceState)
+from .speculative import (SpeculativeConfig, build_proposer, longest_accepted)
 
 
 @dataclasses.dataclass
@@ -92,6 +92,16 @@ class RaggedInferenceConfig(ConfigModel):
     #: watermarks.  The serving engine takes no `telemetry` block, so —
     #: like the sentinel above — the knob lives here
     memory_ledger: bool = True
+    #: speculative decoding (speculative.py): multi-token-per-step
+    #: decode — a proposer drafts up to k tokens, ONE batched verify
+    #: program scores them all, the longest prefix matching the model's
+    #: own greedy choices is accepted (+ the model's correction token),
+    #: rejected tokens' pages roll back through the allocator.  GREEDY
+    #: decoding is bit-identical to the non-speculative baseline;
+    #: non-greedy sequences fall back to the plain decode program
+    #: (sampling guard) so the output distribution is never touched
+    speculative: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig)
 
     @property
     def jnp_dtype(self):
@@ -132,8 +142,11 @@ class InferenceEngineV2:
         return cls(llama_model(config=mcfg), config=cfg, params=params, **kw)
 
     def __init__(self, model: Any, config: Optional[RaggedInferenceConfig] = None,
-                 params: Any = None, seed: int = 0):
+                 params: Any = None, seed: int = 0, proposer: Any = None):
         self.config = config or RaggedInferenceConfig()
+        if isinstance(self.config.speculative, dict):  # hand-built configs
+            self.config.speculative = SpeculativeConfig.from_dict(
+                self.config.speculative)
         if not hasattr(model, "config") or not isinstance(model.config, TransformerConfig):
             raise TypeError("InferenceEngineV2 needs a models/* model carrying "
                             "a TransformerConfig")
@@ -152,6 +165,11 @@ class InferenceEngineV2:
                 "completion even with the whole pool")
         if params is None:
             params = model.init_params(jax.random.PRNGKey(seed))
+        # deferred: runtime.precision pulls runtime.config, which imports
+        # serving.config -> inference.v2 — a top-level import here would
+        # close that cycle during runtime.config's own initialization
+        from ...runtime.precision import cast_tree
+
         self.params = cast_tree(params, self.config.jnp_dtype)
         self.param_bytes = sum(l.size * l.dtype.itemsize for l in
                                jax.tree_util.tree_leaves(self.params))
@@ -181,6 +199,13 @@ class InferenceEngineV2:
         self._stats = {"prefill_admitted_tokens": 0,
                        "prefill_computed_tokens": 0,
                        "prefix_hit_tokens": 0}
+        # decode-phase counters (decode_stats / bench_serving A/B): model
+        # invocations vs tokens produced is THE speculative-decoding
+        # figure of merit — tokens per invocation
+        self._dstats = {"decode_model_invocations": 0, "decode_tokens": 0,
+                        "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
+                        "spec_verify_calls": 0, "spec_rollback_pages": 0,
+                        "spec_fallback_requests": 0}
         self._init_serving_metrics()
         self._uid = itertools.count()
         self._admit_counter = itertools.count()
@@ -219,6 +244,30 @@ class InferenceEngineV2:
                        if self.config.prefill_chunk > 0 else 0)
         self._sample_key = jax.random.PRNGKey(seed)
         self._decode_steps = 0
+        # speculative decoding: an explicit ``proposer=`` argument wins
+        # (and enables speculation regardless of mode); otherwise the
+        # config block builds one.  The verify program has ONE compiled
+        # width (k + 1) so every acceptance outcome reuses it.
+        self.spec = self.config.speculative
+        if proposer is not None:
+            if self.spec.k < 1:  # the one field the engine still uses
+                raise ValueError("speculative.k must be >= 1")
+            self._proposer = proposer
+        else:
+            self.spec.validate()  # directly-built configs skip from_dict
+            self._proposer = build_proposer(self.spec)
+        self._spec_fallback_uids: set = set()
+        self._spec_fallback_warned = False
+        if self._proposer is not None:
+            def _verify_and_greedy(params, pools, ids, pos, table, act, nv):
+                logits, pools = paged_verify(cfg, params, pools, ids, pos,
+                                             table, act, nv)
+                # greedy argmax on device: [B, W] int32 crosses the link,
+                # not [B, W, vocab] logits (same economics as decode)
+                return (jnp.argmax(logits.astype(jnp.float32), axis=-1)
+                        .astype(jnp.int32), pools)
+
+            self._verify = jax.jit(_verify_and_greedy, donate_argnums=(1,))
         # request lifecycle bookkeeping: enqueue/first-token stamps + the
         # open request span, keyed by uid (survives preemption, which
         # resets the SequenceState but not the request)
@@ -344,6 +393,37 @@ class InferenceEngineV2:
             "deepspeed_tpu_serving_tpot_seconds",
             "mean time per output token after the first, observed once "
             "per finished request")
+        # speculative decoding family (speculative.py; all still valid —
+        # flat zeros — with speculation off, like the cache counters)
+        self._m_invocations = reg.counter(
+            "deepspeed_tpu_serving_decode_model_invocations_total",
+            "decode-phase model program calls (plain decode steps + "
+            "speculative verify calls) — tokens/invocation is the "
+            "speculative figure of merit")
+        self._m_spec_proposed = reg.counter(
+            "deepspeed_tpu_serving_spec_proposed_tokens_total",
+            "draft tokens proposed for verification")
+        self._m_spec_accepted = reg.counter(
+            "deepspeed_tpu_serving_spec_accepted_tokens_total",
+            "draft tokens accepted (matched the model's greedy choice)")
+        self._m_spec_rollback = reg.counter(
+            "deepspeed_tpu_serving_spec_rollback_pages_total",
+            "draft-reserved KV pages rolled back after rejection")
+        self._m_spec_fallback = reg.counter(
+            "deepspeed_tpu_serving_spec_fallback_requests_total",
+            "non-greedy requests routed to the plain decode program by "
+            "the sampling guard (speculation never changes the "
+            "sampling distribution)")
+        self._m_spec_tps = reg.histogram(
+            "deepspeed_tpu_serving_spec_tokens_per_step",
+            "tokens emitted per sequence per verify call (accepted "
+            "prefix + the model's correction token; >= 1)")
+        self._m_spec_rate = reg.gauge(
+            "deepspeed_tpu_serving_spec_acceptance_rate",
+            "cumulative accepted / proposed draft tokens")
+        self._m_spec_verify_h = reg.histogram(
+            "deepspeed_tpu_serving_spec_verify_seconds",
+            "one batched speculative verify program wall time")
         # last-published absolutes for the per-engine cache counters, so
         # the process-cumulative registry counters only receive deltas
         self._cache_pub = {"hits": 0, "misses": 0, "evictions": 0}
@@ -669,6 +749,7 @@ class InferenceEngineV2:
             s.slot, s.pages = -1, []
             uids.append(s.uid)
         for uid in uids:
+            self._spec_fallback_uids.discard(uid)
             m = self._req_meta.pop(uid, None)
             if m is not None:
                 end_span(m["span"], aborted=reason, generated=m["n"])
@@ -853,12 +934,19 @@ class InferenceEngineV2:
         self._page_table[seq.slot, :] = self.block.trash_page
         self._slots[seq.slot] = None
         seq.slot, seq.pages, seq.done = -1, [], True
+        self._spec_fallback_uids.discard(seq.uid)
         self._finish_request(seq)
 
-    def _maybe_finish(self, seq: SequenceState, token: int) -> None:
-        if (seq.generated >= seq.max_new_tokens
+    def _should_finish(self, seq: SequenceState, token: int) -> bool:
+        """THE finish predicate — also stops mid-round emission in
+        ``_spec_step``, so any new condition added here automatically
+        drops accepted draft tokens past the boundary too."""
+        return (seq.generated >= seq.max_new_tokens
                 or (seq.eos_id is not None and token == seq.eos_id)
-                or seq.length >= self.max_seq_len):
+                or seq.length >= self.max_seq_len)
+
+    def _maybe_finish(self, seq: SequenceState, token: int) -> None:
+        if self._should_finish(seq, token):
             self._retire(seq)
 
     def _run_prefill_chunk(self, seq: SequenceState, start: int, c_n: int,
@@ -1011,46 +1099,213 @@ class InferenceEngineV2:
         if not active:
             return out
 
-        B = self.block.max_seqs
-        last = np.zeros((B,), np.int32)
-        pos = np.zeros((B,), np.int32)
-        act = np.zeros((B,), bool)
-        temps = np.zeros((B,), np.float32)
-        for seq in active:
-            last[seq.slot] = seq.tokens[-1]
-            pos[seq.slot] = seq.length - 1
-            act[seq.slot] = True
-            temps[seq.slot] = max(seq.temperature, 0.0)
+        # speculative split: greedy sequences go through the batched
+        # verify program (multi-token), non-greedy ones LOUDLY fall back
+        # to the plain decode program — the sampling guard: the verify
+        # accept rule is exact only for argmax, and silently speculating
+        # a sampled stream would change its distribution
+        if self._proposer is not None:
+            spec_seqs = [s for s in active if s.temperature <= 0.0]
+            decode_seqs = [s for s in active if s.temperature > 0.0]
+            for seq in decode_seqs:
+                if seq.uid not in self._spec_fallback_uids:
+                    self._spec_fallback_uids.add(seq.uid)
+                    self._dstats["spec_fallback_requests"] += 1
+                    self._m_spec_fallback.inc()
+                    if not self._spec_fallback_warned:
+                        self._spec_fallback_warned = True
+                        logger.warning(
+                            "speculative decoding: non-greedy sampling "
+                            "params fall back to the plain decode program "
+                            "(distribution-preserving; acceptance gains "
+                            "apply to greedy requests only)")
+            if spec_seqs:
+                decode_seqs += self._spec_step(spec_seqs, out)
+        else:
+            decode_seqs = active
 
-        self._decode_steps += 1
-        self._step_parts.add("decode")
-        with self._phase("decode", self._m_decode_h, batch=len(active)):
-            tokens, self._pools = self._decode(
-                self.params, self._pools,
-                jnp.asarray(last), jnp.asarray(pos),
-                jnp.asarray(self._page_table), jnp.asarray(act),
-                jnp.asarray(temps), self._sample_key,
-                jnp.asarray(self._decode_steps, jnp.uint32))
-            tokens = np.asarray(tokens)
-        self._m_gen_tokens.inc(len(active))
+        if decode_seqs:
+            B = self.block.max_seqs
+            last = np.zeros((B,), np.int32)
+            pos = np.zeros((B,), np.int32)
+            act = np.zeros((B,), bool)
+            temps = np.zeros((B,), np.float32)
+            for seq in decode_seqs:
+                last[seq.slot] = seq.tokens[-1]
+                pos[seq.slot] = seq.length - 1
+                act[seq.slot] = True
+                temps[seq.slot] = max(seq.temperature, 0.0)
 
-        for seq in active:
-            tok = int(tokens[seq.slot])
-            seq.tokens.append(tok)
-            self._note_tokens(seq)
-            # the decode step wrote KV for the token it consumed
-            seq.prefilled = seq.length - 1
-            if self.prefix_cache is not None and seq.prefilled % ps == 0:
-                # the decode write completed a page: publish it so a
-                # preempted-then-readmitted (or forked) sequence can remap
-                # instead of recomputing
-                self._register_pages(seq)
-            rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
-            rec["tokens"].append(tok)
-            self._maybe_finish(seq, tok)
-            rec["done"] = seq.done
+            self._decode_steps += 1
+            self._step_parts.add("decode")
+            with self._phase("decode", self._m_decode_h,
+                             batch=len(decode_seqs)):
+                tokens, self._pools = self._decode(
+                    self.params, self._pools,
+                    jnp.asarray(last), jnp.asarray(pos),
+                    jnp.asarray(self._page_table), jnp.asarray(act),
+                    jnp.asarray(temps), self._sample_key,
+                    jnp.asarray(self._decode_steps, jnp.uint32))
+                tokens = np.asarray(tokens)
+            self._m_gen_tokens.inc(len(decode_seqs))
+            self._m_invocations.inc()
+            self._dstats["decode_model_invocations"] += 1
+            self._dstats["decode_tokens"] += len(decode_seqs)
+
+            for seq in decode_seqs:
+                tok = int(tokens[seq.slot])
+                seq.tokens.append(tok)
+                self._note_tokens(seq)
+                # the decode step wrote KV for the token it consumed
+                seq.prefilled = seq.length - 1
+                if self.prefix_cache is not None and seq.prefilled % ps == 0:
+                    # the decode write completed a page: publish it so a
+                    # preempted-then-readmitted (or forked) sequence can
+                    # remap instead of recomputing
+                    self._register_pages(seq)
+                rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
+                rec["tokens"].append(tok)
+                self._maybe_finish(seq, tok)
+                rec["done"] = seq.done
         self._sync_cache_counters()
         return out
+
+    # -- speculative decoding ------------------------------------------------
+    def _spec_step(self, seqs: List[SequenceState],
+                   out: Dict[int, Dict[str, Any]]
+                   ) -> List[SequenceState]:
+        """One speculative decode round for greedy-ready sequences:
+        propose -> reserve -> ONE batched verify -> accept longest
+        prefix + bonus token -> roll back rejected pages.  Returns the
+        sequences it did NOT run — the whole batch when every proposal
+        came up empty — for the caller's plain decode program.
+
+        Every sequence emits at least one token per round (the model's
+        own greedy choice rides in the verify output even on a total
+        miss or an empty draft), so speculation never does worse than
+        plain decode in tokens per model invocation.  Mixed accept
+        lengths coexist in one batch: acceptance is per-row host logic
+        over the per-position argmax the program returns."""
+        ps = self.block.page_size
+        k = self.spec.k
+        W = k + 1
+        B = self.block.max_seqs
+
+        # -- propose + reserve (host) --
+        drafts: Dict[int, List[int]] = {}
+        with span("spec_propose", cat="serve", seqs=len(seqs)):
+            for seq in seqs:
+                d = list(self._proposer.propose(seq.tokens, k))[:k]
+                # cap to the model window, the page-table width, and the
+                # request's remaining budget (emitting past max_new /
+                # max_seq_len would be discarded — don't verify it)
+                cap = min(self.max_seq_len - seq.length,
+                          len(self._page_table[seq.slot]) * ps
+                          - seq.length,
+                          seq.max_new_tokens - seq.generated - 1)
+                if len(d) > cap:
+                    d = d[:max(cap, 0)]
+                if d:
+                    # reserve pages for the draft window, spending ONLY
+                    # truly-free pages: draft tokens may be rejected, so
+                    # neither prefix-cache LRU content nor other
+                    # sequences (no preemption) are sacrificed for them
+                    need = (seq.length - 1 + len(d)) // ps + 1
+                    extra = need - len(seq.pages)
+                    while (extra > 0
+                           and extra > self.allocator.uncached_free_pages):
+                        d.pop()
+                        need = (seq.length - 1 + len(d)) // ps + 1
+                        extra = need - len(seq.pages)
+                    if extra > 0:
+                        fresh = self.allocator.alloc(extra)
+                        base = len(seq.pages)
+                        seq.pages.extend(fresh)
+                        self._page_table[seq.slot,
+                                         base:base + extra] = fresh
+                drafts[seq.uid] = d
+                self._dstats["spec_proposed_tokens"] += len(d)
+                self._m_spec_proposed.inc(len(d))
+
+        if not any(drafts.values()):
+            # nothing to verify (proposer drew blanks everywhere): the
+            # plain decode program emits the same one greedy token per
+            # row at 1/W the program width — hand the batch back so
+            # low-acceptance traffic never pays for verify it can't use
+            return list(seqs)
+
+        # -- one batched verify call --
+        ids = np.zeros((B, W), np.int32)
+        pos = np.zeros((B,), np.int32)
+        act = np.zeros((B,), bool)
+        nv = np.ones((B,), np.int32)
+        for seq in seqs:
+            row = [seq.tokens[-1]] + drafts[seq.uid]
+            ids[seq.slot, :len(row)] = row
+            pos[seq.slot] = seq.length - 1
+            act[seq.slot] = True
+            nv[seq.slot] = len(row)
+        self._step_parts.add(("verify", W))
+        with self._phase("spec_verify", self._m_spec_verify_h,
+                         batch=len(seqs), width=W):
+            greedy, self._pools = self._verify(
+                self.params, self._pools, jnp.asarray(ids),
+                jnp.asarray(pos), jnp.asarray(self._page_table),
+                jnp.asarray(act), jnp.asarray(nv))
+            greedy = np.asarray(greedy)  # [B, W] argmax per position
+        self._m_invocations.inc()
+        self._dstats["decode_model_invocations"] += 1
+        self._dstats["spec_verify_calls"] += 1
+
+        # -- accept + emit + rollback (host) --
+        rollback_pages = 0
+        for seq in seqs:
+            accepted, bonus = longest_accepted(drafts[seq.uid],
+                                               greedy[seq.slot])
+            base_len = seq.length  # L: tokens before this round
+            self._dstats["spec_accepted_tokens"] += len(accepted)
+            self._m_spec_accepted.inc(len(accepted))
+            rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
+            emitted = 0
+            for tok in accepted + [bonus]:
+                seq.tokens.append(tok)
+                emitted += 1
+                rec["tokens"].append(tok)
+                self._note_tokens(seq)
+                if self._should_finish(seq, tok):
+                    break  # drop accepted tokens past a finish boundary
+            self._m_gen_tokens.inc(emitted)
+            self._dstats["decode_tokens"] += emitted
+            self._m_spec_tps.observe(emitted)
+            # KV is valid through the accepted region (the bonus token is
+            # the pending one, exactly like a plain decode step)
+            seq.prefilled = min(seq.length - 1,
+                                base_len + len(accepted))
+            self._register_pages(seq)
+            self._maybe_finish(seq, seq.tokens[-1])
+            rec["done"] = seq.done
+            if not seq.done:
+                # rollback: pages reserved for rejected draft tokens are
+                # released; rejected KV inside kept pages is overwritten
+                # by the next window before any query can attend it
+                needed = (seq.prefilled - 1) // ps + 1
+                if needed < len(seq.pages):
+                    drop = seq.pages[needed:]
+                    self.allocator.free(drop)
+                    del seq.pages[needed:]
+                    self._page_table[seq.slot, needed:] = \
+                        self.block.trash_page
+                    rollback_pages += len(drop)
+        if rollback_pages:
+            self._dstats["spec_rollback_pages"] += rollback_pages
+            self._m_spec_rollback.inc(rollback_pages)
+            record_event("spec_rollback", cat="serve",
+                         pages=rollback_pages, seqs=len(seqs))
+        prop = self._dstats["spec_proposed_tokens"]
+        if prop:
+            self._m_spec_rate.set(
+                self._dstats["spec_accepted_tokens"] / prop)
+        return []
 
     def close(self) -> None:
         """Release this engine's memory-ledger slots (provider identity
@@ -1092,12 +1347,38 @@ class InferenceEngineV2:
         s["prefix_hit_rate"] = (s["prefix_hit_tokens"] / adm) if adm else 0.0
         return s
 
+    def decode_stats(self) -> Dict[str, float]:
+        """Decode-phase counters (cumulative; all-zero spec entries with
+        speculation off): model invocations, tokens produced, and the
+        speculative propose/accept/rollback tallies.  The derived
+        ``decode_tokens_per_invocation`` is the speculative-decoding
+        figure of merit ``tools/bench_serving.py --ab-speculative``
+        machine-checks."""
+        s: Dict[str, float] = dict(self._dstats)
+        inv = s["decode_model_invocations"]
+        s["decode_tokens_per_invocation"] = (
+            s["decode_tokens"] / inv) if inv else 0.0
+        prop = s["spec_proposed_tokens"]
+        s["spec_acceptance_rate"] = (
+            s["spec_accepted_tokens"] / prop) if prop else 0.0
+        return s
+
+    def assert_no_leaks(self) -> None:
+        """Exact allocator audit against this engine's live sequences
+        (ragged.BlockAllocator.assert_no_leaks): every KV page's
+        refcount must equal its live references, every refcount-0 page
+        must be free or LRU-parked.  Tests and ``fleet_drill`` call this
+        after speculative rollback / migration / preemption churn."""
+        self.allocator.assert_no_leaks(
+            [s.pages for s in self._slots if s is not None])
+
     def reset_cache_stats(self) -> None:
         """Zero the counters (cache CONTENTS are kept) — benches call this
         after warmup so compile-wave admissions don't pollute the rates.
         The registry counters stay cumulative (Prometheus counters never
         go backwards); only the delta baseline resets with the sources."""
         self._stats = {k: 0 for k in self._stats}
+        self._dstats = {k: 0 for k in self._dstats}
         self.allocator.evictions = 0
         if self.prefix_cache is not None:
             self.prefix_cache.hits = self.prefix_cache.misses = 0

@@ -325,6 +325,120 @@ def paged_prefill_chunk(cfg: TransformerConfig, params, pools,
     return logits, out_pools
 
 
+def _gather_window_attend(cfg: TransformerConfig, quant: bool, q,
+                          k_c, v_c, ks_c, vs_c, page_table, q_pos, vis
+                          ) -> jnp.ndarray:
+    """[B, T] written-through queries attend the pooled pages via the
+    XLA gather path — THE shared formulation of the paged_decode
+    fallback (T=1) and paged_verify (T=k+1), so the dequant / GQA /
+    alibi / mask / softmax chain cannot diverge between them.
+
+    q: [B, T, NH, D]; q_pos: [B, T] global positions; vis: [B, T, S]
+    per-query visibility over pool slots.  Returns [B, T, NH*D]."""
+    B, S = vis.shape[0], vis.shape[2]
+    kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
+    vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
+    if quant:
+        kk = kk.astype(jnp.float32) \
+            * ks_c[page_table].reshape(B, S, -1)[..., None]
+        vv = vv.astype(jnp.float32) \
+            * vs_c[page_table].reshape(B, S, -1)[..., None]
+        kk = kk.astype(q.dtype)
+        vv = vv.astype(q.dtype)
+    kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
+    vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
+    scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
+    scores = scores / math.sqrt(cfg.head_dim)
+    if cfg.position == "alibi":
+        scores = scores + _alibi_bias(cfg, q_pos, jnp.arange(S)[None])
+    scores = jnp.where(vis[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(
+        B, q.shape[1], -1)
+
+
+def paged_verify(cfg: TransformerConfig, params, pools,
+                 ids, positions, page_table, active, n_valid
+                 ) -> Tuple[jnp.ndarray, Any]:
+    """Score a W-token window for every decode slot in ONE model call —
+    the batched verify step of speculative decoding (engine_v2).
+
+    This is ``paged_decode`` generalized from one pending token to a
+    fixed-width window of ``W = k + 1`` tokens per sequence (the last
+    accepted token followed by up to ``k`` draft tokens): each valid
+    token's K/V is written into the sequence's pages exactly where plain
+    decode would have written it, then every window query attends the
+    pooled window ``slot_pos <= its position`` — the same
+    write-then-gather data flow as decode, so position ``w``'s logits
+    are what a plain decode step would have produced after consuming
+    ``ids[:, :w+1]``.  The host accepts the longest draft prefix
+    matching the per-position argmax and *rolls back* the pages of
+    rejected tokens; rejected KV left inside kept pages is harmless —
+    every read is masked to ``<= query position`` and the next window
+    starts at the first rejected position, overwriting it before any
+    query can see it.
+
+    ids: [B, W] window tokens (ids[:, 0] = last accepted token);
+    positions: [B] position of ids[:, 0]; page_table: [B, MP]
+    (trash-filled); active: [B] bool; n_valid: [B] valid tokens per row
+    (1..W — rows propose fewer than k drafts on an n-gram miss).
+    Invalid/inactive tokens write to the trash page and their outputs
+    are garbage the host never reads.  Returns (logits [B, W, V],
+    pools).
+
+    Like quantized chunked prefill this stays on the XLA gather path
+    (the Pallas decode kernel is single-query; a multi-query window
+    kernel is a future optimization) — the win measured here is model
+    *invocations*, not attention FLOPs."""
+    quant = "k_scale" in pools
+    B, W = ids.shape
+    ps = pools["k"].shape[2]
+    trash = pools["k"].shape[1] - 1
+    pos_w = positions[:, None] + jnp.arange(W)[None]  # [B, W]
+    x = params["embed"]["tok"][ids]  # [B, W, H]
+    if cfg.position == "learned":
+        pos_idx = jnp.minimum(pos_w, params["embed"]["pos"].shape[0] - 1)
+        x = x + params["embed"]["pos"][pos_idx]
+    if "norm" in params["embed"]:
+        x = _norm(x, params["embed"]["norm"]["scale"],
+                  params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
+
+    valid = active[:, None] & (jnp.arange(W)[None] < n_valid[:, None])
+    S = page_table.shape[1] * ps
+    page_idx = jnp.where(
+        valid, page_table[jnp.arange(B)[:, None],
+                          jnp.minimum(pos_w // ps, page_table.shape[1] - 1)],
+        trash)
+    off = pos_w % ps
+    slot_pos = jnp.arange(S)[None, None]          # [1, 1, S]
+    vis = slot_pos <= pos_w[:, :, None]           # [B, W, S]
+
+    def body(x, inputs):
+        layer, k_c, v_c, ks_c, vs_c = inputs
+        q, k, v = attn_qkv(cfg, layer, x, pos_w)  # [B, W, NH/KVH, D]
+        if quant:
+            kq, ksc = _kv_quantize(k)
+            vq, vsc = _kv_quantize(v)
+            k_c = k_c.at[page_idx, off].set(kq)
+            v_c = v_c.at[page_idx, off].set(vq)
+            ks_c = ks_c.at[page_idx, off].set(ksc)
+            vs_c = vs_c.at[page_idx, off].set(vsc)
+        else:
+            k_c = k_c.at[page_idx, off].set(k.astype(k_c.dtype))
+            v_c = v_c.at[page_idx, off].set(v.astype(v_c.dtype))
+        attn = _gather_window_attend(cfg, quant, q, k_c, v_c, ks_c,
+                                     vs_c, page_table, pos_w, vis)
+        return _attn_out(cfg, layer, x, attn), (k_c, v_c, ks_c, vs_c)
+
+    ops = (params["layers"],) + _pools_per_layer(pools)
+    x, new_pools = jax.lax.scan(body, x, ops)
+    out_pools = _pools_from_scan(new_pools)
+    hidden = _norm(x, params["final_norm"]["scale"],
+                   params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
+    logits = logits_fn(cfg, params, hidden)  # [B, W, V]
+    return logits, out_pools
+
+
 def paged_decode(cfg: TransformerConfig, params, pools,
                  last_tokens, positions, page_table, active
                  ) -> Tuple[jnp.ndarray, Any]:
@@ -381,25 +495,10 @@ def paged_decode(cfg: TransformerConfig, params, pools,
                               if cfg.position == "alibi" else None)
             ).reshape(B, 1, -1)
         else:
-            kk = k_c[page_table].reshape(B, S, *k_c.shape[2:])  # [B, S, KVH, D]
-            vv = v_c[page_table].reshape(B, S, *v_c.shape[2:])
-            if quant:
-                kk = kk.astype(jnp.float32) \
-                    * ks_c[page_table].reshape(B, S, -1)[..., None]
-                vv = vv.astype(jnp.float32) \
-                    * vs_c[page_table].reshape(B, S, -1)[..., None]
-                kk = kk.astype(x.dtype)
-                vv = vv.astype(x.dtype)
-            kk = _repeat_kv(kk, cfg.n_heads // cfg.kv_heads)
-            vv = _repeat_kv(vv, cfg.n_heads // cfg.kv_heads)
-            scores = jnp.einsum("btnd,bsnd->bnts", q, kk).astype(jnp.float32)
-            scores = scores / math.sqrt(cfg.head_dim)
-            if cfg.position == "alibi":
-                scores = scores + _alibi_bias(cfg, positions[:, None],
-                                              slot_pos)
-            scores = jnp.where(vis[:, None, None, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-            attn = jnp.einsum("bnts,bsnd->btnd", probs, vv).reshape(B, 1, -1)
+            attn = _gather_window_attend(cfg, quant, q, k_c, v_c, ks_c,
+                                         vs_c, page_table,
+                                         positions[:, None],
+                                         vis[:, None, :])
         return _attn_out(cfg, layer, x, attn), (k_c, v_c, ks_c, vs_c)
 
     ops = (params["layers"],) + _pools_per_layer(pools)

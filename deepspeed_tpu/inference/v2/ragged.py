@@ -89,6 +89,16 @@ class BlockAllocator:
         return self.num_pages - len(self._free) - len(self._lru)
 
     @property
+    def uncached_free_pages(self) -> int:
+        """Truly-free pages, excluding cached-but-unreferenced LRU pages.
+        This is the budget speculative draft reservation spends: draft
+        tokens may be rejected, so the engine never evicts prefix-cache
+        content (guaranteed future savings) to reserve pages for them —
+        only the base token may claim LRU pages, exactly like plain
+        decode."""
+        return len(self._free)
+
+    @property
     def lru_pages(self) -> int:
         """Cached-but-unreferenced pages parked in the LRU: they occupy
         pool HBM purely for prefix reuse (the "pinned" occupancy the
@@ -149,6 +159,88 @@ class BlockAllocator:
                     self._trim_cache()
                 else:
                     self._free.append(p)
+
+    # -- debug leak/invariant audit ------------------------------------------
+    def check_invariants(
+            self, live_pages: Optional[Sequence[Sequence[int]]] = None
+    ) -> None:
+        """Audit the allocator's internal invariants; raise
+        ``AssertionError`` naming the first violation.  Cheap (O(pages))
+        and read-only — tests and ``tools/fleet_drill.py`` run it after
+        KV churn (speculative rollback, migration, preemption) so a
+        leaked page or refcount can never pass silently.
+
+        Structural invariants (always checked):
+
+        * every page is in exactly one of {free list, LRU, referenced};
+        * the free list has no duplicates and only refcount-0 pages;
+        * every LRU page is refcount-0 AND registered;
+        * ``_by_key``/``_key_of`` are a bijection over registered pages;
+        * ``cache_cap`` (when set) bounds the LRU.
+
+        ``live_pages`` — one page list per live owner (e.g. every
+        slotted sequence's ``seq.pages``) — additionally audits the
+        refcounts *exactly*: each page's refcount must equal its total
+        occurrence count across owners.  A surplus refcount is a leak
+        (freed sequence still holding pages); a deficit is a
+        use-after-free in waiting."""
+        # explicit raises (not bare asserts) so ``python -O`` can't
+        # compile the audit out and vacuously pass the leak gates
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            raise AssertionError(
+                f"free list has duplicates: {sorted(self._free)}")
+        if free_set & set(self._lru):
+            raise AssertionError(
+                f"pages in free list AND LRU: {sorted(free_set & set(self._lru))}")
+        for p in self._free:
+            if self._ref[p] != 0:
+                raise AssertionError(
+                    f"page {p} in free list with refcount {self._ref[p]}")
+        for p in self._lru:
+            if self._ref[p] != 0:
+                raise AssertionError(
+                    f"LRU page {p} has refcount {self._ref[p]}")
+            if p not in self._key_of:
+                raise AssertionError(f"LRU page {p} is not registered")
+        referenced = {p for p in range(self.num_pages) if self._ref[p] > 0}
+        if referenced & free_set:
+            raise AssertionError(
+                f"referenced pages in free list: {sorted(referenced & free_set)}")
+        covered = len(free_set) + len(self._lru) + len(referenced)
+        if covered != self.num_pages:
+            raise AssertionError(
+                f"page partition broken: {len(free_set)} free + "
+                f"{len(self._lru)} LRU + {len(referenced)} referenced "
+                f"!= {self.num_pages} pages (a refcount-0 page outside "
+                "free/LRU is a leaked page)")
+        if len(self._by_key) != len(self._key_of):
+            raise AssertionError("registry maps disagree in size")
+        for key, p in self._by_key.items():
+            if self._key_of.get(p) != key:
+                raise AssertionError(f"registry not a bijection at page {p}")
+        if self.cache_cap > 0 and len(self._lru) > self.cache_cap:
+            raise AssertionError(
+                f"LRU {len(self._lru)} exceeds cache_cap {self.cache_cap}")
+        if live_pages is not None:
+            want: Dict[int, int] = {}
+            for owner in live_pages:
+                for p in owner:
+                    want[p] = want.get(p, 0) + 1
+            for p in range(self.num_pages):
+                w = want.get(p, 0)
+                if self._ref[p] != w:
+                    raise AssertionError(
+                        f"page {p}: refcount {self._ref[p]} != {w} live "
+                        f"reference(s) — "
+                        f"{'leak' if self._ref[p] > w else 'use-after-free'}")
+
+    def assert_no_leaks(
+            self, live_pages: Sequence[Sequence[int]] = ()) -> None:
+        """``check_invariants`` with an exact refcount audit against the
+        given live owners (default: none live, so every page must be
+        free or LRU-parked).  The speculative-rollback / KV-churn gate."""
+        self.check_invariants(list(live_pages))
 
     def adopt(self, keys: Sequence[Optional[Any]]
               ) -> Tuple[List[int], List[bool]]:

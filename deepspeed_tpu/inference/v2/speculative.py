@@ -1,0 +1,224 @@
+"""Speculative decoding: proposers + the greedy accept rule.
+
+Reference parity: the multi-token-per-step decode path of the reference
+inference stack (speculative acceptance over a draft, as in FastGen's
+roadmap and the DeepSpeed-MII speculative decoding mode).  The engine
+(engine_v2.py) drives the loop: a *proposer* guesses up to ``k``
+continuation tokens from host state, one batched **verify** program
+(model_runner.paged_verify) scores all of them in a single model
+invocation, and the longest prefix that matches the model's own greedy
+choices is accepted — plus the model's correction token at the first
+mismatch, so every verify call emits at least one token and the engine
+never does worse than plain decode per invocation.
+
+The contract is **lossless**: greedy speculative decoding is
+bit-identical to the non-speculative baseline (the accepted tokens are
+exactly the tokens greedy decode would have produced, because each is
+checked against the model's own argmax given the same KV state).
+Non-greedy sampling is NOT speculated — the engine falls back to the
+plain decode program for those sequences (see the sampling guard in
+engine_v2) rather than silently changing the output distribution.
+
+Proposers are pluggable: anything with ``propose(tokens, k) -> list``
+works.  Two built-ins:
+
+* :class:`NgramProposer` — self-speculative prompt-lookup (no extra
+  weights): the trailing n-gram of the sequence is searched in its own
+  history (prompt + generated), and the tokens that followed an
+  earlier occurrence are proposed.  Strong on summarization /
+  extraction / code-edit traffic where outputs copy their inputs, free
+  everywhere else.
+* :class:`DraftModelProposer` — a small draft model proposes greedily.
+  The draft runs a bucket-padded dense forward per proposed token (no
+  separate KV pool to keep coherent with the target's paged state), so
+  it is a *reference* implementation sized for tiny drafts; the
+  interface is what matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...runtime.config_utils import ConfigModel
+
+SPEC_MODES = ("off", "ngram", "draft")
+
+
+@dataclasses.dataclass
+class SpeculativeConfig(ConfigModel):
+    """``speculative`` config block (RaggedInferenceConfig.speculative,
+    also accepted fleet-wide under ``serving.speculative``).
+
+    ``k`` is the max draft tokens per verify call: the verify program is
+    compiled for a fixed width of ``k + 1`` tokens (last accepted token
+    + drafts), so one shape serves every acceptance outcome."""
+
+    mode: str = "off"
+    #: max draft tokens proposed per step (verify width = k + 1)
+    k: int = 4
+    #: n-gram proposer: shortest/longest trailing n-gram searched in the
+    #: sequence's own history (longest match wins)
+    ngram_min: int = 1
+    ngram_max: int = 3
+    #: draft-model proposer: models/llama size ref (e.g. "tiny").  Real
+    #: deployments pass a DraftModelProposer with loaded weights to the
+    #: engine instead; a size ref alone gets seed-initialized weights —
+    #: functional (the accept rule keeps it lossless) but low-acceptance.
+    draft_model: str = ""
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def validate(self) -> None:
+        if self.mode not in SPEC_MODES:
+            raise ValueError(f"speculative.mode {self.mode!r} not in "
+                             f"{SPEC_MODES}")
+        if self.k < 1:
+            raise ValueError("speculative.k must be >= 1")
+        if not (1 <= self.ngram_min <= self.ngram_max):
+            raise ValueError("need 1 <= speculative.ngram_min <= ngram_max")
+        if self.mode == "draft" and not self.draft_model:
+            raise ValueError("speculative.mode='draft' needs "
+                             "speculative.draft_model")
+
+
+class NgramProposer:
+    """Self-speculative prompt-lookup: propose the continuation of an
+    earlier occurrence of the sequence's trailing n-gram.
+
+    Host-only and O(n * ngram) per call over a Python token list —
+    it runs between device steps, off the hot path, like the rest of
+    the v2 scheduler.  Longest n-gram wins (tried ``ngram_max`` down to
+    ``ngram_min``); among same-length matches, the most recent
+    occurrence whose continuation can FILL ``k`` wins (in a loop the
+    nearest occurrence sits one period from the tail with its
+    continuation clipped by end-of-history; one period further back the
+    same cycle supplies all ``k``), falling back to the longest clipped
+    continuation, most recent first."""
+
+    def __init__(self, ngram_min: int = 1, ngram_max: int = 3):
+        if not (1 <= ngram_min <= ngram_max):
+            raise ValueError("need 1 <= ngram_min <= ngram_max")
+        self.ngram_min = ngram_min
+        self.ngram_max = ngram_max
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        n_tok = len(tokens)
+        if k < 1 or n_tok < self.ngram_min + 1:
+            return []
+        # the whole-history scan is vectorized (one windowed compare per
+        # n-gram length) so a long context costs microseconds, not a
+        # per-position Python loop between device steps
+        arr = np.asarray(tokens, dtype=np.int64)
+        for n in range(min(self.ngram_max, n_tok - 1), self.ngram_min - 1, -1):
+            tail = arr[n_tok - n:]
+            # candidate start positions 0..n_tok-n-1 (the tail itself
+            # excluded); a match at i proposes tokens[i+n : i+n+k].
+            # The most recent match whose continuation can FILL k wins —
+            # in a generation loop the nearest occurrence sits one
+            # period from the tail with its continuation clipped by the
+            # end of history, while one more period back the same cycle
+            # supplies all k tokens; fall back to the longest clipped
+            # continuation (most recent first) otherwise
+            wins = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+            hits = np.nonzero((wins == tail).all(axis=1))[0]
+            best: List[int] = []
+            for i in hits[::-1]:
+                cont = arr[i + n:i + n + k]
+                if len(cont) == k:
+                    return [int(t) for t in cont]
+                if len(cont) > len(best):
+                    best = [int(t) for t in cont]
+            if best:
+                return best
+        return []
+
+
+class DraftModelProposer:
+    """Greedy proposals from a small draft model (models/* spec).
+
+    Each proposed token is one bucket-padded dense forward of the draft
+    over the full history — padding to power-of-two buckets keeps the
+    compile set bounded.  No draft KV cache: the draft's state never has
+    to be kept coherent with the target's paged pool across accept/
+    rollback, at the cost of recompute that only a *tiny* draft can
+    afford (which is the only draft worth running on-host anyway)."""
+
+    def __init__(self, model: Any, params: Any = None, seed: int = 0,
+                 min_bucket: int = 32):
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = model.config
+        self.params = (params if params is not None
+                       else model.init_params(jax.random.PRNGKey(seed)))
+        self.min_bucket = min_bucket
+
+        from ...models.transformer import logits_fn, transformer_forward
+
+        cfg = self.cfg
+
+        def _greedy_next(params, ids, length):
+            h, _aux = transformer_forward(cfg, params, ids[None])
+            logits = logits_fn(cfg, params, h[:, length - 1][:, None])
+            return jnp.argmax(logits.astype(jnp.float32), axis=-1)[0, 0]
+
+        self._next = jax.jit(_greedy_next)
+
+    def _bucket(self, n: int) -> int:
+        b = self.min_bucket
+        while b < n:
+            b *= 2
+        return min(b, self.cfg.max_seq_len)
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        import numpy as np
+
+        hist = [int(t) for t in tokens]
+        out: List[int] = []
+        for _ in range(k):
+            if len(hist) >= self.cfg.max_seq_len:
+                break
+            ids = np.zeros((self._bucket(len(hist)),), np.int32)
+            ids[:len(hist)] = hist
+            tok = int(self._next(self.params, ids, len(hist)))
+            out.append(tok)
+            hist.append(tok)
+        return out
+
+
+def build_proposer(spec: SpeculativeConfig) -> Optional[Any]:
+    """Proposer for a config block (None when mode is off).  The engine
+    calls this once at construction; callers wanting real draft weights
+    pass ``proposer=DraftModelProposer(model, params)`` instead."""
+    if not spec.enabled:
+        return None
+    if spec.mode == "ngram":
+        return NgramProposer(spec.ngram_min, spec.ngram_max)
+    from ...models.llama import llama_model
+
+    return DraftModelProposer(llama_model(spec.draft_model))
+
+
+def longest_accepted(draft: Sequence[int], verified: Sequence[int]
+                     ) -> Tuple[List[int], int]:
+    """Greedy accept rule: ``verified[w]`` is the model's argmax after
+    consuming the last accepted token followed by ``draft[:w]``.  The
+    longest prefix of ``draft`` matching ``verified`` position-by-
+    position is accepted, and ``verified[m]`` — the model's own choice
+    at the first mismatch (or past a fully-accepted draft) — is the
+    bonus token.  Returns ``(accepted_tokens, bonus_token)``; the step
+    emits ``accepted + [bonus]``, which is exactly the token stream
+    plain greedy decode would have produced."""
+    m = 0
+    while m < len(draft) and int(draft[m]) == int(verified[m]):
+        m += 1
+    return [int(t) for t in draft[:m]], int(verified[m])
+
+
+__all__ = ["SpeculativeConfig", "NgramProposer", "DraftModelProposer",
+           "build_proposer", "longest_accepted", "SPEC_MODES"]

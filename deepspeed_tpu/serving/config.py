@@ -11,7 +11,9 @@ ABOVE the engines: pool sizes, routing policy, and failure handling.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
+from ..inference.v2.speculative import SpeculativeConfig
 from ..runtime.config_utils import ConfigModel
 
 
@@ -44,8 +46,21 @@ class ServingConfig(ConfigModel):
     prefill_chunk: int = 0
     #: step budget for ``InferenceEngineV2.drain`` during retirement
     drain_max_steps: int = 10_000
+    #: fleet-wide speculative decoding (inference/v2/speculative.py):
+    #: applied by ``build_fleet`` to EVERY replica's engine config
+    #: (speculation only touches the decode phase and is lossless for
+    #: greedy streams, so uniform application keeps migration /
+    #: re-dispatch bit-identity trivially).  None = inherit whatever the
+    #: base engine config says
+    speculative: Optional[SpeculativeConfig] = None
 
     def validate(self) -> None:
+        if isinstance(self.speculative, dict):
+            # Optional[...] coercion swallows nested validation errors
+            # (the Union branch treats them as "try the next type"); an
+            # invalid speculative block must fail HERE, not at engine
+            # construction
+            self.speculative = SpeculativeConfig.from_dict(self.speculative)
         if self.prefill_replicas < 0 or self.decode_replicas < 0:
             raise ValueError("serving replica counts must be >= 0")
         if self.prefill_replicas + self.decode_replicas < 1:

@@ -472,6 +472,12 @@ def build_fleet(model: Any, serving: Optional[ServingConfig] = None,
 
     serving = serving or ServingConfig()
     base = engine_config or RaggedInferenceConfig()
+    if serving.speculative is not None:
+        # fleet-wide speculative block overrides the engine config on
+        # every replica: speculation is decode-phase-only and lossless
+        # for greedy streams, so uniform application preserves the
+        # migration / re-dispatch bit-identity contract as-is
+        base = _dc.replace(base, speculative=serving.speculative)
     if params is None:
         params = model.init_params(jax.random.PRNGKey(seed))
     replicas: List[EngineReplica] = []

@@ -192,6 +192,22 @@ def run_demo(out: str, n_requests: int, new_tokens: int) -> int:
            got2 == want2,
            f"{sum(g == w for g, w in zip(got2, want2))}/{len(want2)} match")
 
+    # ---- allocator integrity: after two legs of KV churn (migration,
+    # re-dispatch, evacuation) no surviving replica may hold a leaked
+    # page or refcount — the BlockAllocator debug audit is exact
+    leak_errs = []
+    for name, rep in fleet.replicas.items():
+        if not rep.alive:
+            continue  # a hard-killed replica's state is gone by design
+        try:
+            rep.engine.assert_no_leaks()
+        except AssertionError as e:
+            leak_errs.append(f"{name}: {e}")
+    _check(checks, "allocator_no_leaks_after_churn", not leak_errs,
+           leak_errs[:2] if leak_errs else
+           f"{sum(1 for r in fleet.replicas.values() if r.alive)} "
+           "replicas audited")
+
     # ---- metric-name lint over the tree (fleet family included)
     import check_metric_names as lint
 
