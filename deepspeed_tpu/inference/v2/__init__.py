@@ -13,8 +13,9 @@ device steps — same split as the reference's C++ atom-builder vs CUDA
 kernels.
 """
 
-from .ragged import (BlockAllocator, KVBlockConfig, KVPageBundle,  # noqa: F401
-                     PagedKVCache, PrefixCache)
+from .ragged import (PRIORITY_BATCH, PRIORITY_INTERACTIVE,  # noqa: F401
+                     PRIORITY_NORMAL, BlockAllocator, KVBlockConfig,
+                     KVPageBundle, PagedKVCache, PrefixCache, RejectedError)
 from .engine_v2 import InferenceEngineV2, RaggedInferenceConfig, RaggedRequest  # noqa: F401
 from .speculative import (DraftModelProposer, NgramProposer,  # noqa: F401
                           SpeculativeConfig)

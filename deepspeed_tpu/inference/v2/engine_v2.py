@@ -40,8 +40,9 @@ from ...utils.logging import logger
 from .model_runner import (paged_copy_page, paged_decode, paged_gather_pages,
                            paged_prefill, paged_prefill_chunk,
                            paged_scatter_pages, paged_verify)
-from .ragged import (BlockAllocator, KVBlockConfig, KVPageBundle, PagedKVCache,
-                     PrefixCache, SequenceState)
+from .ragged import (PRIORITY_NORMAL, BlockAllocator, KVBlockConfig,
+                     KVPageBundle, PagedKVCache, PrefixCache, RejectedError,
+                     SequenceState)
 from .speculative import (SpeculativeConfig, build_proposer, longest_accepted)
 
 
@@ -102,6 +103,18 @@ class RaggedInferenceConfig(ConfigModel):
     #: (sampling guard) so the output distribution is never touched
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
+    #: bounded request queue (admission control): once this many
+    #: requests wait for admission, ``put()`` raises
+    #: :class:`RejectedError` (load shedding — the submitter backs off
+    #: ``retry_after_s`` instead of growing the queue without bound).
+    #: <= 0 = unbounded (the pre-SLO behavior)
+    max_queue_depth: int = 0
+    #: latency SLOs (seconds; <= 0 = untracked): TTFT / TPOT observations
+    #: past these thresholds count the
+    #: ``deepspeed_tpu_serving_slo_{ttft,tpot}_violations_total``
+    #: counters and emit an ``slo_violation`` trace event
+    slo_ttft_s: float = 0.0
+    slo_tpot_s: float = 0.0
 
     @property
     def jnp_dtype(self):
@@ -122,6 +135,15 @@ class RaggedRequest:
     temperature: float = 0.0  # 0 => greedy
     eos_id: Optional[int] = None
     uid: Optional[int] = None
+    #: priority class (``ragged.PRIORITY_*``, smaller = more urgent):
+    #: orders admission, picks preemption victims under KV pressure
+    #: (lowest class first), and gates load shedding under overload
+    priority: int = PRIORITY_NORMAL
+    #: wall-clock budget in seconds from enqueue (None = no deadline):
+    #: past it the engine expires the request at the next step boundary
+    #: with ``finish_reason="deadline"`` instead of letting it wait (or
+    #: decode) forever
+    deadline_s: Optional[float] = None
 
 
 class InferenceEngineV2:
@@ -209,6 +231,7 @@ class InferenceEngineV2:
         self._init_serving_metrics()
         self._uid = itertools.count()
         self._admit_counter = itertools.count()
+        self._enqueue_counter = itertools.count()
         self._rng = np.random.RandomState(seed)
 
         self._queue: List[SequenceState] = []
@@ -424,6 +447,25 @@ class InferenceEngineV2:
         self._m_spec_verify_h = reg.histogram(
             "deepspeed_tpu_serving_spec_verify_seconds",
             "one batched speculative verify program wall time")
+        # serving-SLO family (docs/OBSERVABILITY.md): deadline expiry,
+        # queue wait, and TTFT/TPOT SLO-violation accounting live on the
+        # engine; the shed + breaker halves of the family live on the
+        # fleet tier (serving/admission.py, serving/router.py)
+        self._m_deadline = reg.counter(
+            "deepspeed_tpu_serving_slo_deadline_exceeded_total",
+            "requests expired past their deadline at a step boundary "
+            '(finish_reason="deadline")')
+        self._m_queue_wait_h = reg.histogram(
+            "deepspeed_tpu_serving_slo_queue_wait_seconds",
+            "enqueue -> admission wait, observed per admission (a "
+            "preempted sequence re-admitting observes again)")
+        self._m_ttft_viol = reg.counter(
+            "deepspeed_tpu_serving_slo_ttft_violations_total",
+            "first tokens arriving later than slo_ttft_s")
+        self._m_tpot_viol = reg.counter(
+            "deepspeed_tpu_serving_slo_tpot_violations_total",
+            "finished requests whose mean inter-token time exceeded "
+            "slo_tpot_s")
         # last-published absolutes for the per-engine cache counters, so
         # the process-cumulative registry counters only receive deltas
         self._cache_pub = {"hits": 0, "misses": 0, "evictions": 0}
@@ -445,9 +487,21 @@ class InferenceEngineV2:
         now = time.perf_counter()
         if m["t_first"] is None:
             m["t_first"] = now
-            self._m_ttft_h.observe(now - m["t0"])
+            ttft = now - m["t0"]
+            self._m_ttft_h.observe(ttft)
+            if 0 < self.config.slo_ttft_s < ttft:
+                self._m_ttft_viol.inc()
+                self._slo_violation("ttft", ttft, self.config.slo_ttft_s,
+                                    seq.uid)
         m["t_last"] = now
         m["n"] += n
+
+    def _slo_violation(self, kind: str, value: float, limit: float,
+                       uid: int) -> None:
+        """One call site for the ``slo_violation`` event (TTFT and TPOT
+        both thread through here — the name lint wants a single owner)."""
+        record_event("slo_violation", cat="serve", kind=kind,
+                     value=round(value, 6), limit=limit, uid=uid)
 
     def _finish_request(self, seq: SequenceState) -> None:
         """Close the request span and observe TPOT (mean inter-token
@@ -456,8 +510,12 @@ class InferenceEngineV2:
         if m is None:
             return
         if m["n"] > 1 and m["t_first"] is not None:
-            self._m_tpot_h.observe(
-                (m["t_last"] - m["t_first"]) / (m["n"] - 1))
+            tpot = (m["t_last"] - m["t_first"]) / (m["n"] - 1)
+            self._m_tpot_h.observe(tpot)
+            if 0 < self.config.slo_tpot_s < tpot:
+                self._m_tpot_viol.inc()
+                self._slo_violation("tpot", tpot, self.config.slo_tpot_s,
+                                    seq.uid)
         end_span(m["span"], generated=m["n"],
                  total_s=round(time.perf_counter() - m["t0"], 6))
 
@@ -496,8 +554,14 @@ class InferenceEngineV2:
         self._m_cached_pages.set(self.allocator.cached_pages)
 
     # -- request API ---------------------------------------------------------
-    def put(self, request: RaggedRequest) -> int:
-        """Queue a request; returns its uid."""
+    def put(self, request: RaggedRequest, *, record_shed: bool = True
+            ) -> int:
+        """Queue a request; returns its uid.
+
+        ``record_shed=False`` hands shed accounting to the caller: a
+        multi-candidate placer (the fleet router) tries several engines
+        and must count at most ONE shed per request, not one per
+        refusing engine."""
         if self._draining:
             raise RuntimeError("engine is draining/retired: no new "
                                "admissions (route to another replica)")
@@ -508,15 +572,35 @@ class InferenceEngineV2:
         if n >= self.max_seq_len:
             raise ValueError(f"prompt length {n} >= max_seq_len "
                              f"{self.max_seq_len}")
+        if (self.config.max_queue_depth > 0
+                and len(self._queue) >= self.config.max_queue_depth):
+            # bounded queue: shed LOUDLY instead of growing the queue
+            # into an OOM/preemption storm.  Deferred import: admission
+            # (serving tier) owns the shed counter; serving imports
+            # inference, never the reverse at module scope.
+            from ...serving.admission import (record_shed as _record_shed,
+                                              retry_after_hint)
+
+            hint = retry_after_hint(len(self._queue))
+            if record_shed:
+                _record_shed(request.priority, "engine_queue_full", hint)
+            raise RejectedError("engine_queue_full", retry_after_s=hint,
+                                priority=request.priority)
+        now = time.perf_counter()
         self._queue.append(SequenceState(
             uid=uid, tokens=list(request.prompt_ids), prompt_len=n,
             max_new_tokens=request.max_new_tokens,
-            temperature=request.temperature, eos_id=request.eos_id))
+            temperature=request.temperature, eos_id=request.eos_id,
+            priority=int(request.priority),
+            deadline=(now + max(0.0, float(request.deadline_s))
+                      if request.deadline_s is not None else 0.0),
+            enqueue_order=next(self._enqueue_counter),
+            queued_at=now))
         self._req_meta[uid] = {
-            "t0": time.perf_counter(), "t_first": None, "t_last": None,
+            "t0": now, "t_first": None, "t_last": None,
             "n": 0,
             "span": begin_span("request", cat="serve", uid=uid,
-                               prompt_tokens=n,
+                               prompt_tokens=n, priority=request.priority,
                                max_new_tokens=request.max_new_tokens)}
         self._m_requests.inc()
         self._m_queue.set(len(self._queue))
@@ -573,6 +657,7 @@ class InferenceEngineV2:
             max_new_tokens=seq.max_new_tokens, temperature=seq.temperature,
             eos_id=seq.eos_id, prefilled=seq.prefilled,
             decode_entry=seq.decode_entry, page_size=ps, page_keys=keys,
+            priority=seq.priority, deadline=seq.deadline,
             src_pages=self.allocator.export_meta(seq.pages),
             arrays=paged_gather_pages(self._pools, seq.pages),
             model_sig=(self.cfg.n_layers, self.cfg.kv_heads,
@@ -665,7 +750,9 @@ class InferenceEngineV2:
             temperature=bundle.temperature, eos_id=bundle.eos_id,
             slot=slot, pages=pages, prefilled=bundle.prefilled,
             decode_entry=bundle.decode_entry, page_keys=keys,
-            registered_upto=len(keys))
+            registered_upto=len(keys),
+            priority=bundle.priority, deadline=bundle.deadline,
+            enqueue_order=next(self._enqueue_counter))
         seq.admit_order = next(self._admit_counter)
         self._slots[slot] = seq
         self._page_table[slot, :] = self.block.trash_page
@@ -784,6 +871,7 @@ class InferenceEngineV2:
         seq.slot, seq.pages, seq.prefilled = -1, [], 0
         seq.page_keys, seq.registered_upto, seq.decode_entry = [], 0, False
         seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
+        seq.queued_at = time.perf_counter()
         self._queue.insert(0, seq)
         self._m_preemptions.inc()
         occ = self._pool_occupancy()
@@ -806,7 +894,12 @@ class InferenceEngineV2:
                 break
             if slot is not None:
                 continue
-            seq = self._queue[0]
+            # admission head: highest priority class first, FCFS within
+            # a class (enqueue_order; preempted sequences keep their
+            # original stamp, so they re-admit at the front of their
+            # class — the old insert-at-head behavior, now per class)
+            seq = min(self._queue,
+                      key=lambda s: (s.priority, s.enqueue_order))
             shared: List[int] = []
             keys: List[Any] = []
             if self.prefix_cache is not None:
@@ -835,15 +928,43 @@ class InferenceEngineV2:
             # pages at refcount 0 are counted in free_pages but will be
             # claimed by share(), not alloc() — exclude them so a blocked
             # head of queue doesn't churn pages through the LRU each step
-            lru_matched = sum(1 for p in shared
-                              if self.allocator.refcount(p) == 0)
-            if need_new > self.allocator.free_pages - lru_matched:
+            def _fits() -> bool:
+                lru_matched = sum(1 for p in shared
+                                  if self.allocator.refcount(p) == 0)
+                return need_new <= self.allocator.free_pages - lru_matched
+
+            while not _fits():
+                # priority admission: under pool pressure a high class
+                # preempts strictly-lower-class running sequences
+                # (lowest class, then youngest — cheapest prefix to
+                # recompute) instead of waiting behind them.  _fits()
+                # recomputes per eviction: a victim dropping its ref on
+                # a matched page moves that page into the LRU-matched
+                # set, not the allocatable one.
+                victims = [s for s in self._slots
+                           if s is not None and s.priority > seq.priority]
+                if not victims:
+                    break
+                # futility guard: if even reclaiming EVERY victim's
+                # pages cannot cover the head (optimistic upper bound —
+                # shared pages may free less), evict nobody: a
+                # mass-recompute that still fails to admit is the worst
+                # outcome under exactly the pressure this path serves
+                if need_new > (self.allocator.free_pages
+                               + sum(len(v.pages) for v in victims)):
+                    break
+                self._preempt(max(victims,
+                                  key=lambda s: (s.priority, s.admit_order)))
+            if not _fits():
                 break  # head-of-line blocking, like the reference's FCFS
             # protect matched pages from LRU eviction before allocating
             for p in shared:
                 self.allocator.share(p)
-            self._queue.pop(0)
+            self._queue.remove(seq)
             seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
+            if seq.queued_at > 0.0:
+                self._m_queue_wait_h.observe(
+                    time.perf_counter() - seq.queued_at)
             fresh = self.allocator.alloc(need_new)
             if full_hit:
                 src, dst = shared[-1], fresh[-1]
@@ -908,6 +1029,7 @@ class InferenceEngineV2:
         self._maybe_finish(seq, tok)
         if seq.done:
             out[seq.uid]["done"] = True
+            out[seq.uid]["finish_reason"] = seq.finish_reason
 
     @staticmethod
     def _ready_to_decode(seq: SequenceState) -> bool:
@@ -937,16 +1059,63 @@ class InferenceEngineV2:
         self._spec_fallback_uids.discard(seq.uid)
         self._finish_request(seq)
 
+    # -- deadlines -----------------------------------------------------------
+    def _expire(self, seq: SequenceState,
+                out: Dict[int, Dict[str, Any]]) -> None:
+        """Retire one past-deadline sequence (queued or admitted) with
+        ``finish_reason="deadline"``: its pages free immediately, the
+        request span closes, and the expiry is a *finished* step-output
+        record — the stream ends loudly, it does not hang."""
+        seq.finish_reason = "deadline"
+        self._m_deadline.inc()
+        record_event("deadline_expired", cat="serve", uid=seq.uid,
+                     generated=seq.generated, priority=seq.priority)
+        if seq.slot >= 0:
+            self._retire(seq)  # single owner of the slotted teardown
+        else:
+            self.allocator.free(seq.pages)  # queued: normally none
+            seq.pages, seq.done = [], True
+            self._spec_fallback_uids.discard(seq.uid)
+            self._finish_request(seq)
+        out[seq.uid] = {"tokens": [], "done": True,
+                        "finish_reason": "deadline"}
+
+    def _expire_deadlines(self, out: Dict[int, Dict[str, Any]]) -> None:
+        """Step-boundary deadline sweep over the queue AND the decode
+        slots: a request whose ``deadline_s`` budget ran out stops
+        consuming pool pages and decode slots NOW — under overload the
+        pool drains toward work that can still meet its SLO."""
+        now = time.perf_counter()
+        for seq in [s for s in self._queue
+                    if s.deadline and now >= s.deadline]:
+            self._queue.remove(seq)
+            self._expire(seq, out)
+        for seq in list(self._slots):
+            if seq is not None and seq.deadline and now >= seq.deadline:
+                self._expire(seq, out)
+
+    def _finish_reason_for(self, seq: SequenceState, token: int) -> str:
+        """THE finish predicate ("" = keep running) — also stops
+        mid-round emission in ``_spec_step`` via ``_should_finish``, so
+        any new condition added here automatically drops accepted draft
+        tokens past the boundary too.  Deadline expiry is NOT here: it
+        happens at the step boundary (``_expire_deadlines``), never
+        mid-emission."""
+        if seq.generated >= seq.max_new_tokens:
+            return "length"
+        if seq.eos_id is not None and token == seq.eos_id:
+            return "eos"
+        if seq.length >= self.max_seq_len:
+            return "max_seq_len"
+        return ""
+
     def _should_finish(self, seq: SequenceState, token: int) -> bool:
-        """THE finish predicate — also stops mid-round emission in
-        ``_spec_step``, so any new condition added here automatically
-        drops accepted draft tokens past the boundary too."""
-        return (seq.generated >= seq.max_new_tokens
-                or (seq.eos_id is not None and token == seq.eos_id)
-                or seq.length >= self.max_seq_len)
+        return bool(self._finish_reason_for(seq, token))
 
     def _maybe_finish(self, seq: SequenceState, token: int) -> None:
-        if self._should_finish(seq, token):
+        reason = self._finish_reason_for(seq, token)
+        if reason:
+            seq.finish_reason = reason
             self._retire(seq)
 
     def _run_prefill_chunk(self, seq: SequenceState, start: int, c_n: int,
@@ -985,7 +1154,11 @@ class InferenceEngineV2:
     def step(self) -> Dict[int, Dict[str, Any]]:
         """Admit + prefill new sequences, decode one token for running ones.
 
-        Returns {uid: {"tokens": [newly generated], "done": bool}}.
+        Returns {uid: {"tokens": [newly generated], "done": bool}};
+        finished records also carry ``"finish_reason"``
+        ("length"/"eos"/"max_seq_len"/"deadline").  Past-deadline
+        requests (queued or running) expire FIRST, at the step boundary,
+        before admission.
 
         A step that raises dumps the flight recorder (when one is
         installed) before propagating; a step that compiled is reported
@@ -1006,6 +1179,7 @@ class InferenceEngineV2:
         out: Dict[int, Dict[str, Any]] = {}
         ps = self.block.page_size
 
+        self._expire_deadlines(out)
         admitted = self._admit()
         self._m_queue.set(len(self._queue))
         self._m_occupancy.set(
@@ -1082,10 +1256,18 @@ class InferenceEngineV2:
                 while self.allocator.free_pages < 1:
                     victims = [s for s in self._slots
                                if s is not None and s is not seq]
-                    # evict the most recently admitted sequence: it has the
-                    # cheapest prefix to recompute
-                    victim = (max(victims, key=lambda s: s.admit_order)
+                    # evict the lowest priority class first, then the
+                    # most recently admitted (cheapest prefix to
+                    # recompute) — interactive work decodes through
+                    # pool pressure at batch work's expense.  Never
+                    # upward: when every other slotted sequence is MORE
+                    # urgent than the requester, the requester preempts
+                    # ITSELF (mirrors the admission-side victim rule)
+                    victim = (max(victims,
+                                  key=lambda s: (s.priority, s.admit_order))
                               if victims else seq)
+                    if victim is not seq and victim.priority < seq.priority:
+                        victim = seq
                     self._preempt(victim)
                     if victim is seq:
                         break
@@ -1167,6 +1349,8 @@ class InferenceEngineV2:
                 rec["tokens"].append(tok)
                 self._maybe_finish(seq, tok)
                 rec["done"] = seq.done
+                if seq.done:
+                    rec["finish_reason"] = seq.finish_reason
         self._sync_cache_counters()
         return out
 
@@ -1284,6 +1468,8 @@ class InferenceEngineV2:
             self._register_pages(seq)
             self._maybe_finish(seq, seq.tokens[-1])
             rec["done"] = seq.done
+            if seq.done:
+                rec["finish_reason"] = seq.finish_reason
             if not seq.done:
                 # rollback: pages reserved for rejected draft tokens are
                 # released; rejected KV inside kept pages is overwritten

@@ -23,6 +23,34 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
+#: request priority classes (smaller = more urgent).  Priorities order
+#: admission (the scheduler admits the highest class first), choose
+#: preemption victims under KV-pool pressure (lowest class, then
+#: youngest), and gate load shedding (``serving/admission.py`` sheds
+#: only classes above the protected threshold under overload).
+PRIORITY_INTERACTIVE = 0
+PRIORITY_NORMAL = 1
+PRIORITY_BATCH = 2
+
+
+class RejectedError(RuntimeError):
+    """A request refused by admission control (load shedding).
+
+    Not a bug and not data loss: the submitter still holds the request
+    and should back off ``retry_after_s`` seconds before resubmitting.
+    Raised by ``InferenceEngineV2.put`` (bounded queue,
+    ``max_queue_depth``) and by the fleet router's admission controller
+    (queue bound / KV-pool occupancy shed threshold) — loudly, instead
+    of queuing work into an OOM/preemption storm."""
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0,
+                 priority: Optional[int] = None):
+        super().__init__(
+            f"request rejected ({reason}); retry after {retry_after_s:.2f}s")
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        self.priority = priority
+
 
 @dataclasses.dataclass
 class KVBlockConfig:
@@ -455,6 +483,12 @@ class KVPageBundle:
     model_sig: Tuple[int, int, int]
     kv_quant: bool
     dtype: str
+    #: SLO identity travels with the sequence: priority class and the
+    #: absolute in-process deadline (``time.perf_counter`` clock, 0 =
+    #: none).  The wire format re-bases the deadline as seconds-left so
+    #: it survives a clock-domain change across processes.
+    priority: int = PRIORITY_NORMAL
+    deadline: float = 0.0
 
     @property
     def n_pages(self) -> int:
@@ -499,6 +533,22 @@ class SequenceState:
     cached_match: Any = None
     match_gen: int = -1
     match_evict_gen: int = -1
+    #: priority class (PRIORITY_*): orders admission, picks preemption
+    #: victims (lowest class evicted first), and gates load shedding
+    priority: int = PRIORITY_NORMAL
+    #: absolute expiry on the ``time.perf_counter`` clock (0 = none);
+    #: past it the engine retires the sequence with
+    #: ``finish_reason="deadline"`` at the next step boundary
+    deadline: float = 0.0
+    #: monotonic enqueue stamp: FCFS order within a priority class
+    enqueue_order: int = -1
+    #: perf_counter stamp of the LAST (re-)enqueue — queue-wait
+    #: observations measure from here, so a preempted sequence's time
+    #: spent RUNNING before eviction never counts as queueing
+    queued_at: float = 0.0
+    #: why the sequence finished: "length" (max_new_tokens), "eos",
+    #: "max_seq_len", "deadline"; "" while running
+    finish_reason: str = ""
 
     @property
     def length(self) -> int:

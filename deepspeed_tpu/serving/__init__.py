@@ -18,8 +18,12 @@ _LAZY = {
     "pick_replica": "router",
     "EngineReplica": "replica", "ROLE_PREFILL": "replica",
     "ROLE_DECODE": "replica", "ROLE_MIXED": "replica",
+    "BREAKER_CLOSED": "replica", "BREAKER_OPEN": "replica",
+    "BREAKER_HALF_OPEN": "replica",
     "migrate_sequence": "kv_transfer", "bundle_to_bytes": "kv_transfer",
-    "bundle_from_bytes": "kv_transfer",
+    "bundle_from_bytes": "kv_transfer", "CorruptBundleError": "kv_transfer",
+    "AdmissionController": "admission", "RejectedError": "admission",
+    "retry_after_hint": "admission", "estimate_pages": "admission",
 }
 
 __all__ = ["ServingConfig"] + sorted(_LAZY)

@@ -54,6 +54,43 @@ class ServingConfig(ConfigModel):
     #: base engine config says
     speculative: Optional[SpeculativeConfig] = None
 
+    # -- admission control & load shedding (serving/admission.py) -----------
+    #: fleet-wide bounded queue: submissions are shed (RejectedError
+    #: with a retry-after hint) once this many requests wait for
+    #: admission across accepting replicas; 0 = unbounded
+    max_queue_depth: int = 0
+    #: KV-pool shed threshold: shed when even the coolest accepting
+    #: replica's projected occupancy (current used pages + the request's
+    #: estimated page cost) exceeds this fraction; 0.0 = off
+    shed_occupancy: float = 0.0
+    #: priority classes <= this value are NEVER shed by the rules above
+    #: (they fail only when no live replica exists).  Default 0 protects
+    #: exactly PRIORITY_INTERACTIVE.
+    protect_priority: int = 0
+
+    # -- replica circuit breakers (serving/replica.py state machine) --------
+    #: detect gray failure: a replica whose rolling MEDIAN step latency
+    #: (sustained — compile/GC spikes lift only the tail and never
+    #: trip) exceeds ``breaker_latency_factor`` x the fleet median of
+    #: the OTHER replicas, or which throws ``breaker_consec_errors``
+    #: step exceptions in a row, trips open: drained of new placement,
+    #: its in-flight streams re-dispatched (bit-identical recompute)
+    breaker_enabled: bool = True
+    breaker_latency_factor: float = 3.0
+    breaker_consec_errors: int = 3
+    #: rolling step-latency window length and the samples required
+    #: before the latency rule may trip (noise floor)
+    breaker_window: int = 32
+    breaker_min_samples: int = 8
+    #: latency floor (seconds): the fleet median is clamped up to this
+    #: before the factor comparison, so microsecond-fast idle fleets
+    #: don't trip on scheduler jitter
+    breaker_min_latency_s: float = 0.005
+    #: router pumps an open breaker waits before probing (half-open),
+    #: and the healthy steps a half-open replica must serve to close
+    breaker_cooldown_pumps: int = 8
+    breaker_probe_steps: int = 4
+
     def validate(self) -> None:
         if isinstance(self.speculative, dict):
             # Optional[...] coercion swallows nested validation errors
@@ -78,6 +115,28 @@ class ServingConfig(ConfigModel):
             raise ValueError("serving.max_redispatch must be >= 0")
         if self.drain_max_steps < 1:
             raise ValueError("serving.drain_max_steps must be >= 1")
+        if self.max_queue_depth < 0:
+            raise ValueError("serving.max_queue_depth must be >= 0")
+        if not 0.0 <= self.shed_occupancy <= 1.0:
+            raise ValueError("serving.shed_occupancy must be in [0, 1] "
+                             "(0 disables the pool-pressure shed rule)")
+        if self.protect_priority < 0:
+            raise ValueError("serving.protect_priority must be >= 0")
+        if self.breaker_latency_factor <= 1.0:
+            raise ValueError("serving.breaker_latency_factor must be > 1")
+        if self.breaker_consec_errors < 1:
+            raise ValueError("serving.breaker_consec_errors must be >= 1")
+        if self.breaker_window < 2 or self.breaker_min_samples < 2:
+            raise ValueError("serving.breaker_window and "
+                             "breaker_min_samples must be >= 2")
+        if self.breaker_min_samples > self.breaker_window:
+            raise ValueError("serving.breaker_min_samples must be <= "
+                             "breaker_window")
+        if self.breaker_min_latency_s < 0:
+            raise ValueError("serving.breaker_min_latency_s must be >= 0")
+        if self.breaker_cooldown_pumps < 1 or self.breaker_probe_steps < 1:
+            raise ValueError("serving.breaker_cooldown_pumps and "
+                             "breaker_probe_steps must be >= 1")
 
 
 __all__ = ["ServingConfig"]

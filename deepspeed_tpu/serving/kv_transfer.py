@@ -13,23 +13,39 @@ cross-host transport, :func:`bundle_to_bytes` / :func:`bundle_from_bytes`
 give a self-describing wire format (json header + raw little-endian
 page arrays) — the same serialization a host-RAM spill of cold pages
 will reuse.  Bit-exactness is the contract end to end: dtypes are
-carried exactly (bf16 via ml_dtypes) and the importing engine refuses
-to cast.
+carried exactly (bf16 via ml_dtypes), the importing engine refuses to
+cast, and (wire v2) every page carries a CRC32 across its slice of
+every leaf — a torn, truncated or bit-flipped bundle is REJECTED with a
+clear :class:`CorruptBundleError` instead of silently seeding garbage
+KV.  A refused import loses nothing: the source engine still holds the
+sequence and its pages.
 """
 
 from __future__ import annotations
 
 import io
 import json
-from typing import Any
+import time
+import zlib
+from typing import Any, Dict, List
 
 import numpy as np
 
 from ..inference.v2.ragged import KVPageBundle
 from ..utils.logging import logger
 
-#: wire-format magic + version: bump on any layout change
-_MAGIC = b"DSTPUKV1"
+#: wire-format magic + version: bump on any layout change.
+#: v2 added per-page CRC32s (``page_crcs`` in the header) and the
+#: request's SLO identity (priority, seconds of deadline budget left).
+_MAGIC = b"DSTPUKV2"
+_OLD_MAGICS = (b"DSTPUKV1",)
+
+
+class CorruptBundleError(ValueError):
+    """A serialized bundle failed integrity checks (bad magic /
+    unsupported version / truncation / per-page CRC mismatch).  The
+    import side refuses it — the exporter still owns the sequence, so
+    the correct reaction is to retry or re-export, never to import."""
 
 
 def migrate_sequence(src_engine: Any, dst_engine: Any, uid: int) -> int:
@@ -58,10 +74,34 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
+def _page_crcs(arrays: Dict[str, np.ndarray],
+               leaves: List[str]) -> List[int]:
+    """CRC32 per page: each page's slice of EVERY leaf (axis 1 is the
+    page axis, ``[L, n_pages, ...]``), chained in sorted-leaf order.
+    One checksum per page — a flipped bit, a torn page, or a shifted
+    byte stream names the exact page it corrupted."""
+    if not leaves:
+        return []
+    n_pages = arrays[leaves[0]].shape[1]
+    crcs = [0] * n_pages
+    for n in leaves:
+        # ONE contiguous page-major copy per leaf (not one slice copy
+        # per page): row j is exactly arrays[n][:, j]'s C-order bytes,
+        # checksummed as a zero-copy memoryview row
+        rows = np.ascontiguousarray(np.moveaxis(arrays[n], 1, 0)) \
+            .view(np.uint8).reshape(n_pages, -1)
+        for j in range(n_pages):
+            crcs[j] = zlib.crc32(rows[j], crcs[j])
+    return [c & 0xFFFFFFFF for c in crcs]
+
+
 def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
     """Serialize a bundle for cross-process transport: magic, a json
-    header (metadata + per-leaf shape/dtype, page keys hex-encoded),
-    then each leaf's raw C-order bytes in header order."""
+    header (metadata + per-leaf shape/dtype + per-page CRC32s, page
+    keys hex-encoded), then each leaf's raw C-order bytes in header
+    order.  The absolute in-process ``deadline`` is re-based to
+    seconds-left (``deadline_left_s``) — perf_counter clocks don't
+    survive a process boundary."""
     leaves = sorted(bundle.arrays)
     header = {
         "uid": bundle.uid, "tokens": list(map(int, bundle.tokens)),
@@ -70,6 +110,13 @@ def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
         "temperature": bundle.temperature, "eos_id": bundle.eos_id,
         "prefilled": bundle.prefilled, "decode_entry": bundle.decode_entry,
         "page_size": bundle.page_size,
+        "priority": bundle.priority,
+        "deadline_left_s": (max(0.0, bundle.deadline - time.perf_counter())
+                            if bundle.deadline else None),
+        # wall-clock send stamp: transit time must CONSUME the deadline
+        # budget (best-effort across hosts — skew-negative elapsed is
+        # clamped to 0, never granting budget back)
+        "sent_unix": time.time(),
         "page_keys": [k.hex() if isinstance(k, bytes) else k
                       for k in bundle.page_keys],
         "src_pages": [{"page": m["page"], "refcount": m["refcount"],
@@ -81,6 +128,7 @@ def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
         "leaves": [{"name": n, "shape": list(bundle.arrays[n].shape),
                     "dtype": _dtype_name(bundle.arrays[n])}
                    for n in leaves],
+        "page_crcs": _page_crcs(bundle.arrays, leaves),
     }
     buf = io.BytesIO()
     hdr = json.dumps(header).encode()
@@ -93,24 +141,64 @@ def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
 
 
 def bundle_from_bytes(data: bytes) -> KVPageBundle:
-    """Inverse of :func:`bundle_to_bytes` (bit-identical arrays)."""
+    """Inverse of :func:`bundle_to_bytes` (bit-identical arrays).
+
+    Integrity is verified BEFORE anything is adopted: bad magic, an
+    old/unknown wire version, a truncated payload, or a per-page CRC32
+    mismatch raises :class:`CorruptBundleError` — a refused import
+    loses nothing (the exporting engine still holds the pages)."""
+    if data[:len(_MAGIC)] in _OLD_MAGICS:
+        raise CorruptBundleError(
+            f"serialized KVPageBundle uses retired wire version "
+            f"{data[:len(_MAGIC)]!r} (no per-page checksums); current is "
+            f"{_MAGIC!r} — re-export from the source engine")
     if data[:len(_MAGIC)] != _MAGIC:
-        raise ValueError("not a serialized KVPageBundle (bad magic)")
+        raise CorruptBundleError("not a serialized KVPageBundle (bad magic)")
     off = len(_MAGIC)
+    if len(data) < off + 8:
+        raise CorruptBundleError("truncated bundle: header length missing")
     hlen = int.from_bytes(data[off:off + 8], "little")
     off += 8
-    header = json.loads(data[off:off + hlen].decode())
+    if len(data) < off + hlen:
+        raise CorruptBundleError(
+            f"truncated bundle: header needs {hlen} bytes, "
+            f"{len(data) - off} present")
+    try:
+        header = json.loads(data[off:off + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CorruptBundleError(f"corrupt bundle header: {e}") from e
     off += hlen
     arrays = {}
     for leaf in header["leaves"]:
         dt = _np_dtype(leaf["dtype"])
         n = int(np.prod(leaf["shape"])) * dt.itemsize
+        if len(data) < off + n:
+            raise CorruptBundleError(
+                f"truncated bundle: leaf {leaf['name']!r} needs {n} bytes, "
+                f"{len(data) - off} present")
         arrays[leaf["name"]] = np.frombuffer(
             data[off:off + n], dtype=dt).reshape(leaf["shape"]).copy()
         off += n
     if off != len(data):
         logger.warning(f"bundle_from_bytes: {len(data) - off} trailing "
                        "bytes ignored")
+    leaves = sorted(arrays)
+    want = list(header.get("page_crcs", []))
+    got = _page_crcs(arrays, leaves)
+    if len(want) != len(got):
+        raise CorruptBundleError(
+            f"corrupt bundle: header carries {len(want)} page CRCs for "
+            f"{len(got)} pages")
+    bad = [j for j, (w, g) in enumerate(zip(want, got)) if w != g]
+    if bad:
+        raise CorruptBundleError(
+            f"corrupt bundle: CRC32 mismatch on page(s) {bad} of "
+            f"{len(got)} (bit flip or torn write in transport) — "
+            "refused; source still holds the sequence")
+    left = header.get("deadline_left_s")
+    if left is not None and header.get("sent_unix") is not None:
+        transit = max(0.0, time.time() - float(header["sent_unix"]))
+        left = max(0.0, float(left) - transit)
     return KVPageBundle(
         uid=header["uid"], tokens=list(header["tokens"]),
         prompt_len=header["prompt_len"],
@@ -125,7 +213,12 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
                             if m.get("key") else None)}
                    for m in header["src_pages"]],
         arrays=arrays, model_sig=tuple(header["model_sig"]),
-        kv_quant=header["kv_quant"], dtype=header["dtype"])
+        kv_quant=header["kv_quant"], dtype=header["dtype"],
+        priority=int(header.get("priority", 1)),
+        deadline=(time.perf_counter() + float(left)
+                  if left is not None else 0.0))
 
 
-__all__ = ["migrate_sequence", "bundle_to_bytes", "bundle_from_bytes"]
+__all__ = ["migrate_sequence", "bundle_to_bytes", "bundle_from_bytes",
+           "CorruptBundleError"]
+

@@ -35,16 +35,23 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import statistics
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..inference.v2.engine_v2 import RaggedRequest
-from ..inference.v2.ragged import PrefixCache
+from ..inference.v2.ragged import PrefixCache, RejectedError
 from ..telemetry import get_registry
 from ..telemetry.spans import record_event
 from ..utils.logging import logger
+from .admission import AdmissionController, record_shed, retry_after_hint
 from .config import ServingConfig
 from .kv_transfer import migrate_sequence
-from .replica import ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica
+from .replica import (BREAKER_OPEN, ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL,
+                      EngineReplica)
+
+#: breaker_state gauge encoding (docs/OBSERVABILITY.md)
+_BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
 
 
 # -- pure routing policy (unit-testable without engines) ---------------------
@@ -94,7 +101,7 @@ class _RequestRecord:
     """Router-side view of one request across replica hops."""
 
     __slots__ = ("request", "replica", "emitted", "done", "failed",
-                 "redispatches")
+                 "redispatches", "finish_reason", "deadline_abs")
 
     def __init__(self, request: RaggedRequest):
         self.request = request
@@ -103,6 +110,16 @@ class _RequestRecord:
         self.done = False
         self.failed = False
         self.redispatches = 0
+        self.finish_reason = ""             # set when done
+        #: absolute expiry on this process's perf_counter clock; hops
+        #: (re-dispatch) carry the REMAINING budget, not a fresh one
+        self.deadline_abs = (time.perf_counter() + request.deadline_s
+                             if request.deadline_s is not None else None)
+
+    def deadline_left(self) -> Optional[float]:
+        if self.deadline_abs is None:
+            return None
+        return max(0.0, self.deadline_abs - time.perf_counter())
 
 
 class FleetRouter:
@@ -130,6 +147,7 @@ class FleetRouter:
         self._page_size = ps.pop()
         self._requests: Dict[int, _RequestRecord] = {}
         self._uid = itertools.count()
+        self.admission = AdmissionController(self.config)
         self._init_metrics()
         self._publish()
 
@@ -175,6 +193,19 @@ class FleetRouter:
         self._m_failed = reg.counter(
             "deepspeed_tpu_serving_fleet_failed_requests_total",
             "requests abandoned after max_redispatch replica losses")
+        # circuit-breaker half of the slo_* family (the deadline /
+        # queue-wait / shed half lives on engine_v2 + admission.py)
+        self._m_breaker_state = reg.gauge(
+            "deepspeed_tpu_serving_slo_breaker_state",
+            "per-replica breaker state: 0=closed, 1=half_open, 2=open",
+            labelnames=("replica",))
+        self._m_breaker_trips = reg.counter(
+            "deepspeed_tpu_serving_slo_breaker_trips_total",
+            "breakers tripped open (gray failure detected: slow or "
+            "flaky replica drained of placement)")
+        self._m_breaker_recover = reg.counter(
+            "deepspeed_tpu_serving_slo_breaker_recoveries_total",
+            "breakers closed again after a healthy half-open probe")
 
     def _publish(self) -> None:
         self._m_live.set(sum(1 for r in self.replicas.values()
@@ -183,6 +214,26 @@ class FleetRouter:
                                  if not rec.done))
 
     # -- placement -----------------------------------------------------------
+    def _place_engine(self, req: RaggedRequest, target: EngineReplica,
+                      cands: List[EngineReplica]
+                      ) -> Optional[EngineReplica]:
+        """Hand ``req`` to ``target``, falling back to the remaining
+        candidates coolest-first when an engine-level bounded queue
+        refuses — the ONE placement-retry policy, shared by new
+        submissions and re-dispatch.  ``record_shed=False``: shed
+        accounting (or not — in-flight streams are never shed) is the
+        caller's.  Returns the accepting replica, or None when every
+        candidate refused."""
+        order = [target] + sorted((c for c in cands if c is not target),
+                                  key=lambda r: (r.load(), r.name))
+        for t in order:
+            try:
+                t.engine.put(req, record_shed=False)
+            except RejectedError:
+                continue
+            return t
+        return None
+
     def _candidates(self, phase: str) -> List[EngineReplica]:
         """Replicas that can take ``phase`` work, role-preferred with a
         lossless fallback to ANY accepting replica when the preferred
@@ -194,8 +245,11 @@ class FleetRouter:
             return pref
         return [r for r in self.replicas.values() if r.accepts_new()]
 
-    def _route(self, prompt_ids: Sequence[int]) -> Tuple[EngineReplica, str]:
-        cands = self._candidates(ROLE_PREFILL)
+    def _route(self, prompt_ids: Sequence[int],
+               cands: Optional[List[EngineReplica]] = None
+               ) -> Tuple[EngineReplica, str]:
+        if cands is None:
+            cands = self._candidates(ROLE_PREFILL)
         if not cands:
             raise RuntimeError("no live replica accepts work")
         key = affinity_key(prompt_ids, self._page_size,
@@ -207,19 +261,75 @@ class FleetRouter:
     # -- request API ---------------------------------------------------------
     def submit(self, request: RaggedRequest) -> int:
         """Route + enqueue one request; returns the router-level uid its
-        stream is keyed by (stable across migrations/re-dispatch)."""
+        stream is keyed by (stable across migrations/re-dispatch).
+
+        Under overload this raises :class:`RejectedError` (load
+        shedding — bounded queue / KV-pool shed threshold, see
+        ``serving/admission.py``) instead of queuing: the caller still
+        holds the request and backs off ``retry_after_s``.  Requests at
+        or below ``serving.protect_priority`` are never shed by the
+        fleet rules; with engine-level hard bounds
+        (``inference.v2 max_queue_depth``) they are refused only when
+        EVERY accepting engine's queue is full — backpressure of last
+        resort, counted as one shed."""
+        # admission BEFORE allocating a uid: a shed request was never in
+        # the fleet (no record, no partial state to clean up)
+        cands = self._candidates(ROLE_PREFILL)
+        self.admission.check(request, cands)
+        target, via = self._route(request.prompt_ids, cands)
         uid = next(self._uid)
         rec = _RequestRecord(request)
         self._requests[uid] = rec
-        target, via = self._route(request.prompt_ids)
-        target.engine.put(RaggedRequest(
-            prompt_ids=list(request.prompt_ids),
-            max_new_tokens=request.max_new_tokens,
-            temperature=request.temperature, eos_id=request.eos_id, uid=uid))
+        # an engine-level bounded queue may refuse the favorite: try the
+        # remaining candidates coolest-first (record_shed=False in
+        # _place_engine — at most ONE shed per request, counted here,
+        # not per engine)
+        try:
+            req = RaggedRequest(
+                prompt_ids=list(request.prompt_ids),
+                max_new_tokens=request.max_new_tokens,
+                temperature=request.temperature, eos_id=request.eos_id,
+                uid=uid, priority=request.priority,
+                deadline_s=request.deadline_s)
+            placed = self._place_engine(req, target, cands)
+            if placed is None:
+                # roles are preferences, not gates: before shedding, try
+                # the accepting replicas OUTSIDE the prefill-capable
+                # pool (e.g. idle decode replicas — mixed-serving
+                # degradation, the same lossless fallback _candidates
+                # applies when the preferred pool is empty)
+                rest = sorted(
+                    (r for r in self.replicas.values()
+                     if r.accepts_new() and r not in cands),
+                    key=lambda r: (r.load(), r.name))
+                if rest:
+                    placed = self._place_engine(req, rest[0], rest)
+            if placed is None:
+                # every accepting engine's hard queue bound refused:
+                # shed loudly (once)
+                hint = retry_after_hint(
+                    self.admission.fleet_queue_depth(cands))
+                record_shed(request.priority, "engine_queue_full", hint,
+                            uid=uid)
+                logger.warning(
+                    f"fleet: shed priority-{request.priority} request — "
+                    "every accepting engine's bounded queue is full; "
+                    f"retry after {hint}s")
+                raise RejectedError("engine_queue_full",
+                                    retry_after_s=hint,
+                                    priority=request.priority)
+            if placed is not target:
+                target, via = placed, "engine_full_fallback"
+        except BaseException:
+            # the request was never admitted anywhere: a ghost record
+            # with done=False would wedge has_work() True forever
+            self._requests.pop(uid, None)
+            raise
         rec.replica = target.name
         self._m_requests.inc()
         record_event("fleet_route", cat="serve", uid=uid,
                      replica=target.name, via=via,
+                     priority=request.priority,
                      prompt_tokens=len(request.prompt_ids))
         self._publish()
         return uid
@@ -264,10 +374,27 @@ class FleetRouter:
         key = affinity_key(prompt, self._page_size,
                            self.config.affinity_pages)
         target, _via = pick_replica(key, cands, self.config.load_gap)
-        target.engine.put(RaggedRequest(
+        # the hop inherits the request's REMAINING deadline budget (a
+        # re-dispatch never resets the SLO clock) and its priority.
+        # An engine-level bounded queue may refuse the favorite — an
+        # in-flight stream is NOT shed for that: try the remaining
+        # candidates coolest-first before giving up.
+        # an in-flight stream is never "shed": a refusal here is a
+        # placement miss (the loss, if total, counts in
+        # fleet_failed_requests_total), so no shed accounting
+        placed = self._place_engine(RaggedRequest(
             prompt_ids=prompt, max_new_tokens=remaining,
             temperature=rec.request.temperature,
-            eos_id=rec.request.eos_id, uid=uid))
+            eos_id=rec.request.eos_id, uid=uid,
+            priority=rec.request.priority,
+            deadline_s=rec.deadline_left()), target, cands)
+        if placed is None:
+            rec.done = rec.failed = True
+            self._m_failed.inc()
+            logger.error(f"fleet: request {uid} lost — every live replica "
+                         "refused the re-dispatch (bounded queues full)")
+            return
+        target = placed
         rec.replica = target.name
         if charge:
             self._m_redispatch.inc()
@@ -279,11 +406,17 @@ class FleetRouter:
         return [uid for uid, rec in self._requests.items()
                 if rec.replica == name and not rec.done]
 
+    def _clear_breaker_gauge(self, r: EngineReplica) -> None:
+        """A dead/retired replica must not export an open breaker
+        forever: zero its ``breaker_state`` label on the way out."""
+        self._m_breaker_state.set(0, replica=r.name)
+
     def _reap_dead(self) -> None:
         for r in self.replicas.values():
             if r.alive or r.retired:
                 continue
             r.retired = True
+            self._clear_breaker_gauge(r)
             lost = self._owned_uids(r.name)
             self._m_deaths.inc()
             record_event("fleet_replica_death", cat="serve",
@@ -315,6 +448,7 @@ class FleetRouter:
             self._try_migrate(uid, r)
         leftovers = r.engine.abort_all(reason="evacuate")
         r.retired = True
+        self._clear_breaker_gauge(r)
         record_event("fleet_retire", cat="serve", replica=r.name,
                      redispatched=len(leftovers))
         for uid in leftovers:
@@ -357,20 +491,95 @@ class FleetRouter:
             for uid in list(r.engine.ready_uids()):
                 self._try_migrate(uid, r)
 
+    # -- circuit breakers ----------------------------------------------------
+    def _check_breakers(self) -> None:
+        """Advance every live replica's breaker one pump.  The fleet
+        signal for the latency rule is the median of the OTHER
+        *same-role* replicas' rolling medians (open breakers and short
+        windows excluded): prefill chunks and decode steps have
+        different cost profiles, so cross-role comparison would trip
+        healthy prefill replicas on a fleet of fast decoders.  A
+        replica is only *relatively* slow — on a uniformly slow fleet
+        (or a role with a single replica) the latency rule stays quiet
+        and only consecutive step errors trip.  A trip drains the
+        replica of new placement (its ``accepts_new`` goes False) and
+        re-dispatches its in-flight streams through the bit-identical
+        recompute path."""
+        if not self.config.breaker_enabled:
+            return
+        live = [r for r in self.replicas.values()
+                if r.alive and not r.retired]
+        for r in live:
+            others = [o.step_p50() for o in live
+                      if o is not r and o.role == r.role
+                      and o.breaker != BREAKER_OPEN
+                      and o.lat_samples >= self.config.breaker_min_samples]
+            med = statistics.median(others) if others else 0.0
+            action = r.breaker_eval(med, self.config)
+            if action == "trip":
+                self._on_breaker_trip(r, med)
+            elif action == "probe":
+                record_event("breaker_probe", cat="serve", replica=r.name)
+                logger.info(f"fleet: breaker half-open on {r.name} — "
+                            "probing with live traffic")
+            elif action == "recover":
+                self._m_breaker_recover.inc()
+                record_event("breaker_recover", cat="serve", replica=r.name)
+                logger.info(f"fleet: breaker closed on {r.name} — "
+                            "recovered after a healthy probe")
+            self._m_breaker_state.set(_BREAKER_STATE_CODE[r.breaker],
+                                      replica=r.name)
+
+    def _on_breaker_trip(self, r: EngineReplica, fleet_median: float) -> None:
+        self._m_breaker_trips.inc()
+        lost = self._owned_uids(r.name)
+        record_event("breaker_trip", cat="serve", replica=r.name,
+                     p50_s=round(r.step_p50(), 6),
+                     p95_s=round(r.step_p95(), 6),
+                     fleet_median_s=round(fleet_median, 6),
+                     consec_errors=r.consec_errors, inflight=len(lost))
+        logger.warning(
+            f"fleet: breaker OPEN on {r.name} (median step "
+            f"{r.step_p50() * 1e3:.1f}ms / p95 {r.step_p95() * 1e3:.1f}ms "
+            f"vs fleet median {fleet_median * 1e3:.1f}ms, "
+            f"{r.consec_errors} consecutive errors); draining placement, "
+            f"re-dispatching {len(lost)} in-flight stream(s)")
+        # free the degraded replica's queued + admitted work, then
+        # re-run it elsewhere: greedy streams continue bit-identically
+        # (prompt + emitted recompute, the replica-death contract)
+        r.engine.abort_all(reason="breaker")
+        for uid in lost:
+            self._redispatch(uid)
+
     # -- the fleet pump ------------------------------------------------------
     def step(self) -> Dict[int, Dict[str, Any]]:
-        """One pump: reap failures, migrate ready sequences, step every
-        replica.  Returns ``{uid: {"tokens": [...], "done": bool}}``
-        keyed by router uids — the same shape as ``engine.step()``."""
+        """One pump: reap failures, evaluate breakers, migrate ready
+        sequences, step every replica.  Returns ``{uid: {"tokens":
+        [...], "done": bool}}`` keyed by router uids — the same shape as
+        ``engine.step()`` (finished records carry ``finish_reason``)."""
         self._reap_dead()
         self._reap_preempted()
+        self._check_breakers()
         if self.config.disaggregated:
             self._pump_migrations()
         out: Dict[int, Dict[str, Any]] = {}
         for r in self.replicas.values():
             if not (r.alive and not r.retired):
                 continue
-            for uid, rec_out in r.step().items():
+            try:
+                stepped = r.step()
+            except Exception as e:
+                if not self.config.breaker_enabled:
+                    raise
+                # gray-failure tolerance: one replica's step fault must
+                # not take the fleet down.  The error is recorded in the
+                # replica's breaker window — consecutive faults trip the
+                # breaker, which re-dispatches its streams.
+                logger.warning(f"fleet: replica {r.name} step failed "
+                               f"({e!r}); breaker evaluating "
+                               f"({r.consec_errors} consecutive)")
+                continue
+            for uid, rec_out in stepped.items():
                 rec = self._requests.get(uid)
                 if rec is None:
                     continue
@@ -378,9 +587,12 @@ class FleetRouter:
                 if rec_out["done"]:
                     rec.done = True
                     rec.replica = None
+                    rec.finish_reason = rec_out.get("finish_reason", "")
                 merged = out.setdefault(uid, {"tokens": [], "done": False})
                 merged["tokens"].extend(rec_out["tokens"])
                 merged["done"] = rec_out["done"]
+                if rec_out["done"]:
+                    merged["finish_reason"] = rec.finish_reason
         self._publish()
         return out
 
@@ -429,12 +641,14 @@ class FleetRouter:
             if seq.done:
                 rec.done = True
                 rec.replica = None
+                rec.finish_reason = seq.finish_reason
             else:
                 # drain hit drain_max_steps: the sequence is alive but
                 # its replica is retiring — hand it elsewhere, else it
                 # is stranded forever on a replica step() skips
                 unfinished.append(uid)
         r.retired = True
+        self._clear_breaker_gauge(r)
         if unfinished:
             # free the stragglers' pages/spans in the retiring engine
             # before re-running them elsewhere
@@ -449,7 +663,10 @@ class FleetRouter:
         rec = self._requests[uid]
         return {"emitted": list(rec.emitted), "done": rec.done,
                 "failed": rec.failed, "replica": rec.replica,
-                "redispatches": rec.redispatches}
+                "redispatches": rec.redispatches,
+                "finish_reason": rec.finish_reason,
+                "priority": rec.request.priority,
+                "deadline_left_s": rec.deadline_left()}
 
     def health(self) -> Dict[str, Any]:
         return {name: r.health() for name, r in self.replicas.items()}
@@ -489,18 +706,18 @@ def build_fleet(model: Any, serving: Optional[ServingConfig] = None,
             replicas.append(EngineReplica(
                 f"prefill{i}",
                 InferenceEngineV2(model, pf_cfg, params=params, seed=seed),
-                role=ROLE_PREFILL))
+                role=ROLE_PREFILL, breaker_window=serving.breaker_window))
         for i in range(serving.decode_replicas):
             replicas.append(EngineReplica(
                 f"decode{i}",
                 InferenceEngineV2(model, base, params=params, seed=seed),
-                role=ROLE_DECODE))
+                role=ROLE_DECODE, breaker_window=serving.breaker_window))
     else:
         for i in range(serving.prefill_replicas + serving.decode_replicas):
             replicas.append(EngineReplica(
                 f"replica{i}",
                 InferenceEngineV2(model, base, params=params, seed=seed),
-                role=ROLE_MIXED))
+                role=ROLE_MIXED, breaker_window=serving.breaker_window))
     return FleetRouter(replicas, serving)
 
 

@@ -20,7 +20,8 @@ from .compile_sentinel import (RecompileSentinel, compile_counts,
                                expect_recompile)
 from .exporter import (JSONLWriter, PrometheusFileExporter,
                        PrometheusHTTPExporter, parse_prometheus_text,
-                       snapshot_metrics, to_prometheus_text)
+                       record_export_failure, snapshot_metrics,
+                       to_prometheus_text)
 from .flight import (FlightRecorder, dump_on_exception, get_flight_recorder,
                      install_flight_recorder)
 from .memory import (MemoryLedger, get_memory_ledger, is_resource_exhausted,
@@ -41,6 +42,7 @@ __all__ = [
     "get_registry", "set_registry",
     "to_prometheus_text", "parse_prometheus_text", "snapshot_metrics",
     "PrometheusFileExporter", "PrometheusHTTPExporter", "JSONLWriter",
+    "record_export_failure",
     "step_trace", "annotate", "PhaseTimer", "profiler_available",
     "SpanRecorder", "span", "begin_span", "end_span", "record_event",
     "trace_dump", "get_span_recorder", "set_span_recorder", "configure_spans",
@@ -163,19 +165,33 @@ class Telemetry:
         self._last_export = step
         if self.prom_file is None and self.jsonl is None:
             return
+        # a broken sink (full disk, torn mount) must never raise out of
+        # the boundary-cadence export into the train/serve step: warn
+        # once + count, keep stepping (exporter.record_export_failure)
         with span("telemetry_export", step=step):
             if self.prom_file is not None:
-                self.prom_file.write()
+                try:
+                    self.prom_file.write()
+                except Exception as e:
+                    record_export_failure("prometheus_file", e,
+                                          self.registry)
             if self.jsonl is not None:
-                self.jsonl.emit_snapshot(self.registry, step=step)
+                try:
+                    self.jsonl.emit_snapshot(self.registry, step=step)
+                except Exception as e:
+                    record_export_failure("jsonl", e, self.registry)
 
     def close(self) -> None:
-        for part in (self.prom_file, self.prom_http, self.jsonl):
+        for sink, part in (("prometheus_file", self.prom_file),
+                           ("prometheus_http", self.prom_http),
+                           ("jsonl", self.jsonl)):
             if part is not None:
                 try:
                     part.close()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # engine.close() must release every other sink too —
+                    # count + warn-once, never raise out of teardown
+                    record_export_failure(sink, e, self.registry)
         # release the process flight-recorder slot if it is ours (a later
         # engine's Telemetry installs its own)
         if self.flight is not None and get_flight_recorder() is self.flight:

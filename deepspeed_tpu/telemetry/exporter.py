@@ -27,6 +27,33 @@ from typing import Dict, Optional, Tuple
 from ..utils.logging import logger
 from .registry import Histogram, MetricsRegistry, get_registry
 
+#: sinks already warned about this process (warn-once per sink kind: a
+#: full disk would otherwise log every boundary for the rest of the run)
+_warned_sinks: set = set()
+
+
+def record_export_failure(sink: str, exc: BaseException,
+                          registry: Optional[MetricsRegistry] = None) -> None:
+    """Account a failed telemetry export WITHOUT raising.
+
+    Observability must never kill the work it observes: a full disk, a
+    torn NFS mount or a dead scrape socket turns into a warn-once log
+    line plus ``deepspeed_tpu_telemetry_export_failures_total`` (labeled
+    by sink), while the training/serving step goes on.  The counter
+    itself is in-memory, so it survives the broken sink and surfaces on
+    whichever exporter still works."""
+    (registry or get_registry()).counter(
+        "deepspeed_tpu_telemetry_export_failures_total",
+        "telemetry exporter writes that failed (warn-once logged, "
+        "never raised into the step)", labelnames=("sink",)).inc(sink=sink)
+    if sink not in _warned_sinks:
+        _warned_sinks.add(sink)
+        logger.warning(
+            f"telemetry: {sink} export failed ({exc!r}); exports to this "
+            "sink will keep being attempted and counted in "
+            "deepspeed_tpu_telemetry_export_failures_total, but this is "
+            "the only log line you will see for it")
+
 
 # --------------------------------------------------------------------------
 # Prometheus text exposition format

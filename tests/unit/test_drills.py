@@ -1,0 +1,63 @@
+"""In-process smoke tests for the chaos/fleet drill entrypoints.
+
+The drills are acceptance gates (``--demo`` must exit 0 on CPU) but
+used to live outside CI entirely — a refactor could bit-rot them and
+nobody would notice until the next manual run.  These slow-marked tests
+call each tool's ``main()`` **in-process** (entrypoint call, not
+subprocess) so a broken import, flag, or drill leg fails tier-"slow"
+loudly, with the real traceback.
+
+The drills themselves still spawn ElasticAgent subprocesses internally
+(the chaos kill leg ``os._exit``s an *attempt*, never this process).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault(name, mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_fleet_drill_demo_inprocess(tmp_path):
+    drill = _load_tool("fleet_drill")
+    out = str(tmp_path / "fleet")
+    rc = drill.main(["--demo", "--out", out, "--seed", "7"])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "fleet_drill.json")))
+    assert summary["ok"] and summary["seed"] == 7
+    failed = [c for c in summary["checks"] if not c["ok"]]
+    assert not failed, failed
+    # the overload/SLO legs actually ran (not silently skipped)
+    names = {c["check"] for c in summary["checks"]}
+    for leg in ("overload_sheds_only_low_priority",
+                "deadlines_fire_with_finish_reason",
+                "slow_replica_breaker_tripped",
+                "breaker_recovered_via_half_open_probe",
+                "slow_leg_bit_identical_to_single_engine"):
+        assert leg in names, f"missing drill leg {leg}"
+
+
+@pytest.mark.slow
+def test_chaos_drill_demo_inprocess(tmp_path):
+    drill = _load_tool("chaos_drill")
+    out = str(tmp_path / "chaos")
+    rc = drill.main(["--demo", "--out", out, "--seed", "0"])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "chaos_drill.json")))
+    assert summary["ok"] and summary["seed"] == 0
+    failed = [c for c in summary["checks"] if not c["ok"]]
+    assert not failed, failed

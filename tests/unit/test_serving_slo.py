@@ -1,0 +1,667 @@
+"""Serving-SLO tests: admission control, deadlines, priorities,
+circuit breakers, gray-failure chaos, CRC'd KV transport, and the
+never-kill-a-step telemetry export guard.
+
+Fast tier: pure policy — the admission controller's shed rules over
+fake replicas, retry hints, the breaker state machine driven by hand,
+the chaos injectors, wire-format CRC rejection, config validation, and
+the export-failure guard.  No model steps.
+
+Slow tier: engine-level oracles — bounded-queue rejection at put(),
+deadline expiry with ``finish_reason="deadline"`` (queued AND
+mid-decode), priority-ordered admission, priority preemption under
+pool pressure, and a fleet whose flaky replica trips the breaker on
+consecutive errors while every stream still finishes bit-identically.
+"""
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (PRIORITY_BATCH,
+                                        PRIORITY_INTERACTIVE,
+                                        PRIORITY_NORMAL, InferenceEngineV2,
+                                        RaggedInferenceConfig, RaggedRequest,
+                                        RejectedError)
+from deepspeed_tpu.resilience.chaos import (ChaosStepError, FlakyStep,
+                                            PoolSqueeze, SlowReplica)
+from deepspeed_tpu.serving import ServingConfig
+from deepspeed_tpu.serving.admission import (AdmissionController,
+                                             estimate_pages,
+                                             retry_after_hint)
+from deepspeed_tpu.serving.kv_transfer import (CorruptBundleError,
+                                               bundle_from_bytes,
+                                               bundle_to_bytes)
+from deepspeed_tpu.serving.replica import (BREAKER_CLOSED, BREAKER_HALF_OPEN,
+                                           BREAKER_OPEN, EngineReplica)
+
+
+# ----------------------------- fakes ----------------------------------------
+def _fake_replica(name="r0", queue_depth=0, free_pages=32, num_pages=32,
+                  page_size=8):
+    return SimpleNamespace(
+        name=name,
+        engine=SimpleNamespace(
+            queue_depth=queue_depth,
+            allocator=SimpleNamespace(free_pages=free_pages,
+                                      num_pages=num_pages),
+            block=SimpleNamespace(page_size=page_size)))
+
+
+def _req(prompt=16, new=16, priority=PRIORITY_NORMAL):
+    return RaggedRequest(prompt_ids=list(range(prompt)), max_new_tokens=new,
+                         priority=priority)
+
+
+# ----------------------------- fast: admission policy -----------------------
+def test_admission_queue_bound_sheds_by_priority():
+    cfg = ServingConfig(max_queue_depth=4, protect_priority=0)
+    ac = AdmissionController(cfg)
+    cands = [_fake_replica(queue_depth=4)]
+    with pytest.raises(RejectedError) as ei:
+        ac.check(_req(priority=PRIORITY_BATCH), cands)
+    assert ei.value.reason == "queue_full"
+    assert 0.1 <= ei.value.retry_after_s <= 30.0
+    assert ei.value.priority == PRIORITY_BATCH
+    # protected class rides through the same full queue
+    assert ac.check(_req(priority=PRIORITY_INTERACTIVE), cands) > 0
+    # under the bound: everyone admitted
+    assert ac.check(_req(priority=PRIORITY_BATCH),
+                    [_fake_replica(queue_depth=3)]) > 0
+
+
+def test_admission_pool_pressure_uses_coolest_candidate():
+    cfg = ServingConfig(shed_occupancy=0.85, protect_priority=0)
+    ac = AdmissionController(cfg)
+    # one hot replica, one cool: the COOL one decides -> admit
+    hot = _fake_replica("hot", free_pages=0)
+    cool = _fake_replica("cool", free_pages=28)
+    assert ac.check(_req(priority=PRIORITY_BATCH), [hot, cool]) > 0
+    with pytest.raises(RejectedError) as ei:
+        ac.check(_req(priority=PRIORITY_BATCH), [hot])
+    assert ei.value.reason == "pool_pressure"
+    # protected priority never sheds on pool pressure either
+    assert ac.check(_req(priority=PRIORITY_INTERACTIVE), [hot]) > 0
+
+
+def test_admission_disabled_by_default():
+    ac = AdmissionController(ServingConfig())  # both rules off
+    assert ac.check(_req(priority=PRIORITY_BATCH),
+                    [_fake_replica(queue_depth=10 ** 6, free_pages=0)]) > 0
+
+
+def test_retry_hint_and_page_estimate():
+    assert retry_after_hint(0) == 0.1
+    assert retry_after_hint(10 ** 9) == 30.0
+    assert retry_after_hint(10) > retry_after_hint(1)
+    assert estimate_pages(16, 16, 8) == 4
+    assert estimate_pages(17, 16, 8) == 5  # rounds up
+
+
+def test_shed_counter_labels_by_priority():
+    from deepspeed_tpu.serving.admission import shed_counter
+
+    c = shed_counter()
+    before = c.value(priority="2")
+    cfg = ServingConfig(max_queue_depth=1, protect_priority=0)
+    with pytest.raises(RejectedError):
+        AdmissionController(cfg).check(
+            _req(priority=PRIORITY_BATCH), [_fake_replica(queue_depth=1)])
+    assert c.value(priority="2") == before + 1
+
+
+# ----------------------------- fast: breaker state machine ------------------
+def _breaker_cfg(**kw):
+    base = dict(breaker_latency_factor=3.0, breaker_consec_errors=3,
+                breaker_window=16, breaker_min_samples=4,
+                breaker_min_latency_s=0.0, breaker_cooldown_pumps=3,
+                breaker_probe_steps=2)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _bare_replica(window=16):
+    eng = SimpleNamespace(queue_depth=0, active_count=0,
+                          allocator=SimpleNamespace(free_pages=32,
+                                                    num_pages=32))
+    return EngineReplica("r0", eng, breaker_window=window)
+
+
+def test_breaker_latency_trip_recovery_cycle():
+    cfg = _breaker_cfg()
+    r = _bare_replica()
+    for _ in range(6):
+        r._record_step(0.100, error=False)  # sustained 100ms
+    # no fleet signal -> never trips on latency alone
+    assert r.breaker_eval(0.0, cfg) is None
+    # fleet median 10ms, factor 3 -> 100ms trips
+    assert r.breaker_eval(0.010, cfg) == "trip"
+    assert r.breaker == BREAKER_OPEN and not r.accepts_new()
+    # cooldown: 3 pumps to half-open
+    assert r.breaker_eval(0.010, cfg) is None
+    assert r.breaker_eval(0.010, cfg) is None
+    assert r.breaker_eval(0.010, cfg) == "probe"
+    assert r.breaker == BREAKER_HALF_OPEN and r.accepts_new()
+    # window was cleared: old latencies gone
+    assert r.lat_samples == 0
+    # two healthy steps close it
+    r._record_step(0.005, error=False)
+    assert r.breaker_eval(0.010, cfg) is None
+    r._record_step(0.005, error=False)
+    assert r.breaker_eval(0.010, cfg) == "recover"
+    assert r.breaker == BREAKER_CLOSED
+
+
+def test_breaker_median_rule_ignores_spikes():
+    """A one-off compile/GC spike lifts p95 but not the median — the
+    breaker must NOT trip (the gray-failure rule wants SUSTAINED
+    slowness)."""
+    cfg = _breaker_cfg()
+    r = _bare_replica()
+    for _ in range(10):
+        r._record_step(0.005, error=False)
+    r._record_step(1.5, error=False)  # one compile spike
+    assert r.step_p95() > 1.0 > 0.01 > r.step_p50()
+    assert r.breaker_eval(0.005, cfg) is None
+    assert r.breaker == BREAKER_CLOSED
+
+
+def test_breaker_consecutive_error_trip_and_reset():
+    cfg = _breaker_cfg(breaker_consec_errors=3)
+    r = _bare_replica()
+    r._record_step(0.01, error=True)
+    r._record_step(0.01, error=True)
+    r._record_step(0.01, error=False)  # healthy step resets the run
+    assert r.consec_errors == 0
+    assert r.breaker_eval(0.0, cfg) is None
+    for _ in range(3):
+        r._record_step(0.01, error=True)
+    assert r.breaker_eval(0.0, cfg) == "trip"
+    assert r.step_errors == 5
+
+
+def test_breaker_half_open_retrip_on_errors():
+    cfg = _breaker_cfg(breaker_cooldown_pumps=1)
+    r = _bare_replica()
+    for _ in range(3):
+        r._record_step(0.01, error=True)
+    assert r.breaker_eval(0.0, cfg) == "trip"
+    assert r.breaker_eval(0.0, cfg) == "probe"
+    for _ in range(3):  # probe traffic still failing
+        r._record_step(0.01, error=True)
+    assert r.breaker_eval(0.0, cfg) == "trip"
+    assert r.breaker == BREAKER_OPEN
+
+
+def test_breaker_intermittent_errors_trip_majority_window():
+    """A replica failing every other step never runs up consec_errors
+    and its ~0s error returns must not drag p50 down — the majority-
+    erroring window rule catches the intermittent-fault profile."""
+    cfg = _breaker_cfg(breaker_consec_errors=3, breaker_min_samples=4)
+    r = _bare_replica()
+    for _ in range(4):
+        r._record_step(0.000001, error=True)   # fast failures
+        r._record_step(0.010, error=False)
+    assert r.consec_errors == 0
+    # error steps stayed out of the latency window
+    assert r.step_p50() == pytest.approx(0.010, abs=1e-3)
+    assert r.breaker_eval(0.0, cfg) == "trip"
+
+
+def test_breaker_half_open_single_error_retrips():
+    """Docs contract: ANY error during the half-open probe re-trips —
+    interleaved healthy steps must not let a flaky replica 'recover'."""
+    cfg = _breaker_cfg(breaker_cooldown_pumps=1, breaker_probe_steps=2,
+                       breaker_consec_errors=3)
+    r = _bare_replica()
+    for _ in range(3):
+        r._record_step(0.01, error=True)
+    assert r.breaker_eval(0.0, cfg) == "trip"
+    assert r.breaker_eval(0.0, cfg) == "probe"
+    r._record_step(0.01, error=False)
+    r._record_step(0.01, error=True)   # one probe error
+    r._record_step(0.01, error=False)  # healthy steps don't save it
+    r._record_step(0.01, error=False)
+    assert r.breaker_eval(0.0, cfg) == "trip"
+    assert r.breaker == BREAKER_OPEN
+
+
+def test_breaker_half_open_still_slow_retrips_not_recovers():
+    """A persistently slow (error-free) replica must RE-TRIP at the
+    half-open decision point, not recover and flap: the probe steps are
+    the latency evidence even though they are fewer than
+    breaker_min_samples."""
+    cfg = _breaker_cfg(breaker_cooldown_pumps=1, breaker_probe_steps=2,
+                       breaker_min_samples=8, breaker_window=16)
+    r = _bare_replica()
+    for _ in range(8):
+        r._record_step(0.100, error=False)
+    assert r.breaker_eval(0.010, cfg) == "trip"
+    assert r.breaker_eval(0.010, cfg) == "probe"
+    r._record_step(0.100, error=False)  # probe traffic: still 10x slow
+    assert r.breaker_eval(0.010, cfg) is None  # probe not complete yet
+    r._record_step(0.100, error=False)
+    assert r.breaker_eval(0.010, cfg) == "trip"
+    assert r.breaker == BREAKER_OPEN
+    # ...whereas a probe at healthy speed recovers as before
+    assert r.breaker_eval(0.010, cfg) == "probe"
+    r._record_step(0.008, error=False)
+    r._record_step(0.008, error=False)
+    assert r.breaker_eval(0.010, cfg) == "recover"
+
+
+def test_breaker_health_surface():
+    r = _bare_replica()
+    r._record_step(0.004, error=False)
+    h = r.health()
+    assert h["breaker"] == "closed" and h["step_errors"] == 0
+    assert h["step_p50_s"] == pytest.approx(0.004, abs=1e-3)
+
+
+# ----------------------------- fast: chaos injectors ------------------------
+def test_flaky_step_deterministic_then_clean():
+    hook = FlakyStep(fail_steps=2, seed=3)
+    for _ in range(2):
+        with pytest.raises(ChaosStepError):
+            hook()
+    hook()  # passes afterwards
+    assert (hook.calls, hook.raised) == (3, 2)
+    # seeded probabilistic mode replays identically
+    a = FlakyStep(fail_steps=0, p=0.5, seed=11)
+    b = FlakyStep(fail_steps=0, p=0.5, seed=11)
+
+    def trace(h):
+        out = []
+        for _ in range(20):
+            try:
+                h()
+                out.append(0)
+            except ChaosStepError:
+                out.append(1)
+        return out
+
+    assert trace(a) == trace(b) and sum(trace(FlakyStep(0, p=0.5, seed=11)))
+
+
+def test_slow_replica_injects_latency():
+    hook = SlowReplica(delay_s=0.02, seed=0)
+    t0 = time.perf_counter()
+    hook()
+    assert time.perf_counter() - t0 >= 0.015
+    assert hook.calls == 1
+
+
+def test_pool_squeeze_holds_and_releases():
+    from deepspeed_tpu.inference.v2 import BlockAllocator
+
+    alloc = BlockAllocator(16)
+    eng = SimpleNamespace(allocator=alloc)
+    with PoolSqueeze(eng, 10) as sq:
+        assert sq.pages == 10 and alloc.free_pages == 6
+    assert alloc.free_pages == 16
+    # over-asking clamps to what is truly free
+    sq = PoolSqueeze(eng, 99)
+    assert sq.pages == 16 and alloc.free_pages == 0
+    sq.release()
+    assert alloc.free_pages == 16
+
+
+# ----------------------------- fast: CRC'd wire format ----------------------
+def _bundle(n_pages=3, ps=4):
+    from deepspeed_tpu.inference.v2 import KVPageBundle
+
+    rng = np.random.RandomState(0)
+    arrays = {"k": rng.randn(2, n_pages, ps, 1, 2).astype(np.float32),
+              "v": rng.randn(2, n_pages, ps, 1, 2).astype(np.float32)}
+    return KVPageBundle(
+        uid=7, tokens=list(range(ps * n_pages - 1)),
+        prompt_len=ps * (n_pages - 1), max_new_tokens=8, temperature=0.0,
+        eos_id=None, prefilled=ps * n_pages - 2, decode_entry=False,
+        page_size=ps, page_keys=[b"\x01" * 32, b"\x02" * 32],
+        src_pages=[{"page": i, "refcount": 1, "key": None}
+                   for i in range(n_pages)],
+        arrays=arrays, model_sig=(2, 1, 2), kv_quant=False, dtype="fp32",
+        priority=PRIORITY_BATCH, deadline=time.perf_counter() + 60.0)
+
+
+def test_bundle_crc_roundtrip_carries_slo_identity():
+    b = _bundle()
+    rt = bundle_from_bytes(bundle_to_bytes(b))
+    for leaf in b.arrays:
+        assert np.array_equal(rt.arrays[leaf], b.arrays[leaf])
+    assert rt.priority == PRIORITY_BATCH
+    # deadline re-based as seconds-left: still in the future, ~60s out
+    assert 50.0 < rt.deadline - time.perf_counter() <= 60.5
+    # no deadline stays no deadline
+    b2 = _bundle()
+    b2.deadline = 0.0
+    assert bundle_from_bytes(bundle_to_bytes(b2)).deadline == 0.0
+
+
+def test_bundle_bitflip_rejected_naming_page():
+    data = bytearray(bundle_to_bytes(_bundle()))
+    data[-3] ^= 0x10  # payload tail = last page of leaf "v"
+    with pytest.raises(CorruptBundleError, match=r"CRC32 mismatch.*\[2\]"):
+        bundle_from_bytes(bytes(data))
+
+
+def test_bundle_truncation_and_version_rejected():
+    data = bundle_to_bytes(_bundle())
+    with pytest.raises(CorruptBundleError, match="truncated"):
+        bundle_from_bytes(data[:-10])
+    with pytest.raises(CorruptBundleError, match="truncated"):
+        bundle_from_bytes(data[:10])
+    old = b"DSTPUKV1" + data[8:]
+    with pytest.raises(CorruptBundleError, match="retired wire version"):
+        bundle_from_bytes(old)
+    with pytest.raises(CorruptBundleError, match="bad magic"):
+        bundle_from_bytes(b"garbage!" + data[8:])
+
+
+# ----------------------------- fast: export never kills a step --------------
+def test_telemetry_export_failures_counted_not_raised():
+    from deepspeed_tpu.telemetry import Telemetry
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    tm = Telemetry(None, registry=reg)
+
+    class _Broken:
+        def write(self):
+            raise OSError("disk full")
+
+        def emit_snapshot(self, *a, **kw):
+            raise OSError("disk full")
+
+        def close(self):
+            raise OSError("disk full")
+
+    tm.prom_file = _Broken()
+    tm.jsonl = _Broken()
+    tm.export(1, force=True)  # must NOT raise
+    tm.export(2, force=True)
+    c = reg.get("deepspeed_tpu_telemetry_export_failures_total")
+    assert c.value(sink="prometheus_file") == 2
+    assert c.value(sink="jsonl") == 2
+    tm.close()  # broken close paths counted too, still no raise
+    assert c.value(sink="prometheus_file") == 3
+
+
+# ----------------------------- fast: config + request surface ---------------
+def test_serving_config_slo_validation():
+    ServingConfig(max_queue_depth=8, shed_occupancy=0.9,
+                  breaker_latency_factor=2.5).validate()
+    with pytest.raises(ValueError):
+        ServingConfig(shed_occupancy=1.5).validate()
+    with pytest.raises(ValueError):
+        ServingConfig(breaker_latency_factor=1.0).validate()
+    with pytest.raises(ValueError):
+        ServingConfig(breaker_min_samples=64, breaker_window=8).validate()
+    with pytest.raises(ValueError):
+        ServingConfig(max_queue_depth=-1).validate()
+    # ds-config style parse picks the new knobs up
+    cfg = ServingConfig.from_dict({"max_queue_depth": 6,
+                                   "shed_occupancy": 0.8,
+                                   "breaker_consec_errors": 5})
+    assert (cfg.max_queue_depth, cfg.shed_occupancy,
+            cfg.breaker_consec_errors) == (6, 0.8, 5)
+
+
+def test_request_slo_defaults():
+    r = RaggedRequest(prompt_ids=[1, 2])
+    assert r.priority == PRIORITY_NORMAL and r.deadline_s is None
+    e = RejectedError("test", retry_after_s=2.5, priority=1)
+    assert e.retry_after_s == 2.5 and "retry after 2.50s" in str(e)
+
+
+# ----------------------------- slow: engine oracles -------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    import jax
+
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=128)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **kw):
+    cfg = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=64,
+                                max_seqs=4, max_pages_per_seq=12, **kw)
+    return InferenceEngineV2(model, cfg, params=params)
+
+
+def _prompt(n, seed=0, vocab=256):
+    return list(np.random.RandomState(seed).randint(0, vocab, n))
+
+
+@pytest.mark.slow
+def test_engine_bounded_queue_rejects(tiny_model):
+    from deepspeed_tpu.serving.admission import shed_counter
+
+    model, params = tiny_model
+    eng = _engine(model, params, max_queue_depth=2)
+    eng.put(RaggedRequest(prompt_ids=_prompt(10), max_new_tokens=4))
+    eng.put(RaggedRequest(prompt_ids=_prompt(10, 1), max_new_tokens=4))
+    s0 = shed_counter().total()
+    with pytest.raises(RejectedError) as ei:
+        eng.put(RaggedRequest(prompt_ids=_prompt(10, 2), max_new_tokens=4,
+                              priority=PRIORITY_BATCH))
+    assert ei.value.reason == "engine_queue_full"
+    assert ei.value.retry_after_s > 0
+    assert shed_counter().total() == s0 + 1
+    # multi-candidate placers (the fleet router) own shed accounting:
+    # a refusal with record_shed=False raises but counts NOTHING
+    with pytest.raises(RejectedError):
+        eng.put(RaggedRequest(prompt_ids=_prompt(10, 3), max_new_tokens=4,
+                              priority=PRIORITY_BATCH), record_shed=False)
+    assert shed_counter().total() == s0 + 1
+    # queue drains -> accepts again
+    for _ in range(30):
+        if not eng.has_work():
+            break
+        eng.step()
+    eng.put(RaggedRequest(prompt_ids=_prompt(10, 2), max_new_tokens=4))
+    eng.close()
+
+
+@pytest.mark.slow
+def test_engine_deadline_expiry_queued_and_mid_decode(tiny_model):
+    from deepspeed_tpu.telemetry import get_registry
+
+    model, params = tiny_model
+    c = get_registry().get(
+        "deepspeed_tpu_serving_slo_deadline_exceeded_total")
+    eng = _engine(model, params)
+    d0 = c.total()
+    # (1) queued request with an exhausted budget: expires before admission
+    u1 = eng.put(RaggedRequest(prompt_ids=_prompt(10), max_new_tokens=8,
+                               deadline_s=0.0))
+    out = eng.step()
+    assert out[u1] == {"tokens": [], "done": True,
+                      "finish_reason": "deadline"}
+    assert c.total() == d0 + 1
+    # (2) mid-decode: admit with a live budget, then let it run out
+    u2 = eng.put(RaggedRequest(prompt_ids=_prompt(10, 1), max_new_tokens=20,
+                               deadline_s=60.0))
+    for _ in range(3):
+        eng.step()
+    seq = eng._find_slotted(u2)
+    assert 0 < seq.generated < 20
+    seq.deadline = time.perf_counter() - 1.0  # budget exhausted mid-stream
+    out = eng.step()
+    assert out[u2]["done"] and out[u2]["finish_reason"] == "deadline"
+    assert c.total() == d0 + 2
+    eng.assert_no_leaks()
+    assert not eng.has_work()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_engine_priority_orders_admission(tiny_model):
+    model, params = tiny_model
+    cfg = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=64,
+                                max_seqs=1, max_pages_per_seq=12)
+    eng = InferenceEngineV2(model, cfg, params=params)
+    lo = eng.put(RaggedRequest(prompt_ids=_prompt(10), max_new_tokens=4,
+                               priority=PRIORITY_BATCH))
+    hi = eng.put(RaggedRequest(prompt_ids=_prompt(10, 1), max_new_tokens=4,
+                               priority=PRIORITY_INTERACTIVE))
+    got = {}
+
+    def pump():
+        for u, rec in eng.step().items():
+            got.setdefault(u, []).extend(rec["tokens"])
+
+    pump()
+    # one slot: the LATER-submitted interactive request got it
+    assert eng._find_slotted(hi).uid == hi
+    assert [s.uid for s in eng._queue] == [lo]
+    # FCFS within a class: both streams still complete
+    for _ in range(40):
+        if not eng.has_work():
+            break
+        pump()
+    assert len(got[lo]) == 4 and len(got[hi]) == 4
+    eng.close()
+
+
+@pytest.mark.slow
+def test_engine_priority_preempts_batch_under_pool_pressure(tiny_model):
+    from deepspeed_tpu.telemetry import get_registry
+
+    model, params = tiny_model
+    cfg = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=6,
+                                max_seqs=2, max_pages_per_seq=6)
+    eng = InferenceEngineV2(model, cfg, params=params)
+    pre = get_registry().get("deepspeed_tpu_serving_preemptions_total")
+    p0 = pre.total()
+    got = {}
+
+    def pump():
+        for u, rec in eng.step().items():
+            got.setdefault(u, []).extend(rec["tokens"])
+
+    lo = eng.put(RaggedRequest(prompt_ids=_prompt(32), max_new_tokens=16,
+                               priority=PRIORITY_BATCH))  # 4 of 6 pages
+    pump()
+    assert eng._find_slotted(lo).uid == lo
+    hi = eng.put(RaggedRequest(prompt_ids=_prompt(20, 1), max_new_tokens=8,
+                               priority=PRIORITY_INTERACTIVE))  # needs 3
+    pump()
+    # the batch sequence was evicted to make room for the interactive one
+    assert pre.total() == p0 + 1
+    assert eng._find_slotted(hi).uid == hi
+    assert lo in [s.uid for s in eng._queue]
+    # both still finish (batch re-prefills after the interactive frees)
+    for _ in range(80):
+        if not eng.has_work():
+            break
+        pump()
+    assert len(got[hi]) == 8 and len(got[lo]) == 16
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_decode_pool_pressure_never_evicts_more_urgent(tiny_model):
+    """Mid-decode page exhaustion: a batch sequence needing its next KV
+    page must self-preempt rather than evict a running interactive
+    sequence (the decode-path mirror of the admission victim rule)."""
+    model, params = tiny_model
+    cfg = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=4,
+                                max_seqs=2, max_pages_per_seq=4)
+    eng = InferenceEngineV2(model, cfg, params=params)
+    got, hi_done = {}, False
+    lo = eng.put(RaggedRequest(prompt_ids=_prompt(15), max_new_tokens=10,
+                               priority=PRIORITY_BATCH))
+    for u, rec in eng.step().items():  # admit the batch sequence alone
+        got.setdefault(u, []).extend(rec["tokens"])
+    hi = eng.put(RaggedRequest(prompt_ids=_prompt(9, 1), max_new_tokens=10,
+                               priority=PRIORITY_INTERACTIVE))
+    for _ in range(160):
+        if not eng.has_work():
+            break
+        for u, rec in eng.step().items():
+            got.setdefault(u, []).extend(rec["tokens"])
+            if u == hi and rec.get("done"):
+                hi_done = True
+        if (not hi_done and got.get(hi)
+                and eng._find_slotted(hi) is None):
+            raise AssertionError(
+                "interactive sequence was evicted by batch work")
+    assert len(got[hi]) == 10 and len(got[lo]) == 10
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_fleet_submit_failure_leaves_no_ghost_record(tiny_model):
+    """A submit() that fails for a non-shed reason (e.g. prompt too
+    long for the engine) must not leave a done=False record wedging
+    has_work() True forever."""
+    from deepspeed_tpu.serving import build_fleet
+
+    model, params = tiny_model
+    base = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=64,
+                                 max_seqs=4, max_pages_per_seq=12)
+    serving = ServingConfig(enabled=True, prefill_replicas=1,
+                            decode_replicas=1, disaggregated=True,
+                            prefill_chunk=8)
+    fleet = build_fleet(model, serving, engine_config=base, params=params)
+    with pytest.raises(ValueError):
+        fleet.submit(RaggedRequest(prompt_ids=_prompt(500),
+                                   max_new_tokens=4))
+    assert not fleet.has_work()
+    # the fleet still serves normally afterwards
+    u = fleet.submit(RaggedRequest(prompt_ids=_prompt(12), max_new_tokens=4))
+    while fleet.has_work():
+        fleet.step()
+    assert len(fleet.request_state(u)["emitted"]) == 4
+
+
+@pytest.mark.slow
+def test_fleet_flaky_replica_trips_breaker_streams_bit_identical(tiny_model):
+    from deepspeed_tpu.serving import build_fleet
+    from deepspeed_tpu.telemetry import get_registry
+
+    model, params = tiny_model
+    base = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=64,
+                                 max_seqs=4, max_pages_per_seq=12)
+    serving = ServingConfig(enabled=True, prefill_replicas=1,
+                            decode_replicas=2, disaggregated=True,
+                            prefill_chunk=8, breaker_consec_errors=3,
+                            breaker_cooldown_pumps=50)
+    fleet = build_fleet(model, serving, engine_config=base, params=params)
+    reqs = [RaggedRequest(prompt_ids=_prompt(18 + i, seed=i),
+                          max_new_tokens=8) for i in range(4)]
+    ctl = InferenceEngineV2(model, base, params=params)
+    want = ctl.generate_all([RaggedRequest(prompt_ids=list(r.prompt_ids),
+                                           max_new_tokens=8) for r in reqs])
+    want = [want[u] for u in sorted(want)]
+    uids = [fleet.submit(r) for r in reqs]
+    for _ in range(100):  # get streams onto the decode pool
+        fleet.step()
+        if any((fleet.request_state(u)["replica"] or "").startswith("decode")
+               for u in uids):
+            break
+    victim = next(n for n, r in fleet.replicas.items()
+                  if n.startswith("decode")
+                  and any(fleet.request_state(u)["replica"] == n
+                          for u in uids))
+    trips = get_registry().get(
+        "deepspeed_tpu_serving_slo_breaker_trips_total")
+    t0 = trips.total()
+    fleet.replicas[victim].inject_chaos(FlakyStep(fail_steps=3, seed=0))
+    for _ in range(300):
+        if not fleet.has_work():
+            break
+        fleet.step()
+    assert fleet.replicas[victim].breaker == BREAKER_OPEN
+    assert trips.total() == t0 + 1
+    got = [fleet.request_state(u)["emitted"] for u in uids]
+    assert got == want  # bit-identical through the gray failure
+    assert all(not fleet.request_state(u)["failed"] for u in uids)
+    ctl.close()

@@ -28,7 +28,10 @@ JSON summary line, and exits non-zero when any check fails — the
 acceptance gate for the resilience subsystem.
 
 Knobs: ``--out DIR`` (default ./chaos_drill_demo), ``--steps N`` total
-optimizer steps (default 8), ``--kill-step`` / ``--preempt-step``.
+optimizer steps (default 8), ``--kill-step`` / ``--preempt-step``,
+``--seed S`` (default 0: threads through the elastic agent's restart
+jitter, the staged-debris fabrication, and the bit-flip offset; logged
+in the summary so any chaos failure replays exactly).
 """
 
 from __future__ import annotations
@@ -155,8 +158,10 @@ def worker_main() -> int:
         log({"attempt": attempt, "step": step, "loss": loss})
         if attempt == 1 and engine.global_steps == kill_at:
             # simulate a SIGKILL landing mid-commit: partial staging
-            # debris on disk, no atexit, no flushes
-            chaos.make_partial_staging(ckpt_dir, f"killed_step{step}")
+            # debris on disk, no atexit, no flushes (seeded content)
+            chaos.make_partial_staging(ckpt_dir, f"killed_step{step}",
+                                       seed=int(os.environ.get(
+                                           "DRILL_SEED", "0")))
             log({"attempt": attempt, "event": "hard_kill", "step": step})
             chaos.kill_point(step, step)
         engine.save_checkpoint(ckpt_dir)
@@ -176,7 +181,8 @@ def _check(checks, name, ok, detail=""):
     return ok
 
 
-def run_demo(out: str, steps: int, kill_step: int, preempt_step: int) -> int:
+def run_demo(out: str, steps: int, kill_step: int, preempt_step: int,
+             seed: int = 0) -> int:
     from deepspeed_tpu.elasticity.elastic_agent import ElasticAgent
     from deepspeed_tpu.resilience import chaos
     from deepspeed_tpu.resilience import metrics as res_metrics
@@ -192,11 +198,12 @@ def run_demo(out: str, steps: int, kill_step: int, preempt_step: int) -> int:
     env = {"DRILL_DIR": out, "DRILL_TOOLS": _TOOLS_DIR,
            "DRILL_STEPS": str(steps), "DRILL_KILL_STEP": str(kill_step),
            "DRILL_PREEMPT_STEP": str(preempt_step),
+           "DRILL_SEED": str(seed),
            "JAX_PLATFORMS": "cpu"}
     agent = ElasticAgent(max_restarts=2, restart_delay_s=0.05,
-                         export_env=env, seed=0)
+                         export_env=env, seed=seed)
     print(f"chaos drill: {steps} steps, hard-kill at {kill_step}, "
-          f"preemption at {preempt_step} -> {out}")
+          f"preemption at {preempt_step}, seed {seed} -> {out}")
     rc = agent.run(script)
 
     checks = []
@@ -245,7 +252,8 @@ def run_demo(out: str, steps: int, kill_step: int, preempt_step: int) -> int:
     # corruption leg: bit-flip the newest tag; auto-resume must detect
     # it, count it, and fall back to the previous good tag
     newest = tags[0]
-    flipped_file, flip_off = chaos.bitflip_array(ckpt_dir, newest, seed=11)
+    flipped_file, flip_off = chaos.bitflip_array(ckpt_dir, newest,
+                                                 seed=seed + 11)
     corrupt_before = res_metrics.corrupt_checkpoints_total().total()
     resolved, report = resolve_tag(ckpt_dir)
     corrupt_after = res_metrics.corrupt_checkpoints_total().total()
@@ -263,6 +271,7 @@ def run_demo(out: str, steps: int, kill_step: int, preempt_step: int) -> int:
 
     ok = all(c["ok"] for c in checks)
     summary = {"demo": "chaos_drill", "ok": ok, "out": out, "steps": steps,
+               "seed": seed,
                "attempts": agent.attempts, "preemptions": agent.preemptions,
                "world_sizes": agent.world_sizes, "tags": tags,
                "checks": checks}
@@ -283,6 +292,10 @@ def main(argv=None) -> int:
                     help="hard-kill attempt 1 when global_steps hits this")
     ap.add_argument("--preempt-step", type=int, default=5,
                     help="simulated maintenance notice in attempt 2 at this step")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="threads through agent restart jitter, staging "
+                         "debris and the bit-flip offset; logged in the "
+                         "summary so any chaos failure replays exactly")
     args = ap.parse_args(argv)
     if not args.demo:
         ap.print_help()
@@ -290,7 +303,7 @@ def main(argv=None) -> int:
     if not (0 < args.kill_step < args.preempt_step < args.steps):
         ap.error("need 0 < --kill-step < --preempt-step < --steps")
     return run_demo(os.path.abspath(args.out), args.steps, args.kill_step,
-                    args.preempt_step)
+                    args.preempt_step, seed=args.seed)
 
 
 if __name__ == "__main__":

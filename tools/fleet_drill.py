@@ -20,16 +20,36 @@ weights:
   evacuates it (KV migration where possible, re-dispatch otherwise);
   streams again complete bit-identically, with the fleet degraded to
   the prefill replica decoding as a mixed fallback.
+* **Overload leg** (fresh SLO fleet) — a burst past the bounded queue:
+  low-priority submissions are shed loudly (``RejectedError`` with a
+  retry-after hint, counted in ``slo_shed_total``), high-priority ones
+  are never shed; a chaos ``PoolSqueeze`` then drives the KV pool over
+  the shed threshold and proves the pool-pressure rule too.
+* **Deadline leg** — requests with an exhausted ``deadline_s`` budget
+  expire at the step boundary with ``finish_reason="deadline"``
+  (counted in ``slo_deadline_exceeded_total``) instead of waiting
+  forever; undeadlined requests in the same wave run to completion
+  bit-identically.
+* **Slow-replica leg** — a chaos ``SlowReplica`` drags one decode
+  replica's step latency; the circuit breaker trips (sustained MEDIAN
+  step latency > k x the same-role fleet median — a lone spike lifts
+  only p95 and never trips), the replica is drained of placement, its
+  streams finish
+  elsewhere **bit-identical** to the control, and after the cooldown
+  the breaker recovers through half-open probing on live traffic.
 * **Metric-name lint** — the run registers the
-  ``deepspeed_tpu_serving_fleet_*`` family, then
-  ``tools/check_metric_names.py`` must pass over the tree and see it.
+  ``deepspeed_tpu_serving_fleet_*`` + ``deepspeed_tpu_serving_slo_*``
+  families, then ``tools/check_metric_names.py`` must pass over the
+  tree and see them.
 
 Writes ``fleet_drill.json`` under ``--out``, prints ONE JSON summary
 line, and exits non-zero when any check fails — the acceptance gate for
 the serving-fleet subsystem.
 
 Knobs: ``--out DIR`` (default ./fleet_drill_demo), ``--requests N``
-(default 6), ``--new-tokens N`` (default 10).
+(default 6), ``--new-tokens N`` (default 10), ``--seed S`` (default 7:
+threads through prompt generation AND every chaos injector, so any
+failure replays from the seed logged in the summary).
 """
 
 from __future__ import annotations
@@ -43,6 +63,8 @@ import sys
 _TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
 _REPO_DIR = os.path.dirname(_TOOLS_DIR)
 sys.path.insert(0, _REPO_DIR)
+if _TOOLS_DIR not in sys.path:  # in-process entrypoint call (tests)
+    sys.path.insert(1, _TOOLS_DIR)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -57,7 +79,7 @@ def _check(checks, name, ok, detail=""):
     return ok
 
 
-def _build(n_requests: int, new_tokens: int):
+def _build(n_requests: int, new_tokens: int, seed: int = 7):
     import jax
     import numpy as np
 
@@ -78,15 +100,15 @@ def _build(n_requests: int, new_tokens: int):
                             affinity_pages=2, prefill_chunk=PAGE_SIZE)
     fleet = build_fleet(model, serving, engine_config=base, params=params)
 
-    rng = np.random.RandomState(7)
+    rng = np.random.RandomState(seed)
     vocab = model.config.vocab_size
     prefix = list(rng.randint(0, vocab, PREFIX_TOKENS))
 
-    def make_requests(n, salt):
-        rq = np.random.RandomState(100 + salt)
+    def make_requests(n, salt, **kw):
+        rq = np.random.RandomState(seed * 100 + salt)
         return [RaggedRequest(
             prompt_ids=prefix + list(rq.randint(0, vocab, 3 + i)),
-            max_new_tokens=new_tokens) for i in range(n)]
+            max_new_tokens=new_tokens, **kw) for i in range(n)]
 
     def control_run(requests):
         """Fresh single engine on the same weights; greedy, so the
@@ -98,21 +120,54 @@ def _build(n_requests: int, new_tokens: int):
         eng.close()
         return [got[i] for i in range(len(requests))]
 
-    return fleet, make_requests, control_run
+    def build_slo_fleet():
+        """Fresh 1-prefill + 2-decode fleet with the overload knobs on:
+        bounded queue, pool-pressure shedding, tight breaker windows.
+        Prefix cache off so a PoolSqueeze can drive occupancy to 1.0
+        (no LRU-parked pages keeping ``free_pages`` high)."""
+        slo_base = RaggedInferenceConfig(dtype="fp32", page_size=PAGE_SIZE,
+                                         num_pages=48, max_seqs=4,
+                                         max_pages_per_seq=12)
+        slo_serving = ServingConfig(
+            enabled=True, prefill_replicas=1, decode_replicas=2,
+            disaggregated=True, affinity_pages=2, prefill_chunk=PAGE_SIZE,
+            max_queue_depth=4, shed_occupancy=0.85, protect_priority=0,
+            breaker_latency_factor=3.0, breaker_window=16,
+            breaker_min_samples=4, breaker_consec_errors=3,
+            breaker_cooldown_pumps=6, breaker_probe_steps=3,
+            breaker_min_latency_s=0.0005)
+        fl = build_fleet(model, slo_serving, engine_config=slo_base,
+                         params=params)
+        ctl = InferenceEngineV2(model, slo_base, params=params)
+
+        def slo_control(requests):
+            # one long-lived control engine: generate_all returns only
+            # this call's uids (auto-increment => sorted = submit order)
+            got = ctl.generate_all([RaggedRequest(
+                prompt_ids=list(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens) for r in requests])
+            return [got[u] for u in sorted(got)]
+
+        return fl, slo_control
+
+    return fleet, make_requests, control_run, build_slo_fleet
 
 
-def run_demo(out: str, n_requests: int, new_tokens: int) -> int:
+def run_demo(out: str, n_requests: int, new_tokens: int,
+             seed: int = 7) -> int:
     from deepspeed_tpu.telemetry import get_registry
 
     shutil.rmtree(out, ignore_errors=True)
     os.makedirs(out)
     print(f"fleet drill: {n_requests} requests x {new_tokens} tokens, "
-          f"1 prefill + 2 decode replicas -> {out}")
-    fleet, make_requests, control_run = _build(n_requests, new_tokens)
+          f"1 prefill + 2 decode replicas, seed {seed} -> {out}")
+    fleet, make_requests, control_run, build_slo_fleet = _build(
+        n_requests, new_tokens, seed)
     reg = get_registry()
 
     def counter(name):
-        return reg.counter(name, "").total()
+        m = reg.get(name)  # get, not get-or-create: some slo_* metrics
+        return m.total() if m is not None else 0.0  # carry labels
 
     checks = []
 
@@ -208,6 +263,173 @@ def run_demo(out: str, n_requests: int, new_tokens: int) -> int:
            f"{sum(1 for r in fleet.replicas.values() if r.alive)} "
            "replicas audited")
 
+    # ======== SLO legs: fresh fleet with overload knobs on ========
+    from deepspeed_tpu.inference.v2 import (PRIORITY_BATCH,
+                                            PRIORITY_INTERACTIVE,
+                                            RejectedError)
+    from deepspeed_tpu.resilience.chaos import PoolSqueeze, SlowReplica
+
+    slo_fleet, slo_control = build_slo_fleet()
+
+    # ---- leg 3: overload -> bounded-queue shedding by priority
+    print("  leg 3: overload (bounded queue + pool squeeze)")
+    shed0 = counter("deepspeed_tpu_serving_slo_shed_total")
+    lows = make_requests(4, salt=3, priority=PRIORITY_BATCH)
+    low_uids = [slo_fleet.submit(r) for r in lows]  # fills queue to 4
+    shed_lows = 0
+    for r in make_requests(2, salt=4, priority=PRIORITY_BATCH):
+        try:
+            slo_fleet.submit(r)
+        except RejectedError as e:
+            shed_lows += 1
+            _check(checks, "shed_carries_retry_hint_and_reason",
+                   e.retry_after_s > 0 and e.reason == "queue_full",
+                   f"reason={e.reason} retry_after={e.retry_after_s}")
+    highs = make_requests(2, salt=5, priority=PRIORITY_INTERACTIVE)
+    high_shed = 0
+    high_uids = []
+    for r in highs:
+        try:
+            high_uids.append(slo_fleet.submit(r))
+        except RejectedError:
+            high_shed += 1
+    _check(checks, "overload_sheds_only_low_priority",
+           shed_lows == 2 and high_shed == 0,
+           f"{shed_lows} low shed, {high_shed} high shed")
+    want_slo = slo_control(lows + highs)
+    for _ in range(400):
+        if not slo_fleet.has_work():
+            break
+        slo_fleet.step()
+    got_slo = [slo_fleet.request_state(u)["emitted"]
+               for u in low_uids + high_uids]
+    _check(checks, "admitted_overload_streams_bit_identical",
+           got_slo == want_slo,
+           f"{sum(g == w for g, w in zip(got_slo, want_slo))}"
+           f"/{len(want_slo)} match")
+    # pool-pressure rule: squeeze the prefill pool's free pages, then a
+    # low-priority submit sheds while a high-priority one is admitted
+    pf = slo_fleet.replicas["prefill0"]
+    with PoolSqueeze(pf.engine, pf.engine.allocator.num_pages):
+        try:
+            slo_fleet.submit(make_requests(1, salt=6,
+                                           priority=PRIORITY_BATCH)[0])
+            squeezed_shed = False
+        except RejectedError as e:
+            squeezed_shed = (e.reason == "pool_pressure")
+        hp = make_requests(1, salt=7, priority=PRIORITY_INTERACTIVE)[0]
+        hp_uid = slo_fleet.submit(hp)  # protected: admitted, waits
+    for _ in range(200):  # squeeze released: the protected request runs
+        if not slo_fleet.has_work():
+            break
+        slo_fleet.step()
+    _check(checks, "pool_squeeze_sheds_low_admits_high",
+           squeezed_shed
+           and slo_fleet.request_state(hp_uid)["emitted"]
+           == slo_control([hp])[0])
+    shed_delta = counter("deepspeed_tpu_serving_slo_shed_total") - shed0
+    _check(checks, "every_shed_counted", shed_delta == shed_lows + 1,
+           f"slo_shed_total +{shed_delta} for {shed_lows + 1} sheds")
+
+    # ---- leg 4: deadlines fire at the step boundary
+    print("  leg 4: deadlines")
+    dl0 = counter("deepspeed_tpu_serving_slo_deadline_exceeded_total")
+    doomed = make_requests(2, salt=8, priority=PRIORITY_BATCH,
+                           deadline_s=0.0)
+    healthy = make_requests(2, salt=9)
+    doomed_uids = [slo_fleet.submit(r) for r in doomed]
+    healthy_uids = [slo_fleet.submit(r) for r in healthy]
+    want_h = slo_control(healthy)
+    for _ in range(200):
+        if not slo_fleet.has_work():
+            break
+        slo_fleet.step()
+    doomed_states = [slo_fleet.request_state(u) for u in doomed_uids]
+    _check(checks, "deadlines_fire_with_finish_reason",
+           all(s["done"] and s["finish_reason"] == "deadline"
+               and s["emitted"] == [] for s in doomed_states),
+           [s["finish_reason"] for s in doomed_states])
+    dl_delta = counter(
+        "deepspeed_tpu_serving_slo_deadline_exceeded_total") - dl0
+    _check(checks, "every_expiry_counted", dl_delta == len(doomed_uids),
+           f"slo_deadline_exceeded_total +{dl_delta}")
+    _check(checks, "undeadlined_wave_bit_identical",
+           [slo_fleet.request_state(u)["emitted"]
+            for u in healthy_uids] == want_h)
+
+    # ---- leg 5: slow replica -> breaker trip -> bit-identical finish
+    # -> half-open recovery on live traffic
+    print("  leg 5: slow replica (gray failure)")
+    trips0 = counter("deepspeed_tpu_serving_slo_breaker_trips_total")
+    rec0 = counter("deepspeed_tpu_serving_slo_breaker_recoveries_total")
+    # interactive priority: the SLO fleet's bounded queue stays armed
+    # (max_queue_depth=4) and this wave is submitted in one burst —
+    # protected traffic must ride through, which is itself the contract
+    wave = make_requests(n_requests, salt=10, priority=PRIORITY_INTERACTIVE)
+    want_w = slo_control(wave)
+    wave_uids = [slo_fleet.submit(r) for r in wave]
+    for _ in range(200):  # get streams decoding on the decode pool
+        slo_fleet.step()
+        states = [slo_fleet.request_state(u) for u in wave_uids]
+        if any((s["replica"] or "").startswith("decode")
+               and 1 <= len(s["emitted"]) < new_tokens for s in states):
+            break
+    hosts = {}
+    for s in states:
+        if (s["replica"] or "").startswith("decode"):
+            hosts[s["replica"]] = hosts.get(s["replica"], 0) + 1
+    slow_name = max(hosts, key=hosts.get) if hosts else "decode0"
+    print(f"    injecting 80ms step delay into {slow_name} "
+          f"(hosting {hosts.get(slow_name, 0)} stream(s))")
+    slow = slo_fleet.replicas[slow_name]
+    slow.inject_chaos(SlowReplica(delay_s=0.08, seed=seed))
+    tripped = False
+    for _ in range(100):
+        slo_fleet.step()
+        if slow.breaker == "open":
+            tripped = True
+            break
+    _check(checks, "slow_replica_breaker_tripped", tripped,
+           f"{slow_name} p50={slow.step_p50() * 1e3:.1f}ms "
+           f"p95={slow.step_p95() * 1e3:.1f}ms")
+    _check(checks, "breaker_trip_counted",
+           counter("deepspeed_tpu_serving_slo_breaker_trips_total")
+           == trips0 + 1)
+    slow.clear_chaos()  # the operator fixed the host
+    for _ in range(400):
+        if not slo_fleet.has_work():
+            break
+        slo_fleet.step()
+    got_w = [slo_fleet.request_state(u)["emitted"] for u in wave_uids]
+    _check(checks, "slow_leg_bit_identical_to_single_engine",
+           got_w == want_w,
+           f"{sum(g == w for g, w in zip(got_w, want_w))}/{len(want_w)} "
+           "match")
+    # recovery: cooldown -> half_open probe on live traffic -> closed
+    wave2 = make_requests(max(2, n_requests // 2), salt=11,
+                          priority=PRIORITY_INTERACTIVE)
+    want_w2 = slo_control(wave2)
+    w2_uids = [slo_fleet.submit(r) for r in wave2]
+    for _ in range(400):
+        if not slo_fleet.has_work() and slow.breaker == "closed":
+            break
+        slo_fleet.step()
+    _check(checks, "breaker_recovered_via_half_open_probe",
+           slow.breaker == "closed" and slow.accepts_new()
+           and counter("deepspeed_tpu_serving_slo_breaker_recoveries_total")
+           == rec0 + 1, f"breaker={slow.breaker}")
+    _check(checks, "post_recovery_wave_bit_identical",
+           [slo_fleet.request_state(u)["emitted"]
+            for u in w2_uids] == want_w2)
+    slo_leaks = []
+    for name, rep in slo_fleet.replicas.items():
+        if rep.alive:
+            try:
+                rep.engine.assert_no_leaks()
+            except AssertionError as e:
+                slo_leaks.append(f"{name}: {e}")
+    _check(checks, "slo_fleet_no_leaks", not slo_leaks, slo_leaks[:2])
+
     # ---- metric-name lint over the tree (fleet family included)
     import check_metric_names as lint
 
@@ -218,16 +440,24 @@ def run_demo(out: str, n_requests: int, new_tokens: int) -> int:
            errors[:3] if errors else f"{len(fleet_names)} fleet metrics")
     _check(checks, "fleet_metric_family_registered", len(fleet_names) >= 8,
            fleet_names[:4])
+    slo_names = sorted(n for n in lint.collect(_REPO_DIR)
+                       if n.startswith("deepspeed_tpu_serving_slo_"))
+    _check(checks, "slo_metric_family_registered", len(slo_names) >= 8,
+           slo_names[:4])
 
     ok = all(c["ok"] for c in checks)
-    summary = {"demo": "fleet_drill", "ok": ok, "out": out,
+    summary = {"demo": "fleet_drill", "ok": ok, "out": out, "seed": seed,
                "requests": n_requests + len(reqs2),
-               "victim": victim, "health": fleet.health(),
-               "fleet_metrics": fleet_names, "checks": checks}
+               "victim": victim, "slow_replica": slow_name,
+               "health": fleet.health(),
+               "slo_health": slo_fleet.health(),
+               "fleet_metrics": fleet_names, "slo_metrics": slo_names,
+               "checks": checks}
     with open(os.path.join(out, "fleet_drill.json"), "w") as f:
         json.dump(summary, f, indent=2)
     print(json.dumps({k: v for k, v in summary.items()
-                      if k not in ("checks", "health", "fleet_metrics")}))
+                      if k not in ("checks", "health", "slo_health",
+                                   "fleet_metrics", "slo_metrics")}))
     return 0 if ok else 1
 
 
@@ -239,6 +469,10 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="./fleet_drill_demo")
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--new-tokens", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7,
+                    help="threads through prompt generation and every "
+                         "chaos injector; logged in the summary so any "
+                         "failure replays exactly")
     args = ap.parse_args(argv)
     if not args.demo:
         ap.print_help()
@@ -246,7 +480,8 @@ def main(argv=None) -> int:
     if args.requests < 2 or args.new_tokens < 4:
         ap.error("need --requests >= 2 and --new-tokens >= 4 for a "
                  "meaningful mid-stream kill")
-    return run_demo(os.path.abspath(args.out), args.requests, args.new_tokens)
+    return run_demo(os.path.abspath(args.out), args.requests,
+                    args.new_tokens, seed=args.seed)
 
 
 if __name__ == "__main__":
