@@ -298,6 +298,13 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     # perf trajectory instead of silently masquerading as a regression
     # (BENCH_r03–r05 did exactly that; ROADMAP item 5).
     result["comparable"] = jax.default_backend() != "cpu"
+    # provenance: which program contracts (tests/contracts/*.json) this
+    # result ran under — a perf claim is only comparable to another run
+    # with the same contract-set hash (same collectives, same donation)
+    from deepspeed_tpu.analysis.contracts import contract_set_hash
+
+    result["contract_set_hash"] = contract_set_hash(
+        os.path.dirname(os.path.abspath(__file__)))
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         # gate on backend: a leaked env var must not mislabel a real TPU run

@@ -1,0 +1,38 @@
+"""Program-contract static analysis (docs/STATIC_ANALYSIS.md).
+
+Two pillars, both enforced in tier-1:
+
+* :mod:`~deepspeed_tpu.analysis.contracts` — HLO cost contracts: lower
+  the representative tiny programs (train step at ZeRO stages 0/1/3,
+  engine_v2 prefill/decode/paged_verify) on CPU and pin their collective
+  counts, FLOPs, bytes accessed, donation, shape signature, and replay
+  recompile counts against golden JSON under ``tests/contracts/``.
+* :mod:`~deepspeed_tpu.analysis.lint` — the JAX-hazard AST linter
+  (host syncs on hot paths, wall-clock/unseeded randomness in
+  deterministic paths, swallowed exceptions, mutable defaults,
+  order-dependent iteration in sharding code).
+* :mod:`~deepspeed_tpu.analysis.metric_lint` — the metric/span-name
+  lint (moved here from ``tools/check_metric_names.py``, which remains
+  as a thin shim).
+
+``lint`` and ``metric_lint`` are pure-AST and self-contained: the lint
+drivers under ``tools/`` load them by file path so they run without jax
+or a package install.  Importing them *through* this package is also
+fine (lazy attributes below keep this module itself import-light).
+"""
+
+from __future__ import annotations
+
+_SUBMODULES = ("contracts", "lint", "metric_lint")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_SUBMODULES))

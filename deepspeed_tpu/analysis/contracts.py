@@ -1,0 +1,448 @@
+"""HLO cost contracts: machine-checked program shape for the hot paths.
+
+A *contract* pins what a compiled program is allowed to look like: its
+collective op counts by kind, FLOPs, bytes accessed, donated-input
+count, argument shape signature (``compile/backend.py``), structural
+state bytes, and — for the train programs — the recompile count of a
+3-step replay.  Contracts are extracted by lowering representative tiny
+programs on CPU (``jax.jit(...).lower().compile()``, 8 virtual devices,
+the same harness as tier-1) and stored as golden JSON under
+``tests/contracts/``.
+
+Why: BENCH_r03–r05 recorded a CPU fallback and nothing caught it;
+an extra all-gather, a lost fusion, or a steady-state recompile is
+invisible until someone eyeballs a trace (ROADMAP item 5).  With the
+goldens in tier-1, "stage-3 train step grew all-gather 24→26" is a
+named test failure at review time — and the upcoming overlap /
+quantized-collective work can assert "same collectives, fewer exposed"
+without a TPU.
+
+Drivers: ``tools/check_contracts.py`` (standalone + ``--update-goldens``)
+and ``tools/dstpu_lint.py --all`` (merged report).  jax imports are
+function-local so importing this module stays cheap for the lint
+drivers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+#: collective opcodes counted in optimized HLO (async ``-start`` forms
+#: count once; their ``-done`` halves are ignored)
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+#: relative tolerances for the scalar cost fields — XLA cost analysis is
+#: deterministic for an identical program, but minor layout/fusion
+#: nondeterminism must not flap tier-1; collectives/donation/shapes
+#: compare EXACTLY
+DEFAULT_TOLERANCES = {"flops": 0.05, "bytes_accessed": 0.10}
+
+#: goldens live here, relative to the repo root
+CONTRACTS_DIR = os.path.join("tests", "contracts")
+
+
+# ------------------------------------------------------------- extraction
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Count collective ops by kind in optimized HLO text."""
+    out = {}
+    for kind in COLLECTIVE_KINDS:
+        out[kind] = len(re.findall(
+            rf"=\s*\S+\s+{kind}(?:-start)?\(", hlo_text))
+    return out
+
+
+def donated_input_count(stablehlo_text: str) -> int:
+    """Donated input leaves, from the lowering's aliasing attributes."""
+    return len(re.findall(r"tf\.aliasing_output", stablehlo_text))
+
+
+def shape_signature_strings(*trees: Any) -> List[str]:
+    """The ``compile/backend.py`` shape signature, as stable strings."""
+    from ..compile.backend import shape_signature
+
+    return [f"{dtype}{list(shape)}"
+            for shape, dtype in shape_signature(*trees)]
+
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def extract_contract(jit_fn, args: Sequence[Any],
+                     mesh: Any = None) -> Dict[str, Any]:
+    """Lower + compile ``jit_fn(*args)`` and extract its contract dict
+    (the compared section only; callers add replay/state fields)."""
+    import contextlib
+
+    ctx = mesh if mesh is not None else contextlib.nullcontext()
+    with ctx:
+        lowered = jit_fn.lower(*args)
+        compiled = lowered.compile()
+    cost = _cost_dict(compiled)
+    return {
+        "collectives": collective_counts(compiled.as_text()),
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "donated_inputs": donated_input_count(lowered.as_text()),
+        "arg_shapes": shape_signature_strings(*args),
+    }
+
+
+# ------------------------------------------------- representative programs
+def _mlp_spec(hidden: int = 16, nlayers: int = 2):
+    """The tiny MLP regression model (mirrors tests/unit/simple_model.py;
+    re-stated here because package code must not import the test tree)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..runtime.module import ModelSpec
+
+    def init_params(rng):
+        keys = jax.random.split(rng, nlayers)
+        params = {}
+        for i, k in enumerate(keys):
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(k, (hidden, hidden)) * 0.1,
+                "b": jnp.zeros((hidden,)),
+            }
+        return params
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        for i in range(nlayers):
+            layer = params[f"layer_{i}"]
+            x = x @ layer["w"] + layer["b"]
+            if i < nlayers - 1:
+                x = jax.nn.relu(x)
+        return jnp.mean((x - y.astype(x.dtype)) ** 2)
+
+    return ModelSpec(init_params, loss_fn)
+
+
+def _train_batch_arrays(hidden: int = 16, batch: int = 16):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(1, batch, hidden).astype(np.float32)  # leading gas dim
+    ys = xs * 0.5
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def _train_program(stage: int, offload: bool = False, qgz: bool = False,
+                   replay: bool = True) -> Dict[str, Any]:
+    import jax
+
+    import deepspeed_tpu
+    from ..telemetry.memory import tree_bytes
+
+    zero_cfg: Dict[str, Any] = {"stage": stage}
+    if offload:
+        zero_cfg["offload_optimizer"] = {"device": "cpu"}
+    if qgz:
+        zero_cfg["zero_quantized_gradients"] = True
+    engine, *_ = deepspeed_tpu.initialize(model=_mlp_spec(), config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": zero_cfg,
+    })
+    batch = _train_batch_arrays()
+    args = (engine.state, batch, jax.random.PRNGKey(0))
+    dev_b, host_b = tree_bytes(engine.state)
+    extras = {"state_bytes_device": int(dev_b),
+              "state_bytes_host": int(host_b)}
+    replay_fn = (lambda: _replay_train(engine, batch)) if replay else None
+    return {"fn": engine._train_batch, "args": args,
+            "mesh": engine.topology.mesh, "extras": extras,
+            "replay": replay_fn}
+
+
+def _replay_train(engine, batch, steps: int = 3) -> Dict[str, Any]:
+    """Run the tiny train loop for ``steps`` same-shape steps and count
+    XLA backend compiles AFTER the first step.  The contract pins this
+    at 0: shape-signature churn (weak types, donation mismatch,
+    non-hashable statics) shows up here as a nonzero count — the
+    machine-checked form of what the PR 3 sentinel only warns about at
+    runtime."""
+    from ..telemetry.compile_sentinel import (compile_counts,
+                                              install_compile_listener)
+
+    monitoring = install_compile_listener()
+    engine.train_batch(batch)  # warmup step: compiles are expected here
+    c0, _ = compile_counts()
+    for _ in range(2):
+        engine.train_batch(batch)
+    c1, _ = compile_counts()
+    return {"steps": 3,
+            "compiles_after_warmup": (int(c1 - c0) if monitoring else None)}
+
+
+def _v2_engine():
+    import jax
+
+    from ..inference.v2 import (InferenceEngineV2, RaggedInferenceConfig,
+                                SpeculativeConfig)
+    from ..models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=64)
+    params = model.init_params(jax.random.PRNGKey(0))
+    return InferenceEngineV2(model, RaggedInferenceConfig(
+        dtype="fp32", page_size=8, num_pages=32, max_seqs=2,
+        max_pages_per_seq=8,
+        speculative=SpeculativeConfig(mode="ngram", k=3)), params=params)
+
+
+def _v2_extras(eng) -> Dict[str, Any]:
+    from ..telemetry.memory import tree_bytes
+
+    pool_dev, _ = tree_bytes(eng._pools)
+    return {"param_bytes": int(eng.param_bytes),
+            "kv_pool_bytes": int(pool_dev)}
+
+
+def _prefill_program() -> Dict[str, Any]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _v2_engine()
+    ps = eng.block.page_size
+    bucket = eng._bucket(13)
+    ids = np.zeros((bucket,), np.int32)
+    rows = np.full((bucket // ps,), eng.block.trash_page, np.int32)
+    args = (eng.params, eng._pools, jnp.asarray(ids), jnp.asarray(rows),
+            jnp.int32(13))
+    return {"fn": eng._prefill, "args": args, "mesh": None,
+            "extras": _v2_extras(eng), "replay": None}
+
+
+def _decode_program() -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _v2_engine()
+    B = eng.block.max_seqs
+    args = (eng.params, eng._pools,
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(eng._page_table),
+            jnp.asarray(np.zeros((B,), bool)),
+            jnp.asarray(np.zeros((B,), np.float32)),
+            jax.random.PRNGKey(0), jnp.asarray(1, jnp.uint32))
+    return {"fn": eng._decode, "args": args, "mesh": None,
+            "extras": _v2_extras(eng), "replay": None}
+
+
+def _verify_program() -> Dict[str, Any]:
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _v2_engine()
+    B, W = eng.block.max_seqs, eng.spec.k + 1
+    args = (eng.params, eng._pools,
+            jnp.asarray(np.zeros((B, W), np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(eng._page_table),
+            jnp.asarray(np.zeros((B,), bool)),
+            jnp.asarray(np.ones((B,), np.int32)))
+    return {"fn": eng._verify, "args": args, "mesh": None,
+            "extras": _v2_extras(eng), "replay": None}
+
+
+#: name -> (builder, description).  The builder returns the dict
+#: consumed by :func:`extract_program`; descriptions land in the golden
+#: JSON so a diff reader knows what program regressed.
+PROGRAM_BUILDERS: Dict[str, Tuple[Callable[[], Dict[str, Any]], str]] = {
+    "train_step_zero0": (
+        lambda: _train_program(0),
+        "fused train step, ZeRO stage 0 (replicated; grad psum over data)"),
+    "train_step_zero1": (
+        lambda: _train_program(1),
+        "fused train step, ZeRO stage 1 (optimizer state sharded)"),
+    "train_step_zero3": (
+        lambda: _train_program(3),
+        "fused train step, ZeRO stage 3 (params sharded; per-use gathers)"),
+    "train_step_zero3_offload": (
+        lambda: _train_program(3, offload=True, replay=False),
+        "micro-step scan with host-offloaded optimizer (ZeRO-Offload: "
+        "device program is fwd+bwd+accumulate only)"),
+    "train_step_zero1_qgz": (
+        lambda: _train_program(1, qgz=True, replay=False),
+        "fused train step, ZeRO stage 1 + ZeRO++ qgZ int8 all-to-all "
+        "gradient reduce"),
+    "prefill": (
+        _prefill_program,
+        "engine_v2 paged prefill, one bucket-16 prompt"),
+    "decode": (
+        _decode_program,
+        "engine_v2 paged decode + on-device sampling, all slots"),
+    "paged_verify": (
+        _verify_program,
+        "engine_v2 speculative batched verify (width k+1) + greedy argmax"),
+}
+
+
+def extract_program(name: str) -> Dict[str, Any]:
+    """Build + lower one named program; returns its full golden dict."""
+    import jax
+
+    builder, description = PROGRAM_BUILDERS[name]
+    prog = builder()
+    contract = extract_contract(prog["fn"], prog["args"], prog["mesh"])
+    contract.update(prog["extras"])
+    if prog["replay"] is not None:
+        contract["replay"] = prog["replay"]()
+    return {
+        "program": name,
+        "contract": contract,
+        "tolerances": dict(DEFAULT_TOLERANCES),
+        "info": {
+            "description": description,
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "jax_version": jax.__version__,
+        },
+    }
+
+
+def extract_all(programs: Optional[Sequence[str]] = None
+                ) -> Dict[str, Dict[str, Any]]:
+    names = list(programs) if programs else list(PROGRAM_BUILDERS)
+    unknown = [n for n in names if n not in PROGRAM_BUILDERS]
+    if unknown:
+        raise KeyError(f"unknown contract program(s) {unknown}; known: "
+                       f"{sorted(PROGRAM_BUILDERS)}")
+    return {name: extract_program(name) for name in names}
+
+
+# ------------------------------------------------------------------ diffs
+def _rel_close(a: float, b: float, tol: float) -> bool:
+    if a == b:
+        return True
+    denom = max(abs(a), abs(b), 1e-12)
+    return abs(a - b) / denom <= tol
+
+
+def diff_contract(name: str, golden: Dict[str, Any],
+                  got: Dict[str, Any]) -> List[str]:
+    """Named, actionable differences between a golden and an extracted
+    contract.  Empty list = contract holds."""
+    errs: List[str] = []
+    g, n = golden.get("contract", {}), got.get("contract", {})
+    tol = {**DEFAULT_TOLERANCES, **golden.get("tolerances", {})}
+
+    gc, nc = g.get("collectives", {}), n.get("collectives", {})
+    for kind in COLLECTIVE_KINDS:
+        a, b = int(gc.get(kind, 0)), int(nc.get(kind, 0))
+        if a != b:
+            verb = "grew" if b > a else "dropped"
+            errs.append(f"{name}: {verb} {kind} {a} -> {b} "
+                        f"({b - a:+d} collective(s) vs the golden contract)")
+    for field in ("flops", "bytes_accessed"):
+        a, b = float(g.get(field, 0.0)), float(n.get(field, 0.0))
+        if not (math.isfinite(a) and math.isfinite(b)
+                and _rel_close(a, b, tol.get(field, 0.0))):
+            errs.append(f"{name}: {field} {a:.6g} -> {b:.6g} "
+                        f"(beyond the {tol.get(field, 0.0):.0%} tolerance)")
+    a, b = g.get("donated_inputs"), n.get("donated_inputs")
+    if a != b:
+        errs.append(f"{name}: donated inputs {a} -> {b} (a lost donation "
+                    "doubles that buffer's HBM)")
+    if g.get("arg_shapes") != n.get("arg_shapes"):
+        errs.append(f"{name}: arg shape signature changed "
+                    f"{g.get('arg_shapes')} -> {n.get('arg_shapes')} "
+                    "(every caller recompiles)")
+    for field in ("state_bytes_device", "state_bytes_host", "param_bytes",
+                  "kv_pool_bytes"):
+        if field in g or field in n:
+            a, b = g.get(field), n.get(field)
+            if a != b:
+                errs.append(f"{name}: {field} {a} -> {b}")
+    gr, nr = g.get("replay"), n.get("replay")
+    if gr is not None or nr is not None:
+        ga = (gr or {}).get("compiles_after_warmup")
+        na = (nr or {}).get("compiles_after_warmup")
+        # None = jax.monitoring unavailable on one side; not comparable
+        if ga is not None and na is not None and ga != na:
+            errs.append(
+                f"{name}: {(nr or {}).get('steps', 3)}-step replay "
+                f"recompiled {na}x after warmup (golden {ga}) — "
+                "shape-signature churn in the steady-state step")
+    return errs
+
+
+def diff_all(goldens: Dict[str, Dict[str, Any]],
+             got: Dict[str, Dict[str, Any]]) -> List[str]:
+    errs: List[str] = []
+    for name in sorted(set(goldens) | set(got)):
+        if name not in goldens:
+            errs.append(f"{name}: extracted but no golden checked in — "
+                        "run tools/check_contracts.py --update-goldens")
+        elif name not in got:
+            errs.append(f"{name}: golden exists but the program is gone "
+                        "from PROGRAM_BUILDERS (delete the golden or "
+                        "restore the program)")
+        else:
+            errs.extend(diff_contract(name, goldens[name], got[name]))
+    return errs
+
+
+# ---------------------------------------------------------------- goldens
+def goldens_dir(root: str) -> str:
+    return os.path.join(root, CONTRACTS_DIR)
+
+
+def load_goldens(root: str) -> Dict[str, Dict[str, Any]]:
+    d = goldens_dir(root)
+    out: Dict[str, Dict[str, Any]] = {}
+    if not os.path.isdir(d):
+        return out
+    for fn in sorted(os.listdir(d)):
+        if fn.endswith(".json"):
+            with open(os.path.join(d, fn)) as f:
+                data = json.load(f)
+            out[data.get("program", fn[:-5])] = data
+    return out
+
+
+def write_goldens(root: str, contracts: Dict[str, Dict[str, Any]]) -> List[str]:
+    d = goldens_dir(root)
+    os.makedirs(d, exist_ok=True)
+    written = []
+    for name, data in sorted(contracts.items()):
+        path = os.path.join(d, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        written.append(path)
+    return written
+
+
+def contract_set_hash(root: str) -> str:
+    """sha256 over the checked-in goldens (stdlib only — bench.py stamps
+    this into its JSON so a perf artifact is traceable to the exact
+    program contracts it ran under).  Returns the literal ``"no-goldens"``
+    when none are present: a hash-of-nothing would let two artifacts from
+    different program contracts compare as 'same contract set' — the
+    exact masquerading this field exists to prevent."""
+    h = hashlib.sha256()
+    d = goldens_dir(root)
+    n = 0
+    if os.path.isdir(d):
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".json"):
+                h.update(fn.encode())
+                with open(os.path.join(d, fn), "rb") as f:
+                    h.update(f.read())
+                n += 1
+    return h.hexdigest() if n else "no-goldens"

@@ -110,7 +110,8 @@ class ResourceManager:
         return None
 
     def _worker(self, exp: Dict[str, Any], res: Reservation) -> None:
-        t0 = time.time()
+        # perf_counter, not time.time(): elapsed must survive an NTP step
+        t0 = time.perf_counter()
         try:
             tput = self.runner(exp, res)
             err = None
@@ -122,7 +123,7 @@ class ResourceManager:
                 "exp_id": exp["exp_id"], "name": exp["name"],
                 "config": exp.get("config"), "throughput": tput,
                 "error": err, "host": res.node.host,
-                "elapsed": time.time() - t0,
+                "elapsed": time.perf_counter() - t0,
             })
             del self._running[exp["exp_id"]]
             self._cv.notify_all()

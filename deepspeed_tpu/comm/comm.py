@@ -352,13 +352,19 @@ def monitored_barrier(name: str = "monitored_barrier",
     if rnd >= _MB_RETIRE_LAG and hasattr(client, "key_value_delete"):
         try:
             client.key_value_delete(f"dstpu_mb/{name}/{rnd - _MB_RETIRE_LAG}/{me}")
+        # dstpu-lint: allow[swallow] stamp retirement is best-effort cleanup;
+        # a failed delete only costs bounded coordinator memory
         except Exception:
             pass
+    # dstpu-lint: allow[wall-clock] stamp VALUE is debug metadata read by
+    # humans in barrier-failure reports; the deadline math below is monotonic
     client.key_value_set(f"dstpu_mb/{name}/{rnd}/{me}", str(_time.time()))
-    deadline = _time.time() + timeout_s
+    # monotonic, not time.time(): an NTP step during the barrier would
+    # shrink (or inflate) every peer's remaining budget
+    deadline = _time.monotonic() + timeout_s
     missing = []
     for p in range(jax.process_count()):
-        remaining_ms = max(1, int((deadline - _time.time()) * 1000))
+        remaining_ms = max(1, int((deadline - _time.monotonic()) * 1000))
         try:
             client.blocking_key_value_get(f"dstpu_mb/{name}/{rnd}/{p}",
                                           remaining_ms)

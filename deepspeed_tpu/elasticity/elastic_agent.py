@@ -120,6 +120,8 @@ class ElasticAgent:
             fr = get_flight_recorder()
             if fr is not None:
                 fr.note("elastic_attempt", **fields)
+        # dstpu-lint: allow[swallow] flight-recorder note is telemetry; it
+        # must never break the relaunch loop it documents
         except Exception:
             pass
 

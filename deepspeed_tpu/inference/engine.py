@@ -149,6 +149,8 @@ class InferenceEngine:
             last_logits, cache = self._prefill(self.params, ids, cache)
             import functools
 
+            # dstpu-lint: allow[host-sync] sampling-config python scalars
+            # (jit-cache key), not device values
             key = (max_new_tokens, float(temperature), int(top_k),
                    float(top_p))
             cache_map = getattr(self, "_decode_jits", None)

@@ -1022,6 +1022,8 @@ class InferenceEngineV2:
     def _emit_sampled(self, seq: SequenceState, logits, out) -> None:
         """Sample off prefix-end logits, append, record, maybe retire —
         shared by the whole-prompt and final-chunk prefill paths."""
+        # dstpu-lint: allow[host-sync] host sampling of the prefix-end
+        # logits: one [vocab] row per ADMISSION, not per decode step
         tok = self._sample(seq, np.asarray(logits, np.float32))
         seq.tokens.append(tok)
         self._note_tokens(seq)
@@ -1328,6 +1330,9 @@ class InferenceEngineV2:
                     jnp.asarray(self._page_table), jnp.asarray(act),
                     jnp.asarray(temps), self._sample_key,
                     jnp.asarray(self._decode_steps, jnp.uint32))
+                # dstpu-lint: allow[host-sync] THE one designed sync per
+                # decode step: [B] int32 tokens cross, never [B,vocab]
+                # logits (on-device sampling above is exactly for this)
                 tokens = np.asarray(tokens)
             self._m_gen_tokens.inc(len(decode_seqs))
             self._m_invocations.inc()
@@ -1436,6 +1441,8 @@ class InferenceEngineV2:
                 self.params, self._pools, jnp.asarray(ids),
                 jnp.asarray(pos), jnp.asarray(self._page_table),
                 jnp.asarray(act), jnp.asarray(nv))
+            # dstpu-lint: allow[host-sync] one [B,W] int32 pull per verify
+            # round; acceptance is per-row host logic by design
             greedy = np.asarray(greedy)  # [B, W] argmax per position
         self._m_invocations.inc()
         self._dstats["decode_model_invocations"] += 1

@@ -67,6 +67,8 @@ class CSVMonitor(Monitor):
         for f in self._files.values():
             try:
                 f.close()
+            # dstpu-lint: allow[swallow] teardown flush is best-effort; one
+            # broken writer handle must not block closing the rest
             except Exception:
                 pass
         self._files.clear()
@@ -131,6 +133,8 @@ class CometMonitor(Monitor):
         if getattr(cfg, "experiment_name", None):
             try:
                 self._exp.set_name(cfg.experiment_name)
+            # dstpu-lint: allow[swallow] cosmetic experiment rename on a
+            # third-party client; the run proceeds under the default name
             except Exception:
                 pass
 

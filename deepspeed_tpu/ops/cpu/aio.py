@@ -45,6 +45,8 @@ class AsyncIOHandle:
     def __del__(self):
         try:
             self.close()
+        # dstpu-lint: allow[swallow] __del__ runs during interpreter
+        # teardown and must never raise
         except Exception:
             pass
 

@@ -102,6 +102,8 @@ class ResilienceManager:
                             step=engine.global_steps,
                             checkpoint=saved or "")
                     fr.dump(reason="preemption")
+            # dstpu-lint: allow[swallow] the flight dump is forensics; a
+            # broken recorder must not mask the PreemptionInterrupt below
             except Exception:
                 pass
             raise PreemptionInterrupt(reason)

@@ -189,6 +189,8 @@ def finalize_commit(save_dir: str, tag: str, meta: Optional[dict] = None,
             files[rel] = {"bytes": os.path.getsize(full),
                           "crc32": _crc32_file(full)}
             _fsync_file(full)
+    # dstpu-lint: allow[wall-clock] manifest metadata timestamp for humans
+    # and retention tools — not a duration, not replayed
     manifest = {"format": COMMIT_FORMAT, "tag": tag, "ts": time.time(),
                 "files": files, "meta": dict(meta or {})}
     atomic_write_text(os.path.join(staging, MANIFEST),
@@ -346,8 +348,10 @@ def _record_corruption(save_dir: str, tag: str, problems: list) -> None:
             fr.note("corrupt_checkpoint", dir=save_dir, tag=tag,
                     problems=[str(p) for p in problems])
             fr.dump(reason=f"corrupt_checkpoint:{tag}")
+    # dstpu-lint: allow[swallow] incident logging must never break the
+    # corrupt-tag fallback path it is reporting on
     except Exception:
-        pass  # incident logging must never break the fallback path
+        pass
 
 
 def resolve_tag(load_dir: str, tag: Optional[str] = None) -> Tuple[Optional[str], dict]:

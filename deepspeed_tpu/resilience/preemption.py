@@ -123,6 +123,9 @@ class PreemptionWatcher:
                 fr = get_flight_recorder()
                 if fr is not None:
                     fr.note("preemption_notice", reason=reason)
+            # dstpu-lint: allow[swallow] runs inside a signal handler; any
+            # raise here would kill the process mid-step instead of at the
+            # boundary
             except Exception:
                 pass
 

@@ -39,8 +39,9 @@ def see_memory_usage(message: str, force: bool = False) -> None:
         # no-arg: the ledger publishes its own process-aggregate view so
         # the gauges stay consistent with the ledger's residual math
         get_memory_ledger().publish_stats()
+    # dstpu-lint: allow[swallow] telemetry must never break the caller
     except Exception:
-        pass  # telemetry must never break the caller
+        pass
     if not force:
         return
     acc = get_accelerator()
@@ -127,6 +128,8 @@ def set_random_seed(seed: int):
         import torch as _torch
 
         _torch.manual_seed(seed)
+    # dstpu-lint: allow[swallow] torch is optional; a broken install must
+    # not break jax-only seeding (see body comment)
     except Exception:
         # absent torch (ImportError) and broken installs (OSError on a
         # missing shared lib, RuntimeError) alike must not break jax-only

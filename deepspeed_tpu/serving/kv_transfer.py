@@ -116,6 +116,8 @@ def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
         # wall-clock send stamp: transit time must CONSUME the deadline
         # budget (best-effort across hosts — skew-negative elapsed is
         # clamped to 0, never granting budget back)
+        # dstpu-lint: allow[wall-clock] cross-host wire timestamp; monotonic
+        # clocks do not compare across machines (see comment above)
         "sent_unix": time.time(),
         "page_keys": [k.hex() if isinstance(k, bytes) else k
                       for k in bundle.page_keys],
@@ -197,6 +199,8 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
             "refused; source still holds the sequence")
     left = header.get("deadline_left_s")
     if left is not None and header.get("sent_unix") is not None:
+        # dstpu-lint: allow[wall-clock] transit vs the sender's wall-clock
+        # stamp; clamped non-negative so skew never grants budget back
         transit = max(0.0, time.time() - float(header["sent_unix"]))
         left = max(0.0, float(left) - transit)
     return KVPageBundle(

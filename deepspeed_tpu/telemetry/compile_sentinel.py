@@ -85,6 +85,8 @@ def _on_duration_event(event: str, duration_secs: float, **_kw) -> None:
             dur_us = float(duration_secs) * 1e6
             rec.record("xla_compile", _now_us() - dur_us, dur_us,
                        cat="compile", seconds=float(duration_secs))
+    # dstpu-lint: allow[swallow] the listener runs inside jax's compile
+    # path forever; a telemetry hiccup must never break compilation itself
     except Exception:
         pass
 

@@ -116,8 +116,10 @@ class FlightRecorder:
 
                 line(dict({"kind": "memory"},
                           **get_memory_ledger().snapshot()))
+            # dstpu-lint: allow[swallow] the black box must be written even
+            # half-blind: a broken ledger drops one record, not the dump
             except Exception:
-                pass  # the black box must be written even half-blind
+                pass
             line({"kind": "snapshot", "ts": time.time(),
                   "metrics": snapshot_metrics(self.registry)})
             for rec in (extra_records or []):

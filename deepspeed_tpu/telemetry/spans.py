@@ -63,7 +63,9 @@ def _notify_phase(name: str, edge: str) -> None:
         return
     try:
         fn(name, edge)
-    except Exception:  # a broken sampler must never break the traced code
+    # dstpu-lint: allow[swallow] a broken phase listener must never break
+    # the traced code
+    except Exception:
         pass
 
 #: one monotonic origin per process: every span timestamp is

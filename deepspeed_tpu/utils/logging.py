@@ -72,7 +72,12 @@ def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = l
         logger.log(level, f"[Rank {my_rank}] {message}")
 
 
-def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
-    if message not in _seen:
-        _seen.add(message)
+#: messages already emitted by warning_once (module-level, not a default
+#: argument: a mutable default is invisible shared state at the call site)
+_WARNED_ONCE: set = set()
+
+
+def warning_once(message: str) -> None:
+    if message not in _WARNED_ONCE:
+        _WARNED_ONCE.add(message)
         logger.warning(message)

@@ -1,0 +1,2 @@
+"""Repo tooling as a package so drivers run as ``python -m tools.<name>``
+(e.g. ``python -m tools.dstpu_lint --all``) from the repo root."""

@@ -43,6 +43,17 @@ import numpy as np
 
 from bench import _backend_usable, _int_env as _int, _pin_cpu
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stamp_contract_hash(result: dict) -> dict:
+    """Provenance: tie the bench artifact to the exact program contracts
+    (tests/contracts/*.json) it ran under — see docs/STATIC_ANALYSIS.md."""
+    from deepspeed_tpu.analysis.contracts import contract_set_hash
+
+    result["contract_set_hash"] = contract_set_hash(_REPO)
+    return result
+
 
 def main() -> None:
     import jax
@@ -142,7 +153,7 @@ def main() -> None:
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason
-    print(json.dumps(result))
+    print(json.dumps(_stamp_contract_hash(result)))
     # hard identity gate on CPU only: XLA-CPU is deterministic across the
     # two paths, while kernel backends may flip a near-tie greedy pick at
     # ULP level (docs/SERVING.md) — there the mismatch COUNT is the signal
@@ -289,7 +300,7 @@ def main_speculative() -> None:
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason
-    print(json.dumps(result))
+    print(json.dumps(_stamp_contract_hash(result)))
     # lossless contract: greedy speculative decoding must be
     # bit-identical to the baseline — hard gate on CPU (XLA-CPU is
     # deterministic; kernel backends may flip ULP-level near-ties)

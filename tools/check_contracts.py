@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""HLO cost-contract checker (docs/STATIC_ANALYSIS.md).
+
+Lowers the representative tiny programs (train step at ZeRO stages
+0/1/3 with offload/ZeRO++ variants; engine_v2 prefill/decode/
+paged_verify) on CPU and diffs their contracts — collective counts,
+FLOPs, bytes accessed, donation, shape signature, replay recompiles —
+against the goldens under ``tests/contracts/``.
+
+    python tools/check_contracts.py                  # check all programs
+    python tools/check_contracts.py --programs decode,prefill
+    python tools/check_contracts.py --update-goldens # regenerate goldens
+
+Exit is non-zero on any contract violation, with a named delta per
+failure ("train_step_zero3: grew all-gather 24 -> 26 ...").  Runs
+standalone (pins the tier-1 CPU harness: JAX_PLATFORMS=cpu + 8 virtual
+devices) and inside tier-1 via tests/unit/test_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ensure_cpu_harness() -> None:
+    """Pin the tier-1 lowering environment BEFORE jax is imported: CPU
+    platform, 8 virtual devices (same as tests/conftest.py).  No-op when
+    a jax is already configured (e.g. under pytest)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def run_check(root: str = REPO, programs=None, update: bool = False):
+    """Returns ``(errors, n_programs)``; writes goldens when ``update``.
+
+    Import of the contracts module (and so jax) happens here, after
+    :func:`ensure_cpu_harness` had its chance to pin the platform.
+    """
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from deepspeed_tpu.analysis import contracts
+
+    extracted = contracts.extract_all(programs)
+    if update:
+        written = contracts.write_goldens(root, extracted)
+        for path in written:
+            print(f"check_contracts: wrote {os.path.relpath(path, root)}")
+        return [], len(extracted)
+    goldens = contracts.load_goldens(root)
+    if programs:
+        goldens = {k: v for k, v in goldens.items() if k in set(programs)}
+    errors = contracts.diff_all(goldens, extracted)
+    return errors, len(extracted)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--update-goldens", action="store_true",
+                    help="regenerate tests/contracts/*.json from the "
+                         "current tree")
+    ap.add_argument("--programs", default="",
+                    help="comma-separated subset of programs to check")
+    ap.add_argument("--root", default=REPO)
+    args = ap.parse_args(argv)
+
+    ensure_cpu_harness()
+    programs = [p for p in args.programs.split(",") if p] or None
+    errors, n = run_check(args.root, programs, update=args.update_goldens)
+    if args.update_goldens:
+        print(f"check_contracts: regenerated {n} golden contract(s)")
+        return 0
+    if errors:
+        print(f"check_contracts: {len(errors)} contract violation(s) "
+              f"over {n} program(s)")
+        for e in errors:
+            print(f"  ERROR: {e}")
+        return 1
+    print(f"check_contracts: OK ({n} program contracts hold)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,197 +1,46 @@
 #!/usr/bin/env python
-"""Static metric- and span-name lint.
+"""Back-compat shim: the metric/span-name lint implementation moved to
+``deepspeed_tpu/analysis/metric_lint.py`` (PR 9) so the unified driver
+``python -m tools.dstpu_lint --all`` can run it alongside the JAX-hazard
+lint and the HLO contract check with one merged report.
 
-AST-scans the package (``deepspeed_tpu/`` + ``tools/``) for metric
-registrations — ``<registry>.counter/gauge/histogram("name", ...)`` calls
-and direct ``Counter/Gauge/Histogram("name", ...)`` constructions with a
-string-literal first argument — and enforces:
-
-1. ``snake_case`` with the ``deepspeed_tpu_`` namespace prefix
-   (the same ``METRIC_NAME_RE`` the registry enforces at runtime —
-   this lint catches the violation at review time instead of first-run).
-2. No duplicate registrations: a metric name is registered at exactly
-   ONE call site across the package (get-or-create re-execution of the
-   same site is fine; two sites claiming one name is how two subsystems
-   silently sum into each other's series).
-3. One name, one type: the same name must not appear as two different
-   metric types anywhere.
-
-It also scans span/event recordings — ``span("name", ...)``,
-``begin_span("name", ...)``, ``record_event("name", ...)`` with a
-string-literal first argument (``telemetry/spans.py``) — and enforces
-the matching rules for the trace namespace:
-
-4. ``snake_case`` WITHOUT the ``deepspeed_tpu_`` prefix (that namespace
-   belongs to metrics; a prefixed span name would alias a metric family
-   in dashboards that join the two artifacts).
-5. Single owner: each literal span/event name is recorded from exactly
-   one call site (multi-site phases thread the name through a helper).
-
-Runs as a tier-1 test (``tests/unit/test_metric_names.py``) and stands
-alone: ``python tools/check_metric_names.py`` exits non-zero with a
-per-violation report.  No imports of the scanned code — pure AST, so it
-works without jax or a working package install.
+This script keeps the original entry point and module API
+(``check``/``collect``/``collect_spans``/``METRIC_NAME_RE``/...) —
+tests and CI that load it by path keep working unchanged.  Loaded by
+FILE PATH, not package import, so it still needs neither jax nor a
+package install.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib.util
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
-METRIC_NAME_RE = re.compile(r"^deepspeed_tpu_[a-z][a-z0-9_]*$")
-SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-
-_METHODS = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}
-_CTORS = {"Counter": "counter", "Gauge": "gauge", "Histogram": "histogram"}
-_SPAN_FNS = {"span": "span", "begin_span": "span", "record_event": "event"}
-
-#: registration sites that define the generic machinery itself, not a metric
-_EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "registry.py")}
-#: span sites that define the span machinery itself, not a span
-_SPAN_EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "spans.py")}
-
-Site = Tuple[str, int, str]  # (relpath, lineno, metric_type)
+_IMPL = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "deepspeed_tpu", "analysis", "metric_lint.py")
 
 
-def _scan_file(path: str, rel: str) -> List[Tuple[str, Site]]:
-    with open(path) as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        print(f"{rel}: syntax error during scan: {e}", file=sys.stderr)
-        return []
-    out: List[Tuple[str, Site]] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        first = node.args[0]
-        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
-            continue
-        mtype = None
-        if isinstance(node.func, ast.Attribute) and node.func.attr in _METHODS:
-            mtype = _METHODS[node.func.attr]
-        elif isinstance(node.func, ast.Name) and node.func.id in _CTORS:
-            mtype = _CTORS[node.func.id]
-        if mtype is None:
-            continue
-        name = first.value
-        # only treat it as a metric registration when it carries the
-        # namespace prefix or claims to be one but got the case wrong —
-        # plain .counter()/Counter() calls on unrelated objects
-        # (itertools.count etc.) must not trip the lint
-        if not name.lower().startswith("deepspeed_tpu_"):
-            continue
-        out.append((name, (rel, node.lineno, mtype)))
-    return out
+def _load():
+    name = "dstpu_metric_lint"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, _IMPL)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def _scan_spans(path: str, rel: str) -> List[Tuple[str, Site]]:
-    """Span/event recordings: module-level ``span(...)`` /
-    ``begin_span(...)`` / ``record_event(...)`` calls (bare or via an
-    attribute, e.g. ``spans.record_event``) with a literal first arg."""
-    with open(path) as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        print(f"{rel}: syntax error during scan: {e}", file=sys.stderr)
-        return []
-    out: List[Tuple[str, Site]] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        first = node.args[0]
-        if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
-            continue
-        fn = None
-        if isinstance(node.func, ast.Name) and node.func.id in _SPAN_FNS:
-            fn = _SPAN_FNS[node.func.id]
-        elif isinstance(node.func, ast.Attribute) and node.func.attr in _SPAN_FNS:
-            fn = _SPAN_FNS[node.func.attr]
-        if fn is None:
-            continue
-        out.append((first.value, (rel, node.lineno, fn)))
-    return out
+_impl = _load()
 
-
-def _walk(root: str, scanner, exclude) -> Dict[str, List[Site]]:
-    found: Dict[str, List[Site]] = {}
-    for sub in ("deepspeed_tpu", "tools"):
-        base = os.path.join(root, sub)
-        for dirpath, _dirs, files in os.walk(base):
-            for fn in sorted(files):
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                rel = os.path.relpath(path, root)
-                if rel in exclude:
-                    continue
-                for name, site in scanner(path, rel):
-                    found.setdefault(name, []).append(site)
-    return found
-
-
-def collect(root: str) -> Dict[str, List[Site]]:
-    return _walk(root, _scan_file, _EXCLUDE_FILES)
-
-
-def collect_spans(root: str) -> Dict[str, List[Site]]:
-    return _walk(root, _scan_spans, _SPAN_EXCLUDE_FILES)
-
-
-def check(root: str) -> List[str]:
-    errors: List[str] = []
-    found = collect(root)
-    for name, sites in sorted(found.items()):
-        where = ", ".join(f"{f}:{ln}" for f, ln, _t in sites)
-        if not METRIC_NAME_RE.match(name):
-            errors.append(
-                f"{name!r} ({where}): must match "
-                f"{METRIC_NAME_RE.pattern} (snake_case, "
-                f"'deepspeed_tpu_' prefix)")
-        types = {t for _f, _ln, t in sites}
-        if len(types) > 1:
-            errors.append(f"{name!r} registered as multiple types "
-                          f"{sorted(types)} ({where})")
-        if len(sites) > 1:
-            errors.append(
-                f"{name!r} registered at {len(sites)} call sites ({where}): "
-                "each metric belongs to exactly one owner")
-    for name, sites in sorted(collect_spans(root).items()):
-        where = ", ".join(f"{f}:{ln}" for f, ln, _t in sites)
-        if not SPAN_NAME_RE.match(name) or name.startswith("deepspeed_tpu_"):
-            errors.append(
-                f"span {name!r} ({where}): span/event names are "
-                f"snake_case WITHOUT the 'deepspeed_tpu_' metric prefix")
-        if len(sites) > 1:
-            errors.append(
-                f"span {name!r} recorded at {len(sites)} call sites "
-                f"({where}): each span name belongs to exactly one owner "
-                "(thread the name through a helper for shared phases)")
-    return errors
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    root = argv[0] if argv else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
-    errors = check(root)
-    names = collect(root)
-    spans = collect_spans(root)
-    if errors:
-        print(f"check_metric_names: {len(errors)} violation(s) over "
-              f"{len(names)} metric name(s) + {len(spans)} span name(s)")
-        for e in errors:
-            print(f"  ERROR: {e}")
-        return 1
-    print(f"check_metric_names: OK ({len(names)} metric names, "
-          f"{len(spans)} span names)")
-    return 0
-
+METRIC_NAME_RE = _impl.METRIC_NAME_RE
+SPAN_NAME_RE = _impl.SPAN_NAME_RE
+Site = _impl.Site
+collect = _impl.collect
+collect_spans = _impl.collect_spans
+check = _impl.check
+main = _impl.main
 
 if __name__ == "__main__":
     sys.exit(main())
