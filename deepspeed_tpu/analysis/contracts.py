@@ -49,11 +49,17 @@ CONTRACTS_DIR = os.path.join("tests", "contracts")
 
 # ------------------------------------------------------------- extraction
 def collective_counts(hlo_text: str) -> Dict[str, int]:
-    """Count collective ops by kind in optimized HLO text."""
+    """Count collective ops by kind in optimized HLO text.
+
+    The result type is either a plain shape (``s8[8,128]{1,0}``) or — when
+    XLA's collective combiner merged several ops — a tuple of shapes
+    (``(s8[...], f32[...])``); a combined op counts ONCE (it is one wire
+    transaction, which is what the contract pins)."""
     out = {}
+    tuple_ty = r"\([^()]*\)"  # tuple result types contain no nested parens
     for kind in COLLECTIVE_KINDS:
         out[kind] = len(re.findall(
-            rf"=\s*\S+\s+{kind}(?:-start)?\(", hlo_text))
+            rf"=\s*(?:{tuple_ty}|\S+)\s+{kind}(?:-start)?\(", hlo_text))
     return out
 
 
@@ -139,7 +145,7 @@ def _train_batch_arrays(hidden: int = 16, batch: int = 16):
 
 
 def _train_program(stage: int, offload: bool = False, qgz: bool = False,
-                   replay: bool = True) -> Dict[str, Any]:
+                   replay: bool = True, hier: bool = False) -> Dict[str, Any]:
     import jax
 
     import deepspeed_tpu
@@ -150,6 +156,11 @@ def _train_program(stage: int, offload: bool = False, qgz: bool = False,
         zero_cfg["offload_optimizer"] = {"device": "cpu"}
     if qgz:
         zero_cfg["zero_quantized_gradients"] = True
+    if hier:
+        # pinned inner=2 (not auto): the golden must not depend on the
+        # harness's local-device heuristic
+        zero_cfg["zero_hierarchical_grad_reduce"] = True
+        zero_cfg["zero_hierarchy_inner"] = 2
     engine, *_ = deepspeed_tpu.initialize(model=_mlp_spec(), config={
         "train_micro_batch_size_per_gpu": 2,
         "gradient_accumulation_steps": 1,
@@ -259,6 +270,41 @@ def _verify_program() -> Dict[str, Any]:
             "extras": _v2_extras(eng), "replay": None}
 
 
+def _moe_dispatch_program() -> Dict[str, Any]:
+    """Quantized expert-parallel MoE dispatch: the explicit all-to-all
+    shard_map path (moe/ep_dispatch.py) with the comm/collectives int8
+    codec on the token payloads — pins 5 all-to-alls (codes + scales
+    each way, exact routing metadata) so a regression to full-precision
+    dispatch (or a lost/duplicated exchange) is a named tier-1 diff."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..moe.ep_dispatch import moe_ffn_ep
+    from ..moe.sharded_moe import MoEConfig
+    from ..parallel.mesh import initialize_topology
+    from ..runtime.config import MeshConfig
+
+    topo = initialize_topology(MeshConfig(expert=4, data=2),
+                               jax.devices()[:8])
+    B, S, H, F, E = 8, 4, 16, 32, 4
+    cfg = MoEConfig(num_experts=E, top_k=2, drop_tokens=False,
+                    ep_a2a_compression="int8")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, H).astype(np.float32))
+    gate_w = jnp.asarray(rng.randn(H, E).astype(np.float32) * 0.1)
+    wg = jnp.asarray(rng.randn(E, H, F).astype(np.float32) * 0.1)
+    wu = jnp.asarray(rng.randn(E, H, F).astype(np.float32) * 0.1)
+    wd = jnp.asarray(rng.randn(E, F, H).astype(np.float32) * 0.1)
+
+    def dispatch(x, gate_w, wg, wu, wd):
+        return moe_ffn_ep(x, gate_w,
+                          {"w_gate": wg, "w_up": wu, "w_down": wd}, cfg)
+
+    return {"fn": jax.jit(dispatch), "args": (x, gate_w, wg, wu, wd),
+            "mesh": topo.mesh, "extras": {}, "replay": None}
+
+
 #: name -> (builder, description).  The builder returns the dict
 #: consumed by :func:`extract_program`; descriptions land in the golden
 #: JSON so a diff reader knows what program regressed.
@@ -280,6 +326,15 @@ PROGRAM_BUILDERS: Dict[str, Tuple[Callable[[], Dict[str, Any]], str]] = {
         lambda: _train_program(1, qgz=True, replay=False),
         "fused train step, ZeRO stage 1 + ZeRO++ qgZ int8 all-to-all "
         "gradient reduce"),
+    "train_step_zero1_hier": (
+        lambda: _train_program(1, qgz=True, hier=True, replay=False),
+        "fused train step, ZeRO stage 1 + hierarchical two-hop gradient "
+        "reduce (2x4 split of the data axis: intra-slice reduce-scatter, "
+        "int8 inter-slice exchange, intra-slice all-gather)"),
+    "moe_dispatch_quantized": (
+        _moe_dispatch_program,
+        "expert-parallel dropless MoE dispatch with int8-quantized "
+        "all-to-alls (ep=4, data=2; routing metadata exact)"),
     "prefill": (
         _prefill_program,
         "engine_v2 paged prefill, one bucket-16 prompt"),

@@ -112,6 +112,12 @@ SHARDING_FILES = (
     os.path.join("deepspeed_tpu", "runtime", "tensor_parallel",
                  "tp_manager.py"),
     os.path.join("deepspeed_tpu", "module_inject", "auto_tp.py"),
+    # the compressed-collective layer flattens grad pytrees and derives
+    # axis_index_groups — order skew there IS cross-host sharding skew
+    os.path.join("deepspeed_tpu", "comm", "collectives", "codec.py"),
+    os.path.join("deepspeed_tpu", "comm", "collectives", "compressed.py"),
+    os.path.join("deepspeed_tpu", "comm", "collectives", "hierarchical.py"),
+    os.path.join("deepspeed_tpu", "utils", "groups.py"),
 )
 
 #: seeded-RNG constructors / setup calls that are NOT violations

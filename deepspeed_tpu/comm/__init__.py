@@ -3,6 +3,8 @@ from .comm import (all_gather, all_reduce, all_to_all_single, axis_index,
                    get_device_count, get_local_rank, get_rank, get_world_size,
                    inference_all_reduce, init_distributed, is_initialized,
                    ppermute, reduce_scatter, send_recv_next, send_recv_prev)
+from .collectives import (CompressionSpec, hier_all_reduce,
+                          hierarchical_grad_reduce)
 from .comms_logger import CommsLogger, configure_comms_logger, get_comms_logger
 
 __all__ = [
@@ -10,6 +12,7 @@ __all__ = [
     "axis_size_in_program", "barrier", "broadcast", "broadcast_host",
     "get_local_rank", "get_rank", "get_world_size", "inference_all_reduce",
     "init_distributed", "is_initialized", "ppermute", "reduce_scatter",
-    "send_recv_next", "send_recv_prev", "CommsLogger",
-    "configure_comms_logger", "get_comms_logger",
+    "send_recv_next", "send_recv_prev", "CommsLogger", "CompressionSpec",
+    "configure_comms_logger", "get_comms_logger", "hier_all_reduce",
+    "hierarchical_grad_reduce",
 ]

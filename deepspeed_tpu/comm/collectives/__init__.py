@@ -1,0 +1,31 @@
+"""First-class quantized & hierarchical collectives.
+
+Layering (docs/COMM.md):
+
+  * :mod:`.codec` — the wire format: blockwise int8/fp8 quantize /
+    dequantize + error-feedback arithmetic (``CompressionSpec``).
+  * :mod:`.compressed` — compressed verbs mirroring ``comm/comm.py``
+    (all_reduce / reduce_scatter / all_gather / all_to_all / ppermute),
+    reached through the module-level API's ``compression=`` option.
+  * :mod:`.hierarchical` — two-hop intra-slice / inter-slice variants
+    over a split mesh axis (``utils/groups.hierarchy_split``).
+
+Adopters: ZeRO++ qgZ/qwZ (``runtime/zero/zeropp.py``), the 1-bit-family
+error-feedback all-reduce (``runtime/comm/compressed.py``), MoE expert
+dispatch (``moe/ep_dispatch.py``), ring attention
+(``sequence/ring_attention.py``), and the engine's hierarchical gradient
+reduce (``zero_optimization.zero_hierarchical_grad_reduce``).
+"""
+
+from . import compressed, hierarchical  # noqa: F401
+from .codec import (CompressionSpec, compensate, dequantize_blockwise,
+                    init_error, logical_bytes, qdq, quantize_blockwise,
+                    wire_bytes)
+from .hierarchical import hier_all_reduce, hierarchical_grad_reduce
+
+__all__ = [
+    "CompressionSpec", "compensate", "compressed", "dequantize_blockwise",
+    "hier_all_reduce", "hierarchical", "hierarchical_grad_reduce",
+    "init_error", "logical_bytes", "qdq", "quantize_blockwise",
+    "wire_bytes",
+]

@@ -1,0 +1,262 @@
+"""Compressed collective verbs (in-program; use inside shard_map bodies).
+
+Every verb mirrors its exact counterpart in ``comm/comm.py`` and moves
+codes + block scales instead of full-precision values — the XLA-native
+expression of the reference's quantized collectives
+(``runtime/comm/coalesced_collectives.py`` all_to_all_quant_reduce,
+EQuARX-style in-program quantization).  The module-level API dispatches
+here when a verb is called with ``compression=CompressionSpec(...)``;
+with ``compression=None`` the exact paths run untouched (bit-exact).
+
+Reduction verbs quantize *partials* and dequantize before summing, so
+the accumulation itself stays fp32; only the wire moves low-precision.
+``all_reduce`` optionally carries a caller-owned error-feedback residual
+(``spec.error_feedback``) — the 1-bit-Adam-family contract.
+
+``ppermute`` is a straight-through estimator: the forward rotates
+codes + scales, the backward rotates the exact cotangent through the
+inverse permutation (compression is communication lossy-ness, not part
+of the learned function — same stance as zeropp's qwZ gather).
+
+Every verb reports (op, logical bytes, wire bytes) to the comms logger
+at trace time; ``log_summary``'s wire column and the
+``deepspeed_tpu_comm_compression_*`` metric family come from here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .codec import (CompressionSpec, compensate, dequantize_blockwise,
+                    quantize_blockwise, wire_bytes)
+
+
+def _log(op: str, tensor, axis, wire: int) -> None:
+    from ..comm import _log as comm_log
+
+    comm_log(op, tensor, axis, wire_bytes=wire)
+
+
+def _axis_world(axis) -> int:
+    # static inside shard_map: psum of a python scalar folds at trace time
+    return lax.psum(1, axis)
+
+
+def _sum_partials(partials: jnp.ndarray, op: str) -> jnp.ndarray:
+    if op in ("sum", "SUM"):
+        return jnp.sum(partials, axis=0)
+    if op in ("avg", "AVG", "mean"):
+        return jnp.mean(partials, axis=0)
+    raise ValueError(f"Unsupported compressed reduce op {op}")
+
+
+# --------------------------------------------------------------- all_reduce
+def _two_hop_flat(comp: jnp.ndarray, op: str, axis, spec: CompressionSpec,
+                  world: int, out_dtype=None):
+    """qgZ-shaped two-hop reduce over ``axis`` with codes on the wire in
+    both hops; returns ``(reduced, locally_sent_qdq, hop2_residual)`` —
+    the last two feed the error-feedback residual (the non-EF caller
+    discards them; XLA DCEs the dead dequantizes).
+
+    hop 1: split into ``world`` slots, quantize, all_to_all (each rank
+           receives its slot from everyone), dequantize + reduce.
+    hop 2: quantize the reduced slot, all_gather, dequantize — back to a
+           full tensor on every rank.  ``hop2_residual`` [slot] is what
+           THIS rank's hop-2 quantization dropped from the slot it owns.
+    """
+    n = comp.size
+    slot = -(-n // world)
+    slot = -(-slot // spec.block) * spec.block  # whole codec blocks per slot
+    pad = slot * world - n
+    flat = jnp.pad(comp.reshape(-1), (0, pad)) if pad else comp.reshape(-1)
+    chunks = flat.reshape(world, slot)
+
+    q, s, _ = quantize_blockwise(chunks, spec)
+    _log("all_to_all", chunks, axis, wire_bytes(q, s))
+    q_r = lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+    s_r = lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
+    partials = dequantize_blockwise(q_r, s_r, slot, jnp.float32)
+    reduced = _sum_partials(partials, op)  # this rank's slot, reduced
+
+    q2, s2, _ = quantize_blockwise(reduced[None], spec)  # [1, slot]
+    _log("all_gather", reduced, axis, wire_bytes(q2, s2))
+    own_qdq2 = dequantize_blockwise(q2, s2, slot, jnp.float32)[0]
+    q2_g = lax.all_gather(q2, axis, axis=0, tiled=True)  # [W, slot]
+    s2_g = lax.all_gather(s2, axis, axis=0, tiled=True)
+    full = dequantize_blockwise(q2_g, s2_g, slot, jnp.float32).reshape(-1)
+    sent = dequantize_blockwise(q, s, slot, jnp.float32).reshape(-1)
+    return (full[:n].reshape(comp.shape).astype(out_dtype or comp.dtype),
+            sent[:n].reshape(comp.shape).astype(comp.dtype),
+            reduced - own_qdq2)
+
+
+def all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
+               spec: CompressionSpec = CompressionSpec(),
+               error: Optional[jnp.ndarray] = None, out_dtype=None
+               ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Compressed all-reduce over a named mesh axis.
+
+    Plain (``spec.error_feedback=False``): returns the reduced tensor
+    (``out_dtype`` overrides the result dtype — gradient reducers keep
+    the fp32 accumulation instead of rounding back to the input dtype).
+
+    Error-feedback: compensates with the carried residual, sends the
+    quantized value, and returns ``(reduced, new_error)`` — the caller
+    owns the buffer (reference compressed_allreduce,
+    runtime/comm/compressed.py).  The residual covers BOTH quantization
+    points: hop 1 locally (``comp - qdq(comp)``) and hop 2 via the slot
+    owner — rank r quantized the reduced slot r everyone receives, so r
+    reinjects that slot's dropped mass into its own next-step payload
+    (scaled by ``world`` under mean, whose 1/world then cancels it).
+    """
+    world = _axis_world(axis)
+    if not spec.error_feedback:
+        reduced, _, _ = _two_hop_flat(tensor, op, axis, spec, world,
+                                      out_dtype)
+        return reduced
+    if error is None:
+        error = jnp.zeros_like(tensor)
+    comp = compensate(tensor, error)
+    reduced, sent, hop2_delta = _two_hop_flat(comp, op, axis, spec, world,
+                                              out_dtype)
+    n = comp.size
+    slot = hop2_delta.shape[0]
+    r = lax.axis_index(axis)
+    gain = float(world) if op in ("avg", "AVG", "mean") else 1.0
+    flat_delta = lax.dynamic_update_slice(
+        jnp.zeros((slot * world,), jnp.float32), hop2_delta * gain,
+        (r * slot,))[:n].reshape(comp.shape).astype(comp.dtype)
+    return reduced, (comp - sent) + flat_delta
+
+
+# ----------------------------------------------------------- reduce_scatter
+def reduce_scatter(tensor: jnp.ndarray, op: str = "sum", axis="data",
+                   spec: CompressionSpec = CompressionSpec(),
+                   scatter_dim: int = 0, out_dtype=None) -> jnp.ndarray:
+    """Compressed reduce-scatter: one all_to_all whose slot layout IS the
+    target sharding (reference all_to_all_quant_reduce returns the
+    scattered partition; no gather back).  Rank r keeps its shard of the
+    reduction along ``scatter_dim``.  ``out_dtype``: see ``all_reduce``."""
+    world = _axis_world(axis)
+    gm = jnp.moveaxis(tensor, scatter_dim, 0)
+    if gm.shape[0] % world:
+        raise ValueError(
+            f"compressed reduce_scatter: dim {scatter_dim} size "
+            f"{gm.shape[0]} not divisible by axis world {world}")
+    shard = gm.shape[0] // world
+    rest = gm.shape[1:]
+    chunks = gm.reshape(world, -1)  # row w = shard w of the target layout
+    q, s, d = quantize_blockwise(chunks, spec)
+    _log("reduce_scatter", chunks, axis, wire_bytes(q, s))
+    q_r = lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
+    s_r = lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
+    partials = dequantize_blockwise(q_r, s_r, d, jnp.float32)
+    reduced = _sum_partials(partials, op)
+    return jnp.moveaxis(reduced.reshape(shard, *rest), 0,
+                        scatter_dim).astype(out_dtype or tensor.dtype)
+
+
+# --------------------------------------------------------------- all_gather
+def all_gather(tensor: jnp.ndarray, axis="data",
+               spec: CompressionSpec = CompressionSpec(),
+               tensor_axis: int = 0, tiled: bool = True) -> jnp.ndarray:
+    """Compressed all-gather along ``tensor_axis``: every rank's codes +
+    scales are gathered, then dequantized locally."""
+    ta = tensor_axis % tensor.ndim
+    d = tensor.shape[-1]
+    if ta == tensor.ndim - 1 and d % spec.block:
+        # tiled concat along a padded last dim would interleave pad slots
+        raise ValueError(
+            "compressed all_gather along the quantized (last) dim needs "
+            f"the dim ({d}) to be a multiple of the codec block "
+            f"({spec.block}); gather another dim or reshape first")
+    q, s, d = quantize_blockwise(tensor, spec)
+    _log("all_gather", tensor, axis, wire_bytes(q, s))
+    q_g = lax.all_gather(q, axis, axis=ta, tiled=tiled)
+    s_g = lax.all_gather(s, axis, axis=ta, tiled=tiled)
+    return dequantize_blockwise(q_g, s_g, d if ta != tensor.ndim - 1
+                                else q_g.shape[-1],
+                                tensor.dtype)
+
+
+# --------------------------------------------------------------- all_to_all
+def _all_to_all_impl(tensor, axis, spec, split_dim, concat_dim, tiled):
+    nd = tensor.ndim
+    if split_dim % nd == nd - 1 or concat_dim % nd == nd - 1:
+        raise ValueError(
+            "compressed all_to_all cannot split/concat the quantized "
+            "(last) dim; reshape so the exchanged dim is not the last")
+    q, s, d = quantize_blockwise(tensor, spec)
+    _log("all_to_all", tensor, axis, wire_bytes(q, s))
+    q_r = lax.all_to_all(q, axis, split_axis=split_dim,
+                         concat_axis=concat_dim, tiled=tiled)
+    s_r = lax.all_to_all(s, axis, split_axis=split_dim,
+                         concat_axis=concat_dim, tiled=tiled)
+    return dequantize_blockwise(q_r, s_r, d, tensor.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def all_to_all(tensor: jnp.ndarray, axis="sequence",
+               spec: CompressionSpec = CompressionSpec(),
+               split_dim: int = 0, concat_dim: int = 0,
+               tiled: bool = True) -> jnp.ndarray:
+    """Compressed all-to-all (the EQuARX headline verb: MoE expert
+    dispatch).  Quantizes along the last dim, exchanges codes + scales
+    with the same split/concat layout, dequantizes on arrival.
+
+    Straight-through backward: the cotangent rides the TRANSPOSED exact
+    all-to-all (split/concat swapped) at full precision — see
+    ``ppermute`` for the rationale."""
+    return _all_to_all_impl(tensor, axis, spec, split_dim, concat_dim, tiled)
+
+
+def _all_to_all_fwd(tensor, axis, spec, split_dim, concat_dim, tiled):
+    return _all_to_all_impl(tensor, axis, spec, split_dim, concat_dim,
+                            tiled), None
+
+
+def _all_to_all_bwd(axis, spec, split_dim, concat_dim, tiled, _res, ct):
+    return (lax.all_to_all(ct, axis, split_axis=concat_dim,
+                           concat_axis=split_dim, tiled=tiled),)
+
+
+all_to_all.defvjp(_all_to_all_fwd, _all_to_all_bwd)
+
+
+# ----------------------------------------------------------------- ppermute
+def _ppermute_impl(x, perm, axis, spec):
+    q, s, d = quantize_blockwise(x, spec)
+    _log("ppermute", x, axis, wire_bytes(q, s))
+    q = lax.ppermute(q, axis, perm)
+    s = lax.ppermute(s, axis, perm)
+    return dequantize_blockwise(q, s, d, x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def ppermute(tensor: jnp.ndarray, perm, axis,
+             spec: CompressionSpec = CompressionSpec()) -> jnp.ndarray:
+    """Compressed ring shift (ring attention's K/V rotation).  ``perm``
+    must be a tuple of (src, dst) pairs (hashable: it is a vjp-static).
+
+    Straight-through backward: the cotangent rides the INVERSE permutation
+    at full precision — quantizing gradients again would compound error
+    across ring hops, and the K/V forward volume is where the wire savings
+    live."""
+    return _ppermute_impl(tensor, perm, axis, spec)
+
+
+def _ppermute_fwd(tensor, perm, axis, spec):
+    return _ppermute_impl(tensor, perm, axis, spec), None
+
+
+def _ppermute_bwd(perm, axis, spec, _res, ct):
+    inv = tuple((dst, src) for src, dst in perm)
+    return (lax.ppermute(ct, axis, inv),)
+
+
+ppermute.defvjp(_ppermute_fwd, _ppermute_bwd)

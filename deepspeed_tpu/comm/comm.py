@@ -181,13 +181,18 @@ def all_gather_object(obj):
 # --------------------------------------------------------------------------
 # in-program collectives (use inside shard_map / pjit bodies)
 # --------------------------------------------------------------------------
-def _log(op: str, tensor, axis: AxisName) -> None:
+def _log(op: str, tensor, axis: AxisName,
+         wire_bytes: Optional[int] = None) -> None:
     """Report one collective to the comms logger and the span ring.
 
     Runs at TRACE time (collectives compile into the program), so the
     span ring gets zero-duration point events marking op/bytes/group —
     a timeline of what each traced program will execute, aligned with
-    the surrounding compile/step spans — not per-step wall times."""
+    the surrounding compile/step spans — not per-step wall times.
+
+    ``wire_bytes``: what actually crosses the interconnect when the verb
+    compresses its payload (codes + scales); None = uncompressed, wire
+    equals the logical payload size."""
     cl = get_comms_logger()
     rec = get_span_recorder()
     log_cl = cl is not None and cl.enabled
@@ -195,12 +200,24 @@ def _log(op: str, tensor, axis: AxisName) -> None:
         return
     size = getattr(tensor, "size", 0) * jnp.dtype(getattr(tensor, "dtype", jnp.float32)).itemsize
     if log_cl:
-        cl.append(op, str(axis), size)
-    rec.event(op, cat="comm", axis=str(axis), bytes=int(size))
+        cl.append(op, str(axis), size, wire_size_bytes=wire_bytes)
+    rec.event(op, cat="comm", axis=str(axis), bytes=int(size),
+              wire_bytes=int(wire_bytes if wire_bytes is not None else size))
 
 
-def all_reduce(tensor, op: str = "sum", axis: AxisName = "data"):
-    """psum/pmax/pmin/pmean over a named mesh axis (reference comm.all_reduce)."""
+def all_reduce(tensor, op: str = "sum", axis: AxisName = "data",
+               compression=None):
+    """psum/pmax/pmin/pmean over a named mesh axis (reference comm.all_reduce).
+
+    ``compression``: a ``CompressionSpec`` (or "int8"/"fp8") routes the
+    verb through ``comm/collectives`` — codes + block scales on the wire,
+    optional error feedback (docs/COMM.md).  None (default) is the exact
+    path, bit-for-bit unchanged."""
+    if compression is not None:
+        from .collectives import CompressionSpec, compressed
+
+        return compressed.all_reduce(tensor, op=op, axis=axis,
+                                     spec=CompressionSpec.parse(compression))
     _log("all_reduce", tensor, axis)
     if op in ("sum", "SUM"):
         return lax.psum(tensor, axis)
@@ -213,18 +230,34 @@ def all_reduce(tensor, op: str = "sum", axis: AxisName = "data"):
     raise ValueError(f"Unsupported reduce op {op}")
 
 
-def all_gather(tensor, axis: AxisName = "data", tensor_axis: int = 0, tiled: bool = True):
+def all_gather(tensor, axis: AxisName = "data", tensor_axis: int = 0,
+               tiled: bool = True, compression=None):
     """Gather shards along ``tensor_axis`` from every rank of mesh ``axis``.
 
     ``tiled=True`` concatenates (reference all_gather_into_tensor); False
     stacks a new leading dim (reference all_gather list-of-tensors form).
+    ``compression``: see ``all_reduce``.
     """
+    if compression is not None:
+        from .collectives import CompressionSpec, compressed
+
+        return compressed.all_gather(tensor, axis=axis,
+                                     spec=CompressionSpec.parse(compression),
+                                     tensor_axis=tensor_axis, tiled=tiled)
     _log("all_gather", tensor, axis)
     return lax.all_gather(tensor, axis, axis=tensor_axis, tiled=tiled)
 
 
-def reduce_scatter(tensor, op: str = "sum", axis: AxisName = "data", scatter_dim: int = 0):
-    """Reduce then scatter shards (reference reduce_scatter_tensor)."""
+def reduce_scatter(tensor, op: str = "sum", axis: AxisName = "data",
+                   scatter_dim: int = 0, compression=None):
+    """Reduce then scatter shards (reference reduce_scatter_tensor).
+    ``compression``: see ``all_reduce``."""
+    if compression is not None:
+        from .collectives import CompressionSpec, compressed
+
+        return compressed.reduce_scatter(
+            tensor, op=op, axis=axis,
+            spec=CompressionSpec.parse(compression), scatter_dim=scatter_dim)
     _log("reduce_scatter", tensor, axis)
     if op in ("avg", "mean"):
         n = lax.psum(1, axis)
@@ -233,10 +266,17 @@ def reduce_scatter(tensor, op: str = "sum", axis: AxisName = "data", scatter_dim
 
 
 def all_to_all_single(tensor, axis: AxisName = "sequence", split_dim: int = 0,
-                      concat_dim: int = 0):
+                      concat_dim: int = 0, compression=None):
     """All-to-all: split ``split_dim`` across ranks, concat received along
     ``concat_dim`` (reference all_to_all_single, comm.py; the Ulysses
-    primitive, sequence/layer.py:221)."""
+    primitive, sequence/layer.py:221).  ``compression``: see
+    ``all_reduce``."""
+    if compression is not None:
+        from .collectives import CompressionSpec, compressed
+
+        return compressed.all_to_all(
+            tensor, axis=axis, spec=CompressionSpec.parse(compression),
+            split_dim=split_dim, concat_dim=concat_dim, tiled=True)
     _log("all_to_all", tensor, axis)
     return lax.all_to_all(tensor, axis, split_axis=split_dim, concat_axis=concat_dim,
                           tiled=True)
@@ -253,9 +293,16 @@ def broadcast(tensor, src_index: int = 0, axis: AxisName = "data"):
     return lax.psum(tensor * mask, axis)
 
 
-def ppermute(tensor, perm, axis: AxisName = "pipe"):
+def ppermute(tensor, perm, axis: AxisName = "pipe", compression=None):
     """Point-to-point ring shift: the TPU-native send/recv
-    (reference pipe/p2p.py send/recv pairs)."""
+    (reference pipe/p2p.py send/recv pairs).  ``compression``: see
+    ``all_reduce`` — the compressed form rotates codes + scales with a
+    straight-through backward (ring attention's K/V volume)."""
+    if compression is not None:
+        from .collectives import CompressionSpec, compressed
+
+        return compressed.ppermute(tensor, tuple(tuple(p) for p in perm),
+                                   axis, CompressionSpec.parse(compression))
     _log("ppermute", tensor, axis)
     return lax.ppermute(tensor, axis, perm)
 

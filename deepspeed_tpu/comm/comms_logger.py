@@ -47,9 +47,18 @@ class CommsLogger:
         self.prof_all = prof_all
         self.prof_ops = prof_ops or []
         self.debug = debug
-        # op name -> axis -> [count, total_bytes]
+        # op name -> axis ->
+        #   [count, logical_bytes, wire_bytes,
+        #    compressed_logical_bytes, compressed_wire_bytes];
+        # wire == logical for uncompressed verbs, codes + scales for
+        # compressed ones (comm/collectives).  The last two slots isolate
+        # the compressed *subset* of an (op, axis) series — one op name can
+        # carry both compressed and exact calls (e.g. a hierarchical
+        # reduce's quantized inter-slice hop and exact intra-slice hop are
+        # both "all_gather"), and the compression-ratio metrics must not
+        # dilute one with the other
         self.comms_dict: Dict[str, Dict[str, List[int]]] = defaultdict(
-            lambda: defaultdict(lambda: [0, 0]))
+            lambda: defaultdict(lambda: [0, 0, 0, 0, 0]))
 
     def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None, debug=None):
         if enabled is not None:
@@ -63,7 +72,8 @@ class CommsLogger:
         if debug is not None:
             self.debug = debug
 
-    def append(self, op_name: str, axis: str, msg_size_bytes: int) -> None:
+    def append(self, op_name: str, axis: str, msg_size_bytes: int,
+               wire_size_bytes: Optional[int] = None) -> None:
         if not self.enabled:
             return
         if not self.prof_all and op_name not in self.prof_ops:
@@ -71,8 +81,15 @@ class CommsLogger:
         rec = self.comms_dict[op_name][axis]
         rec[0] += 1
         rec[1] += int(msg_size_bytes)
+        rec[2] += int(wire_size_bytes if wire_size_bytes is not None
+                      else msg_size_bytes)
+        if wire_size_bytes is not None:  # a compressed verb reported in
+            rec[3] += int(msg_size_bytes)
+            rec[4] += int(wire_size_bytes)
         if self.verbose:
-            logger.info(f"comm: {op_name} axis={axis} bytes={msg_size_bytes}")
+            logger.info(f"comm: {op_name} axis={axis} bytes={msg_size_bytes}"
+                        + (f" wire={wire_size_bytes}"
+                           if wire_size_bytes is not None else ""))
 
     def _axis_n(self, axis: str,
                 axis_sizes: Optional[Union[int, Dict[str, int]]]) -> int:
@@ -100,18 +117,23 @@ class CommsLogger:
         algorithmic factors; ``elapsed_s`` (wall time the totals
         accumulated over) additionally prints estimated algorithmic bus
         bandwidth — the number to compare against ICI/DCN line rate."""
-        hdr = f"{'op':<20}{'axis':<28}{'count':>8}{'total MB':>12}"
+        hdr = (f"{'op':<20}{'axis':<28}{'count':>8}{'total MB':>12}"
+               f"{'wire MB':>12}")
         if axis_sizes is not None:
             hdr += f"{'bus MB':>12}"
             if elapsed_s:
                 hdr += f"{'busbw GB/s':>12}"
         lines = ["Comms summary (trace-time):", hdr]
         for op, axes in sorted(self.comms_dict.items()):
-            for axis, (count, nbytes) in sorted(axes.items()):
-                row = f"{op:<20}{axis:<28}{count:>8}{nbytes / 1e6:>12.2f}"
+            for axis, (count, nbytes, wbytes, *_comp) in sorted(axes.items()):
+                row = (f"{op:<20}{axis:<28}{count:>8}{nbytes / 1e6:>12.2f}"
+                       f"{wbytes / 1e6:>12.2f}")
                 if axis_sizes is not None:
                     n = self._axis_n(axis, axis_sizes)
-                    bus = nbytes * bus_factor(op, n)
+                    # bus traffic follows the WIRE bytes: a compressed verb
+                    # moves codes + scales, and quoting logical bytes here
+                    # would overstate the achieved bus bandwidth
+                    bus = wbytes * bus_factor(op, n)
                     row += f"{bus / 1e6:>12.2f}"
                     if elapsed_s:
                         row += f"{bus / elapsed_s / 1e9:>12.2f}"
@@ -136,23 +158,48 @@ class CommsLogger:
                            "trace-time collective payload bytes",
                            labelnames=("op", "axis"))
         bus = reg.counter("deepspeed_tpu_comm_bus_bytes_total",
-                          "estimated bytes on the wire (algorithmic factor)",
+                          "estimated bytes on the wire (algorithmic factor "
+                          "over wire bytes)",
                           labelnames=("op", "axis"))
+        cwire = reg.counter("deepspeed_tpu_comm_compression_wire_bytes_total",
+                            "compressed-verb bytes on the wire "
+                            "(codes + block scales)",
+                            labelnames=("op", "axis"))
+        csaved = reg.counter(
+            "deepspeed_tpu_comm_compression_saved_bytes_total",
+            "bytes the codec kept OFF the wire (logical - wire)",
+            labelnames=("op", "axis"))
+        cratio = reg.gauge("deepspeed_tpu_comm_compression_ratio",
+                           "cumulative logical/wire byte ratio of "
+                           "compressed collectives",
+                           labelnames=("op", "axis"))
         published = getattr(self, "_published", None)
         if published is None:
             published = self._published = {}
         for op, axes in self.comms_dict.items():
-            for axis, (count, nbytes) in axes.items():
-                pc, pb = published.get((op, axis), (0, 0))
+            for axis, (count, nbytes, wbytes, clog, cwir) in axes.items():
+                pc, pb, pw, pcl, pcw = published.get((op, axis),
+                                                     (0, 0, 0, 0, 0))
                 if count > pc:
                     ops.inc(count - pc, op=op, axis=axis)
                 if nbytes > pb:
                     byts.inc(nbytes - pb, op=op, axis=axis)
                     n = self._axis_n(axis, axis_sizes)
                     if n > 1:
-                        bus.inc((nbytes - pb) * bus_factor(op, n),
+                        bus.inc((wbytes - pw) * bus_factor(op, n),
                                 op=op, axis=axis)
-                published[(op, axis)] = (count, nbytes)
+                if clog:
+                    # the compression family tracks only the COMPRESSED
+                    # subset of this (op, axis) series — exact calls under
+                    # the same op name must not dilute the ratio
+                    if cwir > pcw:
+                        cwire.inc(cwir - pcw, op=op, axis=axis)
+                    if (clog - cwir) > (pcl - pcw):
+                        csaved.inc((clog - cwir) - (pcl - pcw),
+                                   op=op, axis=axis)
+                    if cwir > 0:
+                        cratio.set(clog / cwir, op=op, axis=axis)
+                published[(op, axis)] = (count, nbytes, wbytes, clog, cwir)
 
     def reset(self) -> None:
         self.comms_dict.clear()

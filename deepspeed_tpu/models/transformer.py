@@ -90,6 +90,12 @@ class TransformerConfig:
     #: EP dispatch: "auto" = explicit all-to-all shard_map when the mesh
     #: has an expert axis (moe/ep_dispatch.py); "spmd" = partitioner-driven
     moe_ep_dispatch: str = "auto"
+    #: quantize the EP dispatch/return all-to-alls ("int8"/"fp8"/None; the
+    #: comm/collectives wire format — EQuARX's biggest win, docs/COMM.md)
+    moe_a2a_compression: Optional[Any] = None
+    #: quantize the ring-attention K/V rotations ("int8"/"fp8"/None);
+    #: only meaningful with attn_impl="ring"
+    ring_compression: Optional[Any] = None
     #: stage-3 manual param prefetch (engine-set per trace, like qwz):
     #: the layer scan runs 2x-unrolled, so each trip holds two
     #: independent gather->compute chains and layer i+1's param
@@ -404,6 +410,11 @@ def _pick_attn(cfg: TransformerConfig) -> Callable:
     if impl == "ring":
         from ..sequence.ring_attention import ring_attention
 
+        if cfg.ring_compression is not None:
+            import functools
+
+            return functools.partial(ring_attention,
+                                     compression=cfg.ring_compression)
         return ring_attention
     if impl == "fpdt":
         from ..sequence.fpdt import fpdt_attention
@@ -474,7 +485,8 @@ def _ffn(cfg: TransformerConfig, layer, h, training: bool = True):
                             aux_loss_coef=cfg.moe_aux_coef,
                             drop_tokens=cfg.moe_drop_tokens,
                             norm_topk=cfg.moe_norm_topk,
-                            ep_dispatch=cfg.moe_ep_dispatch)
+                            ep_dispatch=cfg.moe_ep_dispatch,
+                            ep_a2a_compression=cfg.moe_a2a_compression)
         moe_out, aux = moe_ffn(h, m["router"], m, moe_cfg,
                                activation=cfg.activation, training=training)
         if cfg.moe_shared_expert > 0:

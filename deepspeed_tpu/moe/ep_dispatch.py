@@ -60,6 +60,25 @@ from ..parallel.mesh import (BATCH_AXES, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS,
 _TOKEN_AXES = tuple(BATCH_AXES) + (SEQ_AXIS,)
 
 
+def _ep_a2a(x, a2a_spec):
+    """The expert-group exchange: exact ``lax.all_to_all`` by default;
+    with a compression spec, codes + block scales ride the wire through
+    the shared layer (comm/collectives — EQuARX's headline verb).  The
+    backward exchange stays exact (straight-through).
+
+    Trailing dims are fused into one quantized dim per destination rank:
+    quantizing raw H rows would pad each to a whole codec block (an H=16
+    row would INFLATE to 128 codes); fused, the pad is amortized over the
+    entire per-rank payload and blocks simply span token boundaries."""
+    if a2a_spec is None:
+        return jax.lax.all_to_all(x, EXPERT_AXIS, 0, 0)
+    from ..comm.collectives import compressed as _cc
+
+    flat = x.reshape(x.shape[0], -1)
+    out = _cc.all_to_all(flat, EXPERT_AXIS, a2a_spec, 0, 0, False)
+    return out.reshape(x.shape)
+
+
 def _inside_manual_axes() -> bool:
     """True when tracing inside shard_map/pmap (named axes bound) — the EP
     shard_map cannot nest there (e.g. under the pipeline's manual map)."""
@@ -125,7 +144,7 @@ def _expert_einsums(ein, wg, wu, wd, activation):
 
 
 def _capacity_block(x, gate_w, wg, wu, wd, rng, *, cfg, activation, ep,
-                    training):
+                    training, a2a_spec=None):
     """Per-EP-rank capacity dispatch (reference MOELayer + _AllToAll)."""
     from .sharded_moe import compute_capacity, top_k_gating
 
@@ -143,19 +162,19 @@ def _capacity_block(x, gate_w, wg, wu, wd, rng, *, cfg, activation, ep,
     expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
     # dispatch A2A: split the expert dim over ranks, concat source dim
     send = expert_in.reshape(ep, E_loc, cap, H)
-    recv = jax.lax.all_to_all(send, EXPERT_AXIS, 0, 0)  # [ep(src), E_loc, C, H]
+    recv = _ep_a2a(send, a2a_spec)  # [ep(src), E_loc, C, H]
     ein = recv.transpose(1, 0, 2, 3).reshape(E_loc, ep * cap, H)
 
     eout = _expert_einsums(ein, wg, wu, wd, activation)
 
     back = eout.reshape(E_loc, ep, cap, H).transpose(1, 0, 2, 3)
-    ret = jax.lax.all_to_all(back, EXPERT_AXIS, 0, 0).reshape(E, cap, H)
+    ret = _ep_a2a(back, a2a_spec).reshape(E, cap, H)
     out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ret)
     return out.reshape(Bl, Sl, H), aux
 
 
 def _dropless_block(x, gate_w, wg, wu, wd, rng, *, cfg, activation, ep,
-                    block_rows, c_send):
+                    block_rows, c_send, a2a_spec=None):
     """Per-EP-rank dropless dispatch: sort by destination rank, A2A,
     receiver sorts by local expert and runs the grouped Pallas matmul."""
     from .sharded_moe import (_expert_ffn_blocks, _gate_and_aux,
@@ -189,8 +208,8 @@ def _dropless_block(x, gate_w, wg, wu, wd, rng, *, cfg, activation, ep,
         xt[token_of], mode="drop")
     send_le = jnp.full((ep, c_send), -1, jnp.int32).at[dest_rank, rank_pos].set(
         (sorted_e % E_loc).astype(jnp.int32), mode="drop")
-    recv_x = jax.lax.all_to_all(send_x, EXPERT_AXIS, 0, 0)
-    recv_le = jax.lax.all_to_all(send_le, EXPERT_AXIS, 0, 0)
+    recv_x = _ep_a2a(send_x, a2a_spec)
+    recv_le = jax.lax.all_to_all(send_le, EXPERT_AXIS, 0, 0)  # routing: exact
 
     # receiver: re-sort the ep*c_send rows by local expert (invalid -> end)
     R = ep * c_send
@@ -210,7 +229,7 @@ def _dropless_block(x, gate_w, wg, wu, wd, rng, *, cfg, activation, ep,
 
     y_rows = jnp.zeros((R, H), ys.dtype).at[order2].set(
         ys.at[dest].get(mode="fill", fill_value=0))
-    ret = jax.lax.all_to_all(y_rows.reshape(ep, c_send, H), EXPERT_AXIS, 0, 0)
+    ret = _ep_a2a(y_rows.reshape(ep, c_send, H), a2a_spec)
     y_asgn = ret.at[dest_rank, rank_pos].get(mode="fill", fill_value=0)
     contrib = y_asgn * (flat_g[order] * keep)[:, None].astype(ys.dtype)
     out = jnp.zeros((T, H), x.dtype).at[token_of].add(contrib.astype(x.dtype))
@@ -246,9 +265,14 @@ def moe_ffn_ep(x: jnp.ndarray, gate_w: jnp.ndarray,
         # policy before the blocks bind cfg, or the dummy key would jitter
         cfg = dataclasses.replace(cfg, noisy_gate_policy=None)
 
+    from ..comm.collectives import CompressionSpec
+
+    a2a_spec = CompressionSpec.parse(
+        getattr(cfg, "ep_a2a_compression", None))
+
     if cfg.drop_tokens:
         block = partial(_capacity_block, cfg=cfg, activation=activation,
-                        ep=ep, training=training)
+                        ep=ep, training=training, a2a_spec=a2a_spec)
     else:
         A = T_loc * cfg.top_k
         factor = getattr(cfg, "ep_send_capacity_factor", None)
@@ -257,7 +281,8 @@ def moe_ffn_ep(x: jnp.ndarray, gate_w: jnp.ndarray,
         else:
             c_send = min(A, -(-math.ceil(A * factor / ep) // 8) * 8)
         block = partial(_dropless_block, cfg=cfg, activation=activation,
-                        ep=ep, block_rows=block_rows, c_send=c_send)
+                        ep=ep, block_rows=block_rows, c_send=c_send,
+                        a2a_spec=a2a_spec)
 
     rng_in = rng if rng is not None else jax.random.PRNGKey(0)
 

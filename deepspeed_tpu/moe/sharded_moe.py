@@ -44,6 +44,12 @@ class MoEConfig:
     #: (None = exact worst case, guaranteed dropless; e.g. 2.0 = balanced
     #: load with 2x slack, overflow drops — see ep_dispatch.py)
     ep_send_capacity_factor: Optional[float] = None
+    #: quantize the EP dispatch/return all-to-alls ("int8" | "fp8" | a
+    #: CompressionSpec; None = full precision).  EQuARX reports all-to-all
+    #: as the single biggest quantized-collective win; token payloads ride
+    #: codes + block scales through comm/collectives, routing metadata
+    #: stays exact (docs/COMM.md)
+    ep_a2a_compression: Optional[Any] = None
 
 
 def compute_capacity(tokens: int, cfg: MoEConfig, training: bool = True) -> int:

@@ -1,34 +1,31 @@
 """Compressed gradient collectives (1-bit-Adam-family equivalent).
 
 Reference: ``runtime/comm/{nccl,compressed}.py`` — error-feedback compressed
-allreduce backing OneBitAdam/ZeroOneAdam/OneBitLamb.  TPU version: int8
-block-quantized all-to-all reduce over the data axis using the Pallas quant
-kernels, with a persistent error-feedback buffer held in the TrainState-side
-caller.  Wire format: each rank reduce-scatters int8 shards, dequantizes,
-sums, requantizes, all-gathers — 4x less ICI traffic than fp32 allreduce at
-bf16-comparable convergence (error feedback carries the residual).
+allreduce backing OneBitAdam/ZeroOneAdam/OneBitLamb.  Since the
+``comm/collectives/`` layer exists this module is a thin configuration of
+it: int8 block-128 wire format with error feedback, mean reduction over
+the data axis.  The persistent error buffer stays caller-owned (TrainState
+/ optimizer state), exactly as the reference keeps ``worker_error`` on the
+optimizer.
+
+Wire format: the shared two-hop compressed all-reduce — quantized
+all_to_all reduce-scatter, dequantize + mean, quantized all_gather —
+~4x less interconnect traffic than fp32 allreduce at bf16-comparable
+convergence (error feedback carries the residual).
 """
 
 from __future__ import annotations
 
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 
+from ...comm.collectives import CompressionSpec
+from ...comm.collectives import compressed as _compressed
 from ...parallel.mesh import DATA_AXIS
 
-
-def _quant_dequant(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Symmetric per-128-block int8 quantize-dequantize; returns (qdq, error)."""
-    n = x.size
-    pad = (-n) % 128
-    flat = jnp.pad(x.reshape(-1), (0, pad)) if pad else x.reshape(-1)
-    blocks = flat.reshape(-1, 128)
-    scale = jnp.maximum(jnp.max(jnp.abs(blocks), -1, keepdims=True), 1e-12) / 127.0
-    q = jnp.clip(jnp.round(blocks / scale), -127, 127)
-    deq = (q * scale).reshape(-1)[:n].reshape(x.shape)
-    return deq, x - deq
+#: the 1-bit-family wire format on the shared codec
+_WIRE = CompressionSpec(format="int8", block=128, error_feedback=True)
 
 
 def compressed_all_reduce(grad: jnp.ndarray, error: Optional[jnp.ndarray] = None,
@@ -40,9 +37,5 @@ def compressed_all_reduce(grad: jnp.ndarray, error: Optional[jnp.ndarray] = None
     runtime/comm/compressed.py): compensate with the previous error, send
     the quantized value, keep the residual locally.
     """
-    if error is None:
-        error = jnp.zeros_like(grad)
-    compensated = grad + error
-    sent, new_error = _quant_dequant(compensated)
-    reduced = jax.lax.pmean(sent, axis)
-    return reduced, new_error
+    return _compressed.all_reduce(grad, op="mean", axis=axis, spec=_WIRE,
+                                  error=error)

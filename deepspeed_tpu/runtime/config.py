@@ -135,6 +135,15 @@ class ZeroConfig(ConfigModel):
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     zero_hpz_partition_size: int = 1
+    #: hierarchical two-hop gradient reduce (comm/collectives/hierarchical):
+    #: intra-slice reduce-scatter -> inter-slice exchange -> intra-slice
+    #: all-gather over a split of the data axis.  With
+    #: zero_quantized_gradients also on, the inter-slice hop moves int8
+    #: codes + block scales (the ZeRO++ 4x cross-slice reduction shape).
+    zero_hierarchical_grad_reduce: bool = False
+    #: intra-slice group size for that split (0 = auto:
+    #: utils/groups.hierarchy_split — local device count, else ~sqrt)
+    zero_hierarchy_inner: int = 0
     # MiCS-style replica-group sharding: shard within groups of this size,
     # replicate across groups (reference zero/mics.py).
     mics_shard_size: int = -1

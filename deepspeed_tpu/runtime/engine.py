@@ -300,6 +300,33 @@ class DeepSpeedTPUEngine:
                     "zero_quantized_gradients needs stage 1/2 with data-axis-"
                     "only batch parallelism (repl/expert/sequence == 1); "
                     "falling back to the XLA fp reduce")
+        self._hier_inner = 0
+        if zc.zero_hierarchical_grad_reduce:
+            from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, REPL_AXIS,
+                                         SEQ_AXIS)
+            from ..utils.groups import hierarchy_split
+
+            others = [self.topology.axis_size(a)
+                      for a in (REPL_AXIS, EXPERT_AXIS, SEQ_AXIS)]
+            world = self.topology.axis_size(DATA_AXIS)
+            try:
+                if zc.stage not in (1, 2) or any(s != 1 for s in others):
+                    raise ValueError("needs stage 1/2 with data-axis-only "
+                                     "batch parallelism")
+                inner, outer = hierarchy_split(
+                    world, zc.zero_hierarchy_inner or None)
+                self._hier_inner = inner
+                log_dist(
+                    f"hierarchical grad reduce: {inner}x{outer} two-hop "
+                    f"over '{DATA_AXIS}'"
+                    + (", int8 inter-slice exchange" if self._qgz else
+                       ", full-precision hops"))
+            except ValueError as e:
+                logger.warning(
+                    f"zero_hierarchical_grad_reduce disabled ({e}); "
+                    "falling back to the "
+                    + ("qgZ all-to-all reduce" if self._qgz
+                       else "XLA fp reduce"))
 
     # ------------------------------------------------------------------ init
     def _init_state(self) -> TrainState:
@@ -425,7 +452,7 @@ class DeepSpeedTPUEngine:
                 return loss.astype(jnp.float32) * state.loss_scale.cur_scale, loss
             return loss, loss
 
-        if self._qgz:
+        if self._qgz or self._hier_inner:
             grads, loss = self._qgz_grads(scaled_loss_fn, compute_params, batch)
         else:
             grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
@@ -443,11 +470,13 @@ class DeepSpeedTPUEngine:
         return state, loss.astype(jnp.float32)
 
     def _qgz_grads(self, scaled_loss_fn, compute_params, batch):
-        """qgZ (ZeRO++ quantized gradient reduce): compute PER-DATA-SHARD
+        """Explicit compressed gradient reduce: compute PER-DATA-SHARD
         partial gradients (vmap over batch chunks — embarrassingly parallel,
-        XLA inserts no gradient collective) and reduce them with an explicit
-        int8 all-to-all (reference all_to_all_quant_reduce,
-        runtime/comm/coalesced_collectives.py:31)."""
+        XLA inserts no gradient collective) and reduce them through
+        ``comm/collectives``: either qgZ's int8 all-to-all (reference
+        all_to_all_quant_reduce, runtime/comm/coalesced_collectives.py:31)
+        or the hierarchical two-hop when ``zero_hierarchical_grad_reduce``
+        split the data axis (int8 inter-slice hop iff qgZ is also on)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS
@@ -486,6 +515,21 @@ class DeepSpeedTPUEngine:
             lambda g, s: jax.lax.with_sharding_constraint(
                 g, jax.sharding.NamedSharding(self.topology.mesh, s)),
             grads_c, chunk_specs)
+        if self._hier_inner:
+            # NOTE: the hierarchical reduce reassembles the FULL gradient
+            # (hop-3 all-gather) for every leaf; stage-2 data-scattered
+            # accumulation leaves then pay a reshard in constrain() that
+            # the qgZ scattered path avoids — see docs/COMM.md (known
+            # trade; a scattered hierarchical variant is future work)
+            from ..comm.collectives import (CompressionSpec,
+                                            hierarchical_grad_reduce)
+
+            grads = hierarchical_grad_reduce(
+                grads_c, chunk_specs, self.topology.mesh,
+                inner=self._hier_inner,
+                compression=CompressionSpec(format="int8")
+                if self._qgz else None)
+            return grads, jnp.mean(losses)
         # target = the accumulation buffer's sharding: data-sharded leaves
         # come back as the SCATTERED partition (one all_to_all, no hop-2
         # gather — reference all_to_all_quant_reduce returns the partition)

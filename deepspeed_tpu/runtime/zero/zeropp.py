@@ -28,41 +28,34 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...comm.collectives.codec import (CompressionSpec, dequantize_blockwise,
+                                       quantize_blockwise)
 from ...parallel.mesh import DATA_AXIS
 from ...utils.jax_compat import shard_map
-from ...utils.logging import logger
 
 QBLOCK = 128  # quantization block (reference csrc/quantization group size)
 
+#: the ZeRO++ wire format, expressed on the shared codec
+#: (comm/collectives/codec.py) — qwZ/qgZ are configurations of the
+#: first-class compressed-collective layer, not parallel implementations
+_WIRE = CompressionSpec(format="int8", block=QBLOCK)
+
 
 # ---------------------------------------------------------------------------
-# shape-preserving blockwise int8 quant (jnp: fuses + shards under SPMD)
+# shape-preserving blockwise int8 quant — thin aliases over the shared
+# codec (kept: the qwZ gather below and test_zeropp address this module)
 # ---------------------------------------------------------------------------
 def quantize_lastdim(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     """Symmetric int8 per-QBLOCK along the last dim, keeping array rank:
     returns (codes int8 [..., Dpad], scales fp32 [..., Dpad/QBLOCK], D)."""
-    d = x.shape[-1]
-    pad = (-d) % QBLOCK
-    if pad:
-        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
-    blocks = x.reshape(*x.shape[:-1], x.shape[-1] // QBLOCK, QBLOCK)
-    blocks = blocks.astype(jnp.float32)
-    scale = jnp.maximum(jnp.max(jnp.abs(blocks), -1), 1e-12) / 127.0
-    q = jnp.clip(jnp.round(blocks / scale[..., None]), -127, 127)
-    return q.reshape(*x.shape).astype(jnp.int8), scale, d
+    return quantize_blockwise(x, _WIRE)
 
 
 def dequantize_lastdim(q: jnp.ndarray, scale: jnp.ndarray, d: int,
                        dtype=jnp.bfloat16) -> jnp.ndarray:
-    blocks = q.reshape(*q.shape[:-1], q.shape[-1] // QBLOCK, QBLOCK)
-    x = blocks.astype(jnp.float32) * scale[..., None]
-    x = x.reshape(*q.shape)
-    if d != q.shape[-1]:
-        x = x[..., :d]
-    return x.astype(dtype)
+    return dequantize_blockwise(q, scale, d, dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -115,38 +108,19 @@ qwz_gather.defvjp(_qwz_fwd, _qwz_bwd)
 # ---------------------------------------------------------------------------
 def _a2a_quant_reduce_flat(g: jnp.ndarray, axis: str, world: int) -> jnp.ndarray:
     """Inside shard_map: ``g`` is this rank's partial gradient [n]; returns
-    the mean over ``axis`` with int8 codes on the wire in both hops.
+    the mean over ``axis`` with int8 codes on the wire in both hops — the
+    shared layer's two-hop compressed all-reduce
+    (``comm/collectives/compressed.all_reduce``: quantized all_to_all
+    reduce-scatter, then quantized all_gather back to a full gradient).
+    Leaves whose target sharding IS data-partitioned skip hop 2 via
+    ``_a2a_quant_reduce_scattered``."""
+    from ...comm.collectives import compressed as _cc
 
-    hop 1: split into ``world`` slots, quantize, all_to_all (each rank
-           receives its slot from everyone), dequantize + mean  — the
-           quantized reduce-scatter.
-    hop 2: quantize the reduced slot, all_gather, dequantize — the
-           quantized all-gather back to a full gradient.
-    """
-    n = g.size
-    slot = -(-n // world)
-    slot = -(-slot // QBLOCK) * QBLOCK  # whole quant blocks per slot
-    pad = slot * world - n
-    flat = jnp.pad(g.reshape(-1), (0, pad)) if pad else g.reshape(-1)
-    chunks = flat.reshape(world, slot)
-
-    q, s, _ = quantize_lastdim(chunks)  # [W, slot] int8, [W, slot/B] f32
-    # split_axis=0/concat_axis=0 with tiled=False: receive [W, slot] — rank
-    # r's row w is rank w's chunk r
-    q_r = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
-    s_r = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
-    partials = dequantize_lastdim(q_r, s_r, slot, jnp.float32)  # [W, slot]
-    reduced = jnp.mean(partials, axis=0)  # this rank's slot, reduced
-
-    # hop 2 gathers the reduced slots back to a full gradient (int8 wire) —
-    # only for leaves whose target sharding is NOT data-partitioned (they
-    # need the full value on every rank).  Data-sharded leaves take
-    # _a2a_quant_reduce_scattered instead: one all_to_all, no gather back.
-    q2, s2, _ = quantize_lastdim(reduced[None])  # [1, slot]
-    q2 = jax.lax.all_gather(q2, axis, axis=0, tiled=True)  # [W, slot]
-    s2 = jax.lax.all_gather(s2, axis, axis=0, tiled=True)
-    full = dequantize_lastdim(q2, s2, slot, jnp.float32).reshape(-1)
-    return full[:n].reshape(g.shape)
+    # out_dtype fp32: the mean is fp32-accumulated and the engine casts to
+    # grad_accum_dtype itself — rounding to the compute dtype here would
+    # add a lossy step the pre-rebase implementation never had
+    return _cc.all_reduce(g, op="mean", axis=axis, spec=_WIRE,
+                          out_dtype=jnp.float32)
 
 
 def _a2a_quant_reduce_scattered(g: jnp.ndarray, axis: str, world: int,
@@ -155,17 +129,12 @@ def _a2a_quant_reduce_scattered(g: jnp.ndarray, axis: str, world: int,
     ``shard_dim`` — the slot layout IS the target sharding, so the single
     all_to_all is the whole reduction (reference all_to_all_quant_reduce
     returns the scattered partition, coalesced_collectives.py:31; no
-    follow-up gather)."""
-    gm = jnp.moveaxis(g, shard_dim, 0)
-    shard = gm.shape[0] // world
-    rest = gm.shape[1:]
-    chunks = gm.reshape(world, -1)  # row w = shard w of the target layout
-    q, s, d = quantize_lastdim(chunks)
-    q_r = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0)
-    s_r = jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
-    partials = dequantize_lastdim(q_r, s_r, d, jnp.float32)  # [W, shard*rest]
-    reduced = jnp.mean(partials, axis=0)
-    return jnp.moveaxis(reduced.reshape(shard, *rest), 0, shard_dim)
+    follow-up gather).  Delegates to the shared layer's compressed
+    reduce-scatter."""
+    from ...comm.collectives import compressed as _cc
+
+    return _cc.reduce_scatter(g, op="mean", axis=axis, spec=_WIRE,
+                              scatter_dim=shard_dim, out_dtype=jnp.float32)
 
 
 def _entry_axes(entry) -> tuple:

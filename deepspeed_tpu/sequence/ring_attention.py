@@ -33,8 +33,16 @@ def _chunk_scores(q, k, scale):
     return jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) * scale
 
 
-def _ring_body(qkv, causal: bool):
-    """shard_map body: per-rank q,k,v chunks [B, S_local, NH, D]."""
+def _ring_body(qkv, causal: bool, spec=None):
+    """shard_map body: per-rank q,k,v chunks [B, S_local, NH, D].
+
+    ``spec`` (a ``comm/collectives`` CompressionSpec): the K/V ring
+    rotations move codes + block scales instead of full-precision values
+    — the rotation volume is 2x the resident K/V per step, so it is the
+    whole wire cost of context parallelism.  Heads are fused into one
+    trailing dim for quantization (per-token blocks); the backward
+    rotation stays exact (straight-through, see collectives.ppermute).
+    """
     q, k, v = qkv
     sp = jax.lax.psum(1, SEQ_AXIS)
     my = jax.lax.axis_index(SEQ_AXIS)
@@ -42,6 +50,19 @@ def _ring_body(qkv, causal: bool):
     scale = 1.0 / math.sqrt(D)
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    if spec is None:
+        def rotate(t):
+            return jax.lax.ppermute(t, SEQ_AXIS, perm)
+    else:
+        from ..comm.collectives import compressed as _cc
+
+        pperm = tuple(perm)
+
+        def rotate(t):
+            flat = _cc.ppermute(t.reshape(B, S, NH * D), pperm, SEQ_AXIS,
+                                spec)
+            return flat.reshape(B, S, NH, D)
 
     # bound the materialized score block to [B, NH, S, kc] instead of
     # [B, NH, S, S]: at long local context (the whole point of CP) the
@@ -82,8 +103,8 @@ def _ring_body(qkv, causal: bool):
         col0s = src * S + jnp.arange(nc) * kc
         (acc, m_new, l_new), _ = jax.lax.scan(
             one_kv_chunk, (acc, m_prev, l_prev), (k_chunks, v_chunks, col0s))
-        k_nxt = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
-        v_nxt = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        k_nxt = rotate(k_cur)
+        v_nxt = rotate(v_cur)
         return acc, m_new, l_new, k_nxt, v_nxt
 
     acc0 = jnp.zeros((B, S, NH, D), jnp.float32)
@@ -94,9 +115,19 @@ def _ring_body(qkv, causal: bool):
     return (acc / l).astype(q.dtype)
 
 
-def ring_attention(q, k, v, causal: bool = True, mask=None):
+def ring_attention(q, k, v, causal: bool = True, mask=None,
+                   compression=None):
     """Drop-in ``attn_fn`` ([B, S, NH, D] global); seq dim sharded over the
-    "sequence" axis ring."""
+    "sequence" axis ring.
+
+    ``compression``: a ``CompressionSpec`` / "int8" / "fp8" quantizes the
+    K/V ring exchanges (env default ``DSTPU_RING_COMPRESSION``; model
+    configs set ``ring_compression``).  None keeps the exact ring."""
+    from ..comm.collectives import CompressionSpec
+
+    if compression is None:
+        compression = os.environ.get("DSTPU_RING_COMPRESSION") or None
+    cspec = CompressionSpec.parse(compression)
     topo = get_topology()
     if topo.seq_parallel_size <= 1:
         from ..models.transformer import xla_attention
@@ -107,6 +138,6 @@ def ring_attention(q, k, v, causal: bool = True, mask=None):
                                   "ulysses or pad to full blocks")
     spec = P(BATCH_AXES, SEQ_AXIS, None, None)
     fn = shard_map(
-        functools.partial(_ring_body, causal=causal),
+        functools.partial(_ring_body, causal=causal, spec=cspec),
         mesh=topo.mesh, in_specs=(spec,), out_specs=spec, check_vma=False)
     return fn((q, k, v))

@@ -1,0 +1,72 @@
+"""Mesh-axis group arithmetic (reference ``deepspeed/utils/groups.py``).
+
+The reference carves torch process groups out of the world; on TPU the
+mesh axes already name every parallel group, so what is left to own here
+is the *hierarchy split*: dividing one mesh axis of size ``world`` into
+``inner`` (intra-slice, fast ICI) x ``outer`` (inter-slice, slow DCN)
+rank groups for the two-hop collectives in
+``comm/collectives/hierarchical.py`` (ZeRO++ hpZ-style, PAPERS.md).
+
+Groups are expressed as ``axis_index_groups`` lists for ``jax.lax``
+collectives — ranks are indices along the named axis, contiguous runs of
+``inner`` form a slice (how ``mesh_utils.create_device_mesh`` lays
+slices out along an axis).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional, Tuple
+
+
+def hierarchy_split(world: int, inner: Optional[int] = None
+                    ) -> Tuple[int, int]:
+    """Split a ``world``-rank axis into ``(inner, outer)`` groups.
+
+    ``inner`` explicit: validated (must divide ``world``, 1 < inner <
+    world).  ``inner=None``: auto — prefer the local-device count (the
+    physical slice boundary) when it yields a real split, else the
+    largest divisor <= sqrt(world).  Raises when no split exists
+    (world < 4 or prime).
+    """
+    if world < 4:
+        raise ValueError(
+            f"hierarchy_split: a {world}-rank axis has no two-hop split "
+            "(needs world >= 4)")
+    if inner is not None:
+        if inner <= 1 or inner >= world or world % inner:
+            raise ValueError(
+                f"hierarchy_split: inner={inner} must divide world="
+                f"{world} with 1 < inner < world")
+        return inner, world // inner
+    env = os.environ.get("DSTPU_HIERARCHY_INNER")
+    if env:
+        return hierarchy_split(world, int(env))
+    try:
+        import jax
+
+        local = jax.local_device_count()
+    except Exception:
+        local = 0
+    if 1 < local < world and world % local == 0:
+        return local, world // local
+    root = int(math.isqrt(world))
+    for cand in range(root, 1, -1):
+        if world % cand == 0:
+            return cand, world // cand
+    raise ValueError(f"hierarchy_split: world={world} is prime; no split")
+
+
+def inner_groups(world: int, inner: int) -> List[List[int]]:
+    """Contiguous intra-slice groups: ``[[0..inner-1], [inner..], ...]``."""
+    outer = world // inner
+    return [[s * inner + i for i in range(inner)] for s in range(outer)]
+
+
+def outer_groups(world: int, inner: int) -> List[List[int]]:
+    """Strided inter-slice groups: rank ``s*inner + i`` talks to every
+    other slice's rank ``i`` — the peers holding the same intra-slice
+    scatter slot."""
+    outer = world // inner
+    return [[s * inner + i for s in range(outer)] for i in range(inner)]

@@ -252,10 +252,25 @@ def test_golden_contracts_hold(contracts_mod, extracted):
     assert len(goldens) >= 6, sorted(goldens)
     for required in ("train_step_zero0", "train_step_zero1",
                      "train_step_zero3", "prefill", "decode",
-                     "paged_verify"):
+                     "paged_verify", "train_step_zero1_hier",
+                     "moe_dispatch_quantized"):
         assert required in goldens, f"missing golden for {required}"
     errors = contracts_mod.diff_all(goldens, extracted)
     assert not errors, "\n".join(errors)
+
+
+def test_compressed_collective_contracts_pin_wire_shape(contracts_mod,
+                                                        extracted):
+    """The PR-11 programs pin the compressed-collective wire shape: the
+    hierarchical train step keeps its reduce-scatter + all-gather hops
+    and the quantized MoE dispatch keeps its all-to-alls (codes + scales
+    ride combined ops; a fallback to full-precision dispatch or a
+    lost/duplicated exchange changes these counts)."""
+    hier = extracted["train_step_zero1_hier"]["contract"]["collectives"]
+    assert hier["reduce-scatter"] >= 1, hier
+    assert hier["all-gather"] >= 2, hier
+    moe = extracted["moe_dispatch_quantized"]["contract"]["collectives"]
+    assert moe["all-to-all"] >= 1, moe
 
 
 def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
@@ -273,23 +288,28 @@ def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
     assert "train_step_zero3" in errs[0]
 
 
-def test_update_goldens_idempotent(contracts_mod, extracted, tmp_path):
+@pytest.mark.parametrize("program", ["prefill", "moe_dispatch_quantized",
+                                     "train_step_zero1_hier"])
+def test_update_goldens_idempotent(contracts_mod, extracted, tmp_path,
+                                   program):
     """Writing goldens twice — the second time from a fresh extraction of
-    the same program — is byte-identical."""
-    first = {"prefill": extracted["prefill"]}
+    the same program — is byte-identical (covers the PR-11 compressed-
+    collective programs too: their topology setup must not leak state
+    between extractions)."""
+    first = {program: extracted[program]}
     contracts_mod.write_goldens(str(tmp_path), first)
     path = os.path.join(contracts_mod.goldens_dir(str(tmp_path)),
-                        "prefill.json")
+                        f"{program}.json")
     with open(path) as f:
         bytes1 = f.read()
-    again = contracts_mod.extract_program("prefill")
-    contracts_mod.write_goldens(str(tmp_path), {"prefill": again})
+    again = contracts_mod.extract_program(program)
+    contracts_mod.write_goldens(str(tmp_path), {program: again})
     with open(path) as f:
         bytes2 = f.read()
     assert bytes1 == bytes2
     # and the round-trip loads back as the same contract
     loaded = contracts_mod.load_goldens(str(tmp_path))
-    assert contracts_mod.diff_all(loaded, {"prefill": again}) == []
+    assert contracts_mod.diff_all(loaded, {program: again}) == []
 
 
 def test_train_replay_recompile_contract(contracts_mod, extracted):

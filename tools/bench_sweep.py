@@ -13,12 +13,51 @@ exactly what the driver's bench measures.
 
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _contract_gate() -> str:
+    """Refuse to sweep against stale golden contracts (ROADMAP item 5):
+    a perf artifact measured under program contracts that no longer match
+    the tree is exactly the silent lie the contracts exist to prevent.
+    Runs ``tools/check_contracts.py`` in a subprocess (it pins its own
+    CPU harness) and returns the ``contract_set_hash`` stamped into every
+    sweep record — same provenance bench.py already carries.  Skippable
+    with DSTPU_SWEEP_SKIP_CONTRACTS=1 (the hash is stamped regardless).
+    """
+    # contract_set_hash is stdlib-only; load by file path so the sweep
+    # driver itself never imports jax.  The module comes from THIS tree
+    # (next to the tool — ROOT may be redirected to an artifact dir);
+    # the hash is computed over ROOT's goldens.
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "dstpu_contracts_hash",
+        os.path.join(here, "deepspeed_tpu", "analysis", "contracts.py"))
+    contracts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(contracts)
+    h = contracts.contract_set_hash(ROOT)
+    if os.environ.get("DSTPU_SWEEP_SKIP_CONTRACTS") == "1":
+        print("bench_sweep: contract check SKIPPED "
+              "(DSTPU_SWEEP_SKIP_CONTRACTS=1)", file=sys.stderr)
+        return h
+    print("bench_sweep: checking golden contracts before sweeping...",
+          file=sys.stderr, flush=True)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_contracts.py")],
+        capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        print(proc.stdout[-3000:], file=sys.stderr)
+        sys.exit("bench_sweep: REFUSING to sweep — golden contracts are "
+                 "stale (see violations above).  Fix the regression or "
+                 "regenerate with tools/check_contracts.py "
+                 "--update-goldens, then re-run.")
+    return h
 
 RUNGS = {
     # headline: the round-3 PERF_NOTES configuration; bs unpinned so the
@@ -90,6 +129,7 @@ def main() -> int:
     names = sys.argv[1:] or list(RUNGS)
     # test hook: JSON dict merged over every rung (e.g. shrink sizes on CPU)
     overrides = json.loads(os.environ.get("DSTPU_SWEEP_OVERRIDES", "{}"))
+    contract_hash = _contract_gate()
     out = []
     # DSTPU_SWEEP_CPU=1 forces bench.py's --cpu pin (the site TPU plugin
     # pins the platform via jax.config, so the env var alone can't)
@@ -106,7 +146,8 @@ def main() -> int:
         script = os.path.join(ROOT, "tools", tool + ".py") if tool \
             else os.path.join(ROOT, "bench.py")
         print(f"=== rung {name}: {rung}", file=sys.stderr, flush=True)
-        rec = {"rung": name, "env": rung}
+        rec = {"rung": name, "env": rung,
+               "contract_set_hash": contract_hash}
         try:
             # budget: the hang-proof ladder's worst case is
             # 3 rungs x (rung_timeout + 240s post-hang probe) + a CPU
