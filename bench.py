@@ -30,6 +30,37 @@ def _int_env(name: str, default: int) -> int:
 # slow; a hung tunnel must not zero out the benchmark (round-1 BENCH rc=1).
 _PROBE_TIMEOUT_S = _int_env("DSTPU_BENCH_PROBE_TIMEOUT", 240)
 
+#: XLA latency-hiding-scheduler flags pinned into every TPU CHILD rung —
+#: the backstop that lets the scheduler actually hide the in-loop
+#: collectives the overlap wrap issues (runtime/zero/overlap.py).  This
+#: is a deliberate copy of compile/backend.py LATENCY_HIDING_FLAGS: the
+#: parent process never imports the package (a site TPU plugin could
+#: wedge at import), and tests/unit/test_overlap.py asserts the copies
+#: match.  TPU-only — never pinned into CPU children, where unknown
+#: flags abort XLA startup.  DSTPU_BENCH_NO_LHS_FLAGS=1 opts out.
+_LATENCY_HIDING_FLAGS = {
+    "--xla_tpu_enable_latency_hiding_scheduler": "true",
+    "--xla_tpu_enable_async_collective_fusion": "true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather": "true",
+}
+
+
+def _pin_overlap_flags(env: dict) -> dict:
+    """Child-env copy with the missing latency-hiding flags appended to
+    XLA_FLAGS (explicit operator values are left alone).  Presence is
+    token-parsed, not substring-matched — a flag that prefixes a longer
+    flag's name (fusion vs fusion_fuse_all_gather) must still pin."""
+    if os.environ.get("DSTPU_BENCH_NO_LHS_FLAGS") == "1":
+        return env
+    cur = env.get("XLA_FLAGS", "")
+    present = {tok.split("=", 1)[0] for tok in cur.split()
+               if tok.startswith("--")}
+    missing = [f"{k}={v}" for k, v in _LATENCY_HIDING_FLAGS.items()
+               if k not in present]
+    if not missing:
+        return env
+    return dict(env, XLA_FLAGS=" ".join([cur.strip()] + missing).strip())
+
 
 def _pin_cpu() -> None:
     """Force the CPU platform, overriding any site-plugin pin."""
@@ -200,8 +231,16 @@ def build_model_and_config(size: str, seq: int, micro_bs: int, env=None,
     if env.get("DSTPU_BENCH_OFFLOAD") == "1":
         zero_cfg["offload_optimizer"] = {"device": "cpu"}
     if env.get("DSTPU_BENCH_PREFETCH") == "1":
-        # stage-3 manual prefetch A/B (2x-unrolled layer scan)
+        # stage-3 manual prefetch A/B (explicit in-loop gathers on the
+        # 2x-unrolled layer scan)
         zero_cfg["zero3_param_prefetch"] = True
+    if env.get("DSTPU_BENCH_OVERLAP") == "1":
+        # compute/collective overlap A/B (runtime/zero/overlap.py):
+        # per-layer-bucket grad reduce inside the backward loop
+        zero_cfg["overlap_grad_reduce"] = True
+    if env.get("DSTPU_BENCH_OVERLAP_BUCKET_MB"):
+        zero_cfg["overlap_bucket_mb"] = float(
+            env["DSTPU_BENCH_OVERLAP_BUCKET_MB"])
     opt_params = {"lr": 1e-4, "weight_decay": 0.1}
     if env.get("DSTPU_BENCH_MU_DTYPE"):
         # bf16 exp_avg: -2 bytes/param of optimizer HBM (helps the 1b
@@ -298,6 +337,16 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     # perf trajectory instead of silently masquerading as a regression
     # (BENCH_r03–r05 did exactly that; ROADMAP item 5).
     result["comparable"] = jax.default_backend() != "cpu"
+    # exposure accounting (telemetry/overlap.py): the perf trajectory
+    # records how much of the grad exchange is overlap-scheduled, not
+    # just walls — a wall regression with an unchanged fraction is not
+    # an overlap regression (tools/bench_sweep.py carries these into
+    # every rung record)
+    rep = engine.overlap_report()
+    if rep is not None:
+        result["overlapped_fraction"] = round(rep.overlapped_fraction, 4)
+        result["exposed_collective_seconds_per_step_est"] = round(
+            rep.exposed_seconds_per_step, 6)
     # provenance: which program contracts (tests/contracts/*.json) this
     # result ran under — a perf claim is only comparable to another run
     # with the same contract-set hash (same collectives, same donation)
@@ -422,6 +471,128 @@ def _ab_compression() -> None:
     }))
 
 
+def _ab_overlap() -> None:
+    """Deterministic CPU *training* tier for the compute/collective
+    overlap (docs/COMM.md "Overlap & scheduling"): fixed tiny scanned
+    llama on the 8-virtual-device harness, pinned seeds, median-of-k
+    walls, ``comparable: true``.
+
+    Arms, per ZeRO stage in {1, 3}:
+      * ``off``        — the legacy GSPMD step (no wrap);
+      * ``unbucketed`` — overlap wrap with ``overlap_bucket_mb=0``
+        (per-leaf buckets, no coalescing);
+      * ``on``         — overlap wrap, default buckets (+
+        ``zero3_param_prefetch`` at stage 3).
+
+    Machine-checked claims in the JSON:
+      * determinism — the ``on`` arm re-run from scratch reproduces its
+        loss curve bit-for-bit;
+      * ``identical_to_unbucketed`` — ``on`` vs ``unbucketed`` losses
+        are BIT-EXACT (bucketing/prefetch are scheduling, not math);
+      * ``loss_parity_max_rel`` — ``on`` vs ``off``: the wrap fixes the
+        per-shard summation order, while GSPMD is free to pick another
+        (it even differs between stages at HEAD), so this is fp
+        reassociation noise, asserted < 1e-4;
+      * ``overlapped_fraction`` per arm (0 for ``off``) and the bucket
+        count, traceable to the ``train_step_zero1_overlap`` /
+        ``train_step_zero3_prefetch`` goldens via ``contract_set_hash``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.parallel.mesh import reset_topology
+
+    steps = _int_env("DSTPU_BENCH_AB_STEPS", 6)
+    repeats = _int_env("DSTPU_BENCH_AB_REPEATS", 3)
+    seq, micro_bs = 32, 1
+
+    def run(stage, overlap, bucket_mb=4.0, prefetch=False):
+        reset_topology()
+        model = llama_model("tiny", max_seq_len=seq)
+        zero_cfg = {"stage": stage, "overlap_grad_reduce": overlap,
+                    "overlap_bucket_mb": bucket_mb}
+        if prefetch:
+            zero_cfg["zero3_param_prefetch"] = True
+        engine, *_ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": zero_cfg,
+        })
+        dp = engine.topology.dp_world_size
+        rng = np.random.RandomState(0)  # pinned: every arm sees one stream
+        vocab = model.config.vocab_size
+        batches = [{"input_ids": jnp.asarray(
+            rng.randint(0, vocab, (1, micro_bs * dp, seq)).astype(np.int32))}
+            for _ in range(steps)]
+        losses = [float(engine.train_batch(b)) for b in batches]
+        walls = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for b in batches:
+                loss = engine.train_batch(b)
+            jax.block_until_ready(loss)
+            walls.append(time.perf_counter() - t0)
+        rep = engine.overlap_report()
+        return {"losses": losses,
+                "wall_median_s": sorted(walls)[len(walls) // 2],
+                "overlapped_fraction": (round(rep.overlapped_fraction, 4)
+                                        if rep else 0.0),
+                "buckets": rep.buckets if rep else 0}
+
+    out = {"metric": "ab-overlap: per-layer-bucket grad reduce + stage-3 "
+                     f"gather prefetch vs the post-backward block (tiny "
+                     f"llama, seq={seq}, steps={steps})",
+           "unit": "overlapped fraction of grad-exchange bytes",
+           "comparable": True,  # deterministic pinned-seed CPU tier
+           "stages": {}}
+    worst_parity = 0.0
+    for stage in (1, 3):
+        off = run(stage, overlap=False)
+        unb = run(stage, overlap=True, bucket_mb=0.0,
+                  prefetch=(stage == 3))
+        on = run(stage, overlap=True, prefetch=(stage == 3))
+        on2 = run(stage, overlap=True, prefetch=(stage == 3))
+        assert on["losses"] == on2["losses"], \
+            f"stage {stage}: CPU tier is not deterministic"
+        identical = on["losses"] == unb["losses"]
+        assert identical, (
+            f"stage {stage}: bucketed overlap diverged from the "
+            f"unbucketed path — scheduling changed the math\n"
+            f"on:  {on['losses']}\nunb: {unb['losses']}")
+        parity = max(abs(a - b) / max(abs(a), 1e-9)
+                     for a, b in zip(off["losses"], on["losses"]))
+        worst_parity = max(worst_parity, parity)
+        out["stages"][f"zero{stage}"] = {
+            "contract": ("train_step_zero1_overlap" if stage == 1
+                         else "train_step_zero3_prefetch"),
+            "identical_to_unbucketed": identical,
+            "loss_parity_max_rel_vs_off": round(parity, 7),
+            "final_loss_off": off["losses"][-1],
+            "final_loss_on": on["losses"][-1],
+            "overlapped_fraction": on["overlapped_fraction"],
+            "buckets": on["buckets"],
+            "wall_median_s": {"off": round(off["wall_median_s"], 4),
+                              "unbucketed": round(unb["wall_median_s"], 4),
+                              "on": round(on["wall_median_s"], 4)},
+        }
+    assert worst_parity < 1e-4, \
+        f"overlap-on vs overlap-off loss gap {worst_parity} is not " \
+        "reassociation-sized"
+    import jax as _jax
+
+    out["backend"] = _jax.default_backend()
+    out["value"] = out["stages"]["zero1"]["overlapped_fraction"]
+    out["loss_parity_ok"] = worst_parity < 1e-4
+    from deepspeed_tpu.analysis.contracts import contract_set_hash
+
+    out["contract_set_hash"] = contract_set_hash(
+        os.path.dirname(os.path.abspath(__file__)))
+    print(json.dumps(out))
+
+
 def _release_device_memory() -> None:
     """Free every live device array before retrying a smaller rung.
 
@@ -544,9 +715,10 @@ def _parent_ladder() -> int:
             bs_ladder = ladder
         mosaic_failure = False
         for i, bs in enumerate(bs_ladder):
-            env = dict(os.environ, DSTPU_BENCH_SIZE=size,
-                       DSTPU_BENCH_SEQ=str(seq), DSTPU_BENCH_STEPS=str(steps),
-                       DSTPU_BENCH_BS=str(bs), DSTPU_BENCH_ATTN=attn)
+            env = _pin_overlap_flags(dict(
+                os.environ, DSTPU_BENCH_SIZE=size,
+                DSTPU_BENCH_SEQ=str(seq), DSTPU_BENCH_STEPS=str(steps),
+                DSTPU_BENCH_BS=str(bs), DSTPU_BENCH_ATTN=attn))
             try:
                 proc = subprocess.run([sys.executable, __file__, "--child"],
                                       capture_output=True, text=True, env=env,
@@ -599,7 +771,15 @@ def _parent_ladder() -> int:
 
 
 if __name__ == "__main__":
-    if "--ab-compression" in sys.argv:
+    if "--ab-overlap" in sys.argv:
+        # deterministic CPU tier: 8 virtual devices, pinned platform
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        _pin_cpu()
+        _ab_overlap()
+    elif "--ab-compression" in sys.argv:
         # the deterministic CPU training tier needs the 8-virtual-device
         # harness (hierarchy split of the data axis) — pin BEFORE jax loads
         flags = os.environ.get("XLA_FLAGS", "")

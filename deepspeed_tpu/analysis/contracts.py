@@ -305,6 +305,49 @@ def _moe_dispatch_program() -> Dict[str, Any]:
             "mesh": topo.mesh, "extras": {}, "replay": None}
 
 
+def _train_overlap_program(stage: int, prefetch: bool = False
+                           ) -> Dict[str, Any]:
+    """Fused train step with the compute/collective overlap wrap
+    (runtime/zero/overlap.py) on a tiny SCANNED llama — the MLP spec has
+    no layer scan, and the overlap contract exists precisely to pin the
+    in-loop collective structure (bucketed grad reduce; stage 3: explicit
+    prefetched gathers + reduce-scatters).  Replay is pinned at 0
+    recompiles: the wrap must not introduce shape-signature churn."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from ..models.llama import llama_model
+    from ..telemetry.memory import tree_bytes
+
+    zero_cfg: Dict[str, Any] = {"stage": stage, "overlap_grad_reduce": True}
+    if prefetch:
+        zero_cfg["zero3_param_prefetch"] = True
+    model = llama_model("tiny", max_seq_len=16, vocab_size=64, n_layers=2,
+                        attn_impl="xla")
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": zero_cfg,
+    })
+    dp = engine.topology.dp_world_size
+    ids = np.random.RandomState(0).randint(0, 64, (1, dp, 16)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids)}
+    args = (engine.state, batch, jax.random.PRNGKey(0))
+    dev_b, host_b = tree_bytes(engine.state)
+    extras = {"state_bytes_device": int(dev_b),
+              "state_bytes_host": int(host_b)}
+    report = engine.overlap_report()
+    if report is not None:
+        extras["overlap_buckets"] = int(report.buckets)
+        extras["overlapped_fraction"] = round(report.overlapped_fraction, 6)
+    return {"fn": engine._train_batch, "args": args,
+            "mesh": engine.topology.mesh, "extras": extras,
+            "replay": lambda: _replay_train(engine, batch)}
+
+
 #: name -> (builder, description).  The builder returns the dict
 #: consumed by :func:`extract_program`; descriptions land in the golden
 #: JSON so a diff reader knows what program regressed.
@@ -331,6 +374,17 @@ PROGRAM_BUILDERS: Dict[str, Tuple[Callable[[], Dict[str, Any]], str]] = {
         "fused train step, ZeRO stage 1 + hierarchical two-hop gradient "
         "reduce (2x4 split of the data axis: intra-slice reduce-scatter, "
         "int8 inter-slice exchange, intra-slice all-gather)"),
+    "train_step_zero1_overlap": (
+        lambda: _train_overlap_program(1),
+        "fused train step, ZeRO stage 1 + compute/collective overlap "
+        "(tiny scanned llama; per-layer-bucket grad all-reduce issued "
+        "inside the backward scan via the data-axis shard_map wrap)"),
+    "train_step_zero3_prefetch": (
+        lambda: _train_overlap_program(3, prefetch=True),
+        "fused train step, ZeRO stage 3 + overlap + zero3_param_prefetch "
+        "(tiny scanned llama; explicit in-loop param all-gathers, "
+        "2x-unrolled double buffer, per-layer reduce-scatter in the "
+        "backward loop)"),
     "moe_dispatch_quantized": (
         _moe_dispatch_program,
         "expert-parallel dropless MoE dispatch with int8-quantized "
@@ -418,7 +472,7 @@ def diff_contract(name: str, golden: Dict[str, Any],
                     f"{g.get('arg_shapes')} -> {n.get('arg_shapes')} "
                     "(every caller recompiles)")
     for field in ("state_bytes_device", "state_bytes_host", "param_bytes",
-                  "kv_pool_bytes"):
+                  "kv_pool_bytes", "overlap_buckets", "overlapped_fraction"):
         if field in g or field in n:
             a, b = g.get(field), n.get(field)
             if a != b:

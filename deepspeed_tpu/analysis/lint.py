@@ -46,6 +46,16 @@ jobs at runtime but are perfectly visible at review time:
     *processes* — in code that derives PartitionSpecs or flattens
     pytrees, that is cross-host sharding skew waiting to happen.
 
+``grad-overlap``
+    Regression guard for the compute/collective overlap structure
+    (runtime/zero/overlap.py, docs/COMM.md "Overlap & scheduling"): the
+    explicit gradient reducers must route their leaves through the
+    shared bucketer, and the transformer forward must keep its overlap
+    hook point.  A refactor that quietly reverts to a monolithic
+    post-backward grad reduce — per-leaf collectives after the whole
+    backward, nothing overlapped — fails this rule by name instead of
+    silently regressing MFU.
+
 Suppression: every rule honors an inline allowlist comment on the
 violation line or the line above::
 
@@ -67,7 +77,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 #: rule ids (the catalog in docs/STATIC_ANALYSIS.md mirrors this)
 RULES = ("host-sync", "wall-clock", "unseeded-random", "swallow",
-         "mutable-default", "pytree-order")
+         "mutable-default", "pytree-order", "grad-overlap")
 
 ALLOW_RE = re.compile(
     r"#\s*dstpu-lint:\s*allow\[(?P<rules>[a-z, -]+)\]\s*(?P<reason>.*)")
@@ -114,9 +124,11 @@ SHARDING_FILES = (
     os.path.join("deepspeed_tpu", "module_inject", "auto_tp.py"),
     # the compressed-collective layer flattens grad pytrees and derives
     # axis_index_groups — order skew there IS cross-host sharding skew
+    os.path.join("deepspeed_tpu", "comm", "collectives", "bucketer.py"),
     os.path.join("deepspeed_tpu", "comm", "collectives", "codec.py"),
     os.path.join("deepspeed_tpu", "comm", "collectives", "compressed.py"),
     os.path.join("deepspeed_tpu", "comm", "collectives", "hierarchical.py"),
+    os.path.join("deepspeed_tpu", "runtime", "zero", "overlap.py"),
     os.path.join("deepspeed_tpu", "utils", "groups.py"),
 )
 
@@ -407,8 +419,56 @@ def _check_pytree_order(rel, tree, out: List[Violation]) -> None:
                 "sorted(...) before deriving specs/placements from it"))
 
 
+#: rel path -> (root function, names one of which must be transitively
+#: called/referenced from it, what breaking that means).  The guard is
+#: structural presence, not behavior: losing the bucketer routing or the
+#: hook point IS the monolithic-reduce regression returning.
+_GRAD_OVERLAP_CONTRACTS: Dict[str, Tuple[str, Set[str], str]] = {
+    os.path.join("deepspeed_tpu", "runtime", "zero", "zeropp.py"): (
+        "quantized_grad_reduce",
+        {"bucketed_map", "assign_buckets", "coalesce_flat"},
+        "the qgZ gradient reduce no longer routes leaves through the "
+        "shared bucketer (comm/collectives/bucketer.py) — a monolithic "
+        "per-leaf post-backward reduce reappeared"),
+    os.path.join("deepspeed_tpu", "comm", "collectives",
+                 "hierarchical.py"): (
+        "hierarchical_grad_reduce",
+        {"bucketed_map", "assign_buckets", "coalesce_flat"},
+        "the hierarchical gradient reduce no longer routes leaves "
+        "through the shared bucketer (comm/collectives/bucketer.py) — a "
+        "monolithic per-leaf post-backward reduce reappeared"),
+    os.path.join("deepspeed_tpu", "models", "transformer.py"): (
+        "transformer_forward", {"wrap_block"},
+        "the transformer forward lost its overlap hook point "
+        "(OverlapPlan.wrap_block) — the ZeRO grad reduce falls back to "
+        "one monolithic post-backward block"),
+}
+
+
+def _check_grad_overlap(rel, tree, out: List[Violation]) -> None:
+    contract = _GRAD_OVERLAP_CONTRACTS.get(rel)
+    if contract is None:
+        return
+    fname, needed, why = contract
+    reachable = _reachable(tree, {fname})
+    if not reachable:
+        out.append(Violation(
+            "grad-overlap", rel, 1,
+            f"'{fname}' is gone from {rel}: {why}"))
+        return
+    called: Set[str] = set()
+    for _name, fn in reachable:
+        called |= _called_names(fn)
+    if called.isdisjoint(needed):
+        lineno = min(fn.lineno for _n, fn in reachable)
+        out.append(Violation(
+            "grad-overlap", rel, lineno,
+            f"'{fname}' reaches none of {sorted(needed)}: {why}"))
+
+
 _CHECKS = (_check_host_sync, _check_wall_clock, _check_unseeded_random,
-           _check_swallow, _check_mutable_default, _check_pytree_order)
+           _check_swallow, _check_mutable_default, _check_pytree_order,
+           _check_grad_overlap)
 
 
 # ----------------------------------------------------------------- driver

@@ -9,6 +9,11 @@ Layering (docs/COMM.md):
     reached through the module-level API's ``compression=`` option.
   * :mod:`.hierarchical` — two-hop intra-slice / inter-slice variants
     over a split mesh axis (``utils/groups.hierarchy_split``).
+  * :mod:`.bucketer` — the ONE size-targeted leaf-bucketing policy
+    (``zero_optimization.overlap_bucket_mb``) shared by the overlap
+    hook (``runtime/zero/overlap.py``) and the bucketed reducers
+    (``bucketed_all_reduce``, qgZ, hierarchical) — one collective chain
+    and one error-feedback residual per bucket.
 
 Adopters: ZeRO++ qgZ/qwZ (``runtime/zero/zeropp.py``), the 1-bit-family
 error-feedback all-reduce (``runtime/comm/compressed.py``), MoE expert
@@ -17,15 +22,19 @@ dispatch (``moe/ep_dispatch.py``), ring attention
 reduce (``zero_optimization.zero_hierarchical_grad_reduce``).
 """
 
-from . import compressed, hierarchical  # noqa: F401
+from . import bucketer, compressed, hierarchical  # noqa: F401
+from .bucketer import (assign_buckets, bucketed_map, coalesce_flat,
+                       split_flat)
 from .codec import (CompressionSpec, compensate, dequantize_blockwise,
                     init_error, logical_bytes, qdq, quantize_blockwise,
                     wire_bytes)
+from .compressed import bucketed_all_reduce
 from .hierarchical import hier_all_reduce, hierarchical_grad_reduce
 
 __all__ = [
-    "CompressionSpec", "compensate", "compressed", "dequantize_blockwise",
+    "CompressionSpec", "assign_buckets", "bucketed_all_reduce", "bucketer",
+    "bucketed_map", "coalesce_flat", "compensate", "compressed", "dequantize_blockwise",
     "hier_all_reduce", "hierarchical", "hierarchical_grad_reduce",
     "init_error", "logical_bytes", "qdq", "quantize_blockwise",
-    "wire_bytes",
+    "split_flat", "wire_bytes",
 ]

@@ -1,0 +1,128 @@
+"""Size-targeted leaf bucketing for collective coalescing.
+
+The reference's IPG buckets (``reduce_bucket_size``, stage_1_and_2.py)
+exist because per-leaf NCCL launches are expensive; on TPU the analogous
+cost is per-collective scheduling slack and codec-block underutilization
+for tiny leaves.  This module is the ONE bucket-assignment policy shared
+by every bucketed path:
+
+* the per-layer grad-reduce hook (``runtime/zero/overlap.py``) groups a
+  layer's cotangent leaves per bucket so XLA's collective combiner can
+  merge them into one wire transaction;
+* the explicit compressed reducers (``runtime/zero/zeropp.py`` qgZ and
+  ``hierarchical.hierarchical_grad_reduce``) concatenate each bucket's
+  raveled leaves into one flat payload and run ONE two-hop collective
+  per bucket — one error-feedback residual per bucket.
+
+Everything here is a pure function of ``(sizes, bucket_bytes)``:
+deterministic, stable under the pytree flatten order it is given (the
+caller never feeds set-ordered sequences — the ``pytree-order`` lint
+covers this file), and size-bounded — a bucket closes as soon as it has
+reached the target, so no bucket exceeds ``target + largest_leaf``.
+Knob: ``zero_optimization.overlap_bucket_mb`` (0 → one leaf per bucket,
+the pre-bucketing behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+
+def assign_buckets(sizes: Sequence[int], bucket_bytes: int) -> List[List[int]]:
+    """Greedy in-order assignment of leaf indices to buckets.
+
+    ``sizes``: per-leaf byte sizes in pytree flatten order.  Returns a
+    list of index buckets covering every leaf exactly once, preserving
+    order (bucket k's indices all precede bucket k+1's).  A bucket is
+    closed once its total reaches ``bucket_bytes``; with
+    ``bucket_bytes <= 0`` every leaf gets its own bucket.
+    """
+    if not sizes:
+        return []
+    if bucket_bytes <= 0:
+        return [[i] for i in range(len(sizes))]
+    buckets: List[List[int]] = [[]]
+    acc = 0
+    for i, sz in enumerate(sizes):
+        if buckets[-1] and acc >= bucket_bytes:
+            buckets.append([])
+            acc = 0
+        buckets[-1].append(i)
+        acc += int(sz)
+    return buckets
+
+
+def leaf_bytes(leaf: Any) -> int:
+    """Byte size of an array-like leaf (shape/dtype avals included)."""
+    import numpy as np
+
+    size = getattr(leaf, "size", None)
+    if size is None:
+        size = int(np.prod(getattr(leaf, "shape", ()) or (1,)))
+    dtype = getattr(leaf, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+    return int(size) * int(itemsize)
+
+
+def coalesce_flat(leaves: Sequence[Any]) -> Tuple[Any, List[Tuple[int, Tuple[int, ...]]]]:
+    """Concatenate raveled array leaves into one flat fp32 payload.
+
+    Returns ``(flat, layout)`` where ``layout`` is the per-leaf
+    ``(offset, shape)`` needed by :func:`split_flat`.  The flat buffer is
+    fp32: the callers are gradient reducers whose accumulation dtype is
+    fp32 anyway, and mixing dtypes in one payload would make the codec
+    block scale meaningless.
+    """
+    import jax.numpy as jnp
+
+    layout: List[Tuple[int, Tuple[int, ...]]] = []
+    parts = []
+    off = 0
+    for leaf in leaves:
+        shape = tuple(leaf.shape)
+        n = int(leaf.size)
+        layout.append((off, shape))
+        parts.append(jnp.ravel(leaf).astype(jnp.float32))
+        off += n
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0], layout
+
+
+def split_flat(flat: Any, layout: Sequence[Tuple[int, Tuple[int, ...]]],
+               dtypes: Sequence[Any]) -> List[Any]:
+    """Inverse of :func:`coalesce_flat` (per-leaf dtype restored)."""
+    import numpy as np
+
+    out = []
+    for (off, shape), dt in zip(layout, dtypes):
+        n = int(np.prod(shape)) if shape else 1
+        out.append(flat[off:off + n].reshape(shape).astype(dt))
+    return out
+
+
+def bucketed_map(leaves: Sequence[Any], bucket_bytes: int, fn,
+                 out_dtype: Any = None,
+                 buckets: Any = None) -> List[Any]:
+    """The one coalesce -> reduce -> split pipeline every bucketed
+    reducer shares: assign ``leaves`` to buckets, concatenate each
+    bucket's raveled leaves into one flat fp32 payload, call
+    ``fn(flat, bucket_index) -> flat`` once per bucket, and split the
+    results back into per-leaf arrays (``out_dtype``: one dtype for
+    every leaf; None restores each leaf's own dtype).
+
+    ``buckets``: a precomputed :func:`assign_buckets` result (callers
+    that validate against the bucket structure first); None assigns
+    here.  Per-bucket side state (e.g. error-feedback residuals) rides
+    ``fn``'s closure, keyed by the bucket index it receives."""
+    leaves = list(leaves)
+    if buckets is None:
+        buckets = assign_buckets([leaf_bytes(l) for l in leaves],
+                                 bucket_bytes)
+    out: List[Any] = [None] * len(leaves)
+    for k, idxs in enumerate(buckets):
+        flat, layout = coalesce_flat([leaves[i] for i in idxs])
+        red = fn(flat, k)
+        dtypes = [out_dtype if out_dtype is not None else leaves[i].dtype
+                  for i in idxs]
+        for i, o in zip(idxs, split_flat(red, layout, dtypes)):
+            out[i] = o
+    return out

@@ -26,7 +26,7 @@ at trace time; ``log_summary``'s wire column and the
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -132,6 +132,55 @@ def all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
         jnp.zeros((slot * world,), jnp.float32), hop2_delta * gain,
         (r * slot,))[:n].reshape(comp.shape).astype(comp.dtype)
     return reduced, (comp - sent) + flat_delta
+
+
+# -------------------------------------------------------- bucketed all_reduce
+def bucketed_all_reduce(leaves: Sequence[jnp.ndarray], op: str = "sum",
+                        axis="data",
+                        spec: CompressionSpec = CompressionSpec(),
+                        bucket_bytes: int = 0,
+                        errors: Optional[Sequence[jnp.ndarray]] = None,
+                        ) -> Tuple[List[jnp.ndarray],
+                                   Optional[List[jnp.ndarray]]]:
+    """Compressed all-reduce over a LIST of leaves, coalesced into
+    size-targeted flat buckets (``comm/collectives/bucketer.py``): one
+    two-hop collective chain — and, with ``spec.error_feedback``, ONE
+    caller-owned residual — per bucket instead of per leaf.  Small
+    leaves stop paying a full collective + an underfilled codec block
+    each; the per-bucket chains are independent, so XLA can overlap
+    bucket k's exchange with bucket k+1's quantize.
+
+    ``errors``: per-BUCKET residuals from the previous round (None on
+    the first).  Returns ``(reduced_leaves, new_errors)`` —
+    ``new_errors`` is None when error feedback is off.  With
+    ``bucket_bytes <= 0`` every leaf gets its own bucket (the
+    pre-bucketing per-leaf behavior, bit-identical to calling
+    :func:`all_reduce` per leaf)."""
+    from .bucketer import assign_buckets, bucketed_map, leaf_bytes
+
+    leaves = list(leaves)
+    buckets = assign_buckets([leaf_bytes(l) for l in leaves], bucket_bytes)
+    if errors is not None and len(errors) != len(buckets):
+        raise ValueError(
+            f"bucketed_all_reduce: {len(errors)} error residual(s) for "
+            f"{len(buckets)} bucket(s) — the residual is per bucket, and "
+            "bucket structure must be stable across rounds")
+    new_errors: Optional[List[jnp.ndarray]] = \
+        [] if spec.error_feedback else None
+
+    def reduce_bucket(flat, k):
+        if spec.error_feedback:
+            red, err = all_reduce(flat, op=op, axis=axis, spec=spec,
+                                  error=errors[k] if errors else None,
+                                  out_dtype=jnp.float32)
+            new_errors.append(err)
+            return red
+        return all_reduce(flat, op=op, axis=axis, spec=spec,
+                          out_dtype=jnp.float32)
+
+    outs = bucketed_map(leaves, bucket_bytes, reduce_bucket,
+                        buckets=buckets)
+    return outs, new_errors
 
 
 # ----------------------------------------------------------- reduce_scatter

@@ -97,18 +97,25 @@ def hier_all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
 def hierarchical_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
                              axis: Optional[str] = None,
                              inner: Optional[int] = None,
-                             compression: Optional[CompressionSpec] = None
-                             ) -> Any:
+                             compression: Optional[CompressionSpec] = None,
+                             bucket_bytes: int = 0) -> Any:
     """Hierarchical mean-reduce of vmap-chunked gradients (leading dim =
     ``axis`` chunks) — the two-hop sibling of
     ``runtime/zero/zeropp.quantized_grad_reduce``, sharing its chunked
     layout contract: ``chunk_specs`` is the per-leaf PartitionSpec of the
     chunked grads, leading entry = the reduce axis.
+
+    ``bucket_bytes`` (``zero_optimization.overlap_bucket_mb``; 0 =
+    per-leaf): leaves coalesce into size-targeted flat buckets
+    (``comm/collectives/bucketer.py``) — one three-hop chain per bucket
+    instead of per leaf, so small leaves stop paying full hop latency
+    each and the independent per-bucket chains overlap.
     """
     from jax.sharding import PartitionSpec as P
 
     from ...parallel.mesh import DATA_AXIS
     from ...utils.jax_compat import shard_map
+    from .bucketer import bucketed_map
 
     axis = axis or DATA_AXIS
     world = mesh.shape[axis]
@@ -117,10 +124,11 @@ def hierarchical_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     grads_flat = treedef.flatten_up_to(grads_chunked)
 
     def body(flat_tree):
-        return tuple(
-            hier_all_reduce(g[0], op="mean", axis=axis, inner=inner,
-                            spec=compression)
-            for g in flat_tree)
+        return tuple(bucketed_map(
+            [g[0] for g in flat_tree], bucket_bytes,
+            lambda flat, _k: hier_all_reduce(flat, op="mean", axis=axis,
+                                             inner=inner, spec=compression),
+            out_dtype=jnp.float32))
 
     out_specs = tuple(P(*tuple(c)[1:]) for c in flat_chunk)
     fn = shard_map(body, mesh=mesh, in_specs=(tuple(flat_chunk),),

@@ -101,8 +101,16 @@ class TransformerConfig:
     #: independent gather->compute chains and layer i+1's param
     #: all-gather can overlap layer i's compute (the compiled analogue of
     #: the reference's PartitionedParameterCoordinator prefetch,
-    #: partitioned_param_coordinator.py:285)
+    #: partitioned_param_coordinator.py:285).  With an ``overlap_plan``
+    #: installed, the gathers are additionally issued EXPLICITLY at the
+    #: body top by the plan's hook, so the two chains start independent.
     zero3_prefetch: bool = False
+    #: ZeRO overlap hook (engine-set per trace, like qwz): a
+    #: runtime/zero/overlap.OverlapPlan threading every layer's param
+    #: slices through a custom_vjp whose bwd issues each bucket's grad
+    #: reduce inside the backward loop (and, under zero3_prefetch,
+    #: whose fwd forces the param gathers at the scan-body top)
+    overlap_plan: Optional[Any] = None
     # PR-MoE residual experts (reference moe/layer.py use_residual): a dense
     # MLP runs beside the MoE and a learned 2-way coefficient mixes them
     moe_use_residual: bool = False
@@ -594,7 +602,19 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
                   params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
     attn_fn = _pick_attn(cfg)
 
-    block = lambda x, layer: _block(cfg, x, layer, positions, mask, attn_fn)  # noqa: E731
+    plan = getattr(cfg, "overlap_plan", None)
+    if plan is None:
+        block = lambda x, layer: _block(cfg, x, layer, positions, mask, attn_fn)  # noqa: E731
+    else:
+        # ZeRO overlap wrap (runtime/zero/overlap.py): the block runs in
+        # a shard_map over the data axis, where each layer-bucket's grad
+        # reduce is an explicit collective issued inside the backward
+        # loop (and, at stage 3, the param gathers are explicit at the
+        # body top — prefetched one layer ahead by the 2x unroll below)
+        wrapped = plan.wrap_block(
+            lambda x, pos, m, layer: _block(cfg, x, layer, pos, m, attn_fn),
+            has_mask=mask is not None)
+        block = lambda x, layer: wrapped(x, positions, mask, layer)  # noqa: E731
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)

@@ -127,10 +127,30 @@ class ZeroConfig(ConfigModel):
     #: MANUAL stage-3 prefetch: run the layer scan 2x-unrolled
     #: (models/transformer.py) so consecutive layers' param gathers and
     #: compute can overlap, instead of leaving scheduling slack entirely
-    #: to XLA.  Off by default — A/B on hardware (bench STAGE=3
-    #: PREFETCH=1) decides; the reference's analogue is the
-    #: PartitionedParameterCoordinator prefetch.
+    #: to XLA.  With the overlap wrap active (it is, whenever this knob
+    #: or overlap_grad_reduce is on and the model supports it), the
+    #: gathers are EXPLICIT in-loop collectives issued at the body top
+    #: (runtime/zero/overlap.py) — the unrolled pair of gather->compute
+    #: chains is the double buffer.  Off by default — A/B on hardware
+    #: (bench STAGE=3 PREFETCH=1) decides; the reference's analogue is
+    #: the PartitionedParameterCoordinator prefetch.
     zero3_param_prefetch: bool = False
+    #: issue each layer-bucket's gradient reduce inside the BACKWARD
+    #: scan, as soon as the bucket's cotangents materialize
+    #: (runtime/zero/overlap.py custom_vjp hook; Domino-style — the
+    #: collective rides the dataflow graph, no post-backward block).
+    #: Scheduling only: bit-exact with the unbucketed path, A/B'd by
+    #: ``bench.py --ab-overlap``.  Needs a models/* transformer; under
+    #: qgZ / hierarchical reduce the overlap instead rides the bucketed
+    #: explicit reducers (see overlap_bucket_mb).
+    overlap_grad_reduce: bool = False
+    #: size target (MB) for the ONE shared bucketer
+    #: (comm/collectives/bucketer.py): the overlap hook's per-layer
+    #: reduce groups AND the leaf coalescing inside the explicit
+    #: compressed reducers (qgZ / hierarchical — one collective and one
+    #: error-feedback residual per bucket).  0 = per-leaf (no
+    #: coalescing, the pre-bucketing behavior).
+    overlap_bucket_mb: float = 4.0
     # ZeRO++ style knobs: quantized weight gather / hierarchical partition
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
@@ -156,6 +176,9 @@ class ZeroConfig(ConfigModel):
     def validate(self) -> None:
         if self.stage not in (0, 1, 2, 3):
             raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        if self.overlap_bucket_mb < 0:
+            raise ValueError("zero_optimization.overlap_bucket_mb must be "
+                             f">= 0, got {self.overlap_bucket_mb}")
 
     @classmethod
     def deprecated_fields(cls):

@@ -214,6 +214,7 @@ class DeepSpeedTPUEngine:
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
 
         self.state = self._init_state()
+        self._build_overlap_plan()
         self._compile_steps()
         self._wire_memory_ledger()
         # ZeRO-Infinity param offload (reference offload_param config): the
@@ -328,6 +329,100 @@ class DeepSpeedTPUEngine:
                     + ("qgZ all-to-all reduce" if self._qgz
                        else "XLA fp reduce"))
 
+    def _overlap_unsupported_reason(self) -> Optional[str]:
+        """Why the overlap wrap cannot apply on this engine (None = ok).
+
+        The wrap runs the scanned block in a shard_map over the data
+        axis; everything it cannot express is excluded loudly here
+        instead of failing deep inside tracing."""
+        from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, REPL_AXIS,
+                                     SEQ_AXIS)
+
+        mc = getattr(self.model, "config", None)
+        params = self.state.params
+        if not (isinstance(params, dict) and "layers" in params
+                and mc is not None and hasattr(mc, "overlap_plan")):
+            return "needs a models/* transformer (stacked layer tree)"
+        if self.topology.pipe_parallel_size != 1:
+            return "pipeline parallelism is not supported"
+        others = [(a, self.topology.axis_size(a))
+                  for a in (REPL_AXIS, EXPERT_AXIS, SEQ_AXIS)]
+        if any(s != 1 for _a, s in others):
+            return ("needs data-axis-only batch parallelism "
+                    f"(got {dict(others)})")
+        if self.topology.axis_size(DATA_AXIS) <= 1:
+            return "data axis is 1: there is no grad exchange to overlap"
+        if self._qgz or self._hier_inner:
+            return ("qgZ/hierarchical explicit reducers own the grad "
+                    "exchange (overlap there rides their bucketed "
+                    "collectives; see overlap_bucket_mb)")
+        if self._qwz:
+            return "zero_quantized_weights owns the stage-3 gathers"
+        if getattr(mc, "moe_experts", 0):
+            return ("MoE aux loss is batch-dependent; the wrap cannot "
+                    "claim it replicated")
+        if getattr(mc, "attn_impl", "xla") not in ("auto", "xla", "flash"):
+            return (f"attn_impl={mc.attn_impl!r} manages its own "
+                    "sequence-axis collectives")
+        return None
+
+    def _build_overlap_plan(self) -> None:
+        """Fine-grained compute/collective overlap (ROADMAP item 3,
+        runtime/zero/overlap.py): run the scanned transformer block in
+        a data-axis shard_map so each layer-bucket's grad reduce is an
+        explicit collective inside the backward loop
+        (``overlap_grad_reduce``) and the stage-3 param all-gathers are
+        explicit at the body top, prefetched one layer ahead by the
+        2x-unrolled scan (``zero3_param_prefetch``).  Also derives the
+        structural exposure split the telemetry layer publishes
+        (``deepspeed_tpu_train_overlapped_fraction`` /
+        ``_exposed_collective_seconds``)."""
+        self._overlap_plan = None
+        self._overlap_struct = None
+        zc = self.config.zero_config
+        wanted = bool(zc.overlap_grad_reduce
+                      or (getattr(self, "_zero3_prefetch", False)
+                          and zc.stage >= 3))
+        params = self.state.params
+        has_layers = isinstance(params, dict) and "layers" in params
+        reason = self._overlap_unsupported_reason() if wanted else None
+        if wanted and reason is not None:
+            logger.warning(f"compute/collective overlap disabled: {reason}")
+        if wanted and reason is None:
+            from ..parallel.mesh import DATA_AXIS
+            from .zero.overlap import build_overlap_plan
+
+            self._overlap_plan = build_overlap_plan(
+                self.zero_plan, jax.eval_shape(lambda: params["layers"]),
+                bucket_bytes=int(zc.overlap_bucket_mb * 2**20),
+                axis=DATA_AXIS, stage=zc.stage,
+                grad_dtype=self.grad_accum_dtype)
+            if self._overlap_plan is not None:
+                from ..compile.backend import validate_latency_hiding_flags
+
+                # the XLA backstop: warn when the scheduler flags that
+                # actually hide the in-loop collectives aren't pinned
+                validate_latency_hiding_flags()
+        if not has_layers:
+            return
+        # structural exposure split: grad-exchange bytes per micro-step,
+        # split into wrap-covered (overlap-scheduled) vs post-backward
+        # tail — the deterministic source for overlapped_fraction
+        itemsize = np.dtype(self.grad_accum_dtype).itemsize
+        layer_bytes = sum(
+            l.size for l in jax.tree_util.tree_leaves(params["layers"])
+        ) * itemsize
+        total_bytes = sum(
+            l.size for l in jax.tree_util.tree_leaves(params)) * itemsize
+        covered = layer_bytes if self._overlap_plan is not None else 0
+        self._overlap_struct = {
+            "total_bytes": int(total_bytes),
+            "overlapped_bytes": int(covered),
+            "tail_bytes": int(total_bytes - covered),
+            "buckets": (len(self._overlap_plan.buckets)
+                        if self._overlap_plan is not None else 0),
+        }
+
     # ------------------------------------------------------------------ init
     def _init_state(self) -> TrainState:
         """Initialize params already sharded: the analogue of ``zero.Init``
@@ -401,14 +496,18 @@ class DeepSpeedTPUEngine:
         mc = getattr(self.model, "config", None)
         has_q = mc is not None and hasattr(mc, "qwz")
         has_pf = mc is not None and hasattr(mc, "zero3_prefetch")
-        if not (has_q or has_pf):
+        has_ov = mc is not None and hasattr(mc, "overlap_plan")
+        if not (has_q or has_pf or has_ov):
             return self.model.loss_fn(p, batch, rng)
         old_q = mc.qwz if has_q else None
         old_pf = mc.zero3_prefetch if has_pf else None
+        old_ov = mc.overlap_plan if has_ov else None
         if has_q:
             mc.qwz = self._qwz
         if has_pf:
             mc.zero3_prefetch = getattr(self, "_zero3_prefetch", False)
+        if has_ov:
+            mc.overlap_plan = getattr(self, "_overlap_plan", None)
         try:
             return self.model.loss_fn(p, batch, rng)
         finally:
@@ -416,6 +515,8 @@ class DeepSpeedTPUEngine:
                 mc.qwz = old_q
             if has_pf:
                 mc.zero3_prefetch = old_pf
+            if has_ov:
+                mc.overlap_plan = old_ov
 
     def _fetch_params(self, master_params):
         """Host-offloaded masters (offload_param): stream them into device
@@ -458,6 +559,13 @@ class DeepSpeedTPUEngine:
             grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
         grads = cast_tree(grads, self.grad_accum_dtype)
         grads = self.zero_plan.constrain(grads, "grad")
+        if getattr(self, "_overlap_struct", None) is not None:
+            # trace-time span-timeline event for the gradient bytes the
+            # overlap hook does NOT cover (the post-backward tail) — the
+            # exposure accountant reads these against the compute spans
+            from .zero.overlap import record_tail_reduce
+
+            record_tail_reduce(self._overlap_struct["tail_bytes"])
         return grads, loss
 
     def _micro_step_body(self, state: TrainState, batch, rng,
@@ -528,7 +636,9 @@ class DeepSpeedTPUEngine:
                 grads_c, chunk_specs, self.topology.mesh,
                 inner=self._hier_inner,
                 compression=CompressionSpec(format="int8")
-                if self._qgz else None)
+                if self._qgz else None,
+                bucket_bytes=int(
+                    self.config.zero_config.overlap_bucket_mb * 2**20))
             return grads, jnp.mean(losses)
         # target = the accumulation buffer's sharding: data-sharded leaves
         # come back as the SCATTERED partition (one all_to_all, no hop-2
@@ -536,9 +646,11 @@ class DeepSpeedTPUEngine:
         target_specs = jax.tree_util.tree_map_with_path(
             lambda path, g: self.zero_plan.grad_spec(_path_str(path),
                                                      g.shape[1:]), grads_c)
-        grads = quantized_grad_reduce(grads_c, chunk_specs,
-                                      self.topology.mesh,
-                                      target_specs=target_specs)
+        grads = quantized_grad_reduce(
+            grads_c, chunk_specs, self.topology.mesh,
+            target_specs=target_specs,
+            bucket_bytes=int(
+                self.config.zero_config.overlap_bucket_mb * 2**20))
         return grads, jnp.mean(losses)
 
     def _apply_step_body(self, state: TrainState, grads_src=None) -> TrainState:
@@ -1239,6 +1351,16 @@ class DeepSpeedTPUEngine:
             "deepspeed_tpu_train_mfu",
             "model FLOPs utilization vs per-generation peak "
             "(telemetry/mfu.py table)")
+        self._m_overlap_frac = reg.gauge(
+            "deepspeed_tpu_train_overlapped_fraction",
+            "bytes-weighted share of the step's gradient exchange issued "
+            "inside the backward loop (overlap-scheduled) vs the "
+            "post-backward tail (telemetry/overlap.py)")
+        self._m_exposed = reg.counter(
+            "deepspeed_tpu_train_exposed_collective_seconds",
+            "cumulative ESTIMATED seconds of exposed (non-overlapped) "
+            "gradient collectives: wire bytes x bus factor over the "
+            "nominal per-generation interconnect bandwidth")
         self._m_steps = reg.counter("deepspeed_tpu_train_steps_total",
                                     "optimizer steps taken")
         self._m_skipped = reg.counter(
@@ -1394,6 +1516,12 @@ class DeepSpeedTPUEngine:
         if skipped > self._skipped_pub:
             self._m_skipped.inc(skipped - self._skipped_pub)
             self._skipped_pub = skipped
+        report = self.overlap_report()
+        if report is not None:
+            self._m_overlap_frac.set(report.overlapped_fraction)
+            if self._win_steps > 0:
+                self._m_exposed.inc(
+                    report.exposed_seconds_per_step * self._win_steps)
         if self._win_time > 0:
             bs = self.config.train_batch_size or 1
             self._m_samples_ps.set(self._win_steps * bs / self._win_time)
@@ -1466,6 +1594,22 @@ class DeepSpeedTPUEngine:
             ])
         if cfg.wall_clock_breakdown and self.global_steps % cfg.steps_per_print == 0:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER])
+
+    def overlap_report(self):
+        """Current exposure split of the gradient exchange
+        (``telemetry/overlap.py``), or None when the model has no
+        stacked layer tree / no data parallelism.  Deterministic: a
+        property of the compiled program structure, not runtime
+        jitter — ``bench.py --ab-overlap`` stamps it per arm."""
+        from ..parallel.mesh import DATA_AXIS
+        from ..telemetry.overlap import structural_report
+
+        dev = jax.devices()[0]
+        return structural_report(
+            getattr(self, "_overlap_struct", None),
+            world=self.topology.axis_size(DATA_AXIS),
+            device_kind=str(getattr(dev, "device_kind", "cpu")),
+            gas=self.config.gradient_accumulation_steps or 1)
 
     def get_lr(self):
         # dstpu-lint: allow[host-sync] reporting/checkpoint API, not the

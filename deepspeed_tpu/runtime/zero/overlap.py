@@ -1,0 +1,324 @@
+"""Fine-grained compute/collective overlap for the fused train step.
+
+Today's step compiles the ZeRO gradient exchange as one post-backward
+block: the backward scan accumulates every layer's cotangent into the
+stacked gradient buffer and GSPMD places the data-axis reduce wherever
+its propagation lands it — in practice hoisted out of the layer loops,
+serialized against nothing.  That is the exposed-communication problem
+T3 (PAPERS.md) attacks with fine-grained tracking/triggering, and the
+in-tree Domino module solves for TP by making the overlap *be* the
+dataflow graph.
+
+This module is the ZeRO-side analogue.  Sharding *constraints* cannot
+pin a reduction point (GSPMD folds them into propagation — measured:
+at stage 1 a replicated cotangent constraint makes the partitioner
+replicate the whole backward, 6x FLOPs), so the scanned transformer
+block is instead wrapped in a **shard_map over the data axis** (other
+mesh axes stay auto/GSPMD — TP rules untouched), where collectives are
+explicit ops the partitioner must execute in place:
+
+* **stage <= 2** — layer params enter the body replicated; shard_map's
+  transpose inserts an explicit ``psum`` over ``data`` for each leaf's
+  cotangent *inside the backward scan trip*, right where the partial
+  grads materialize.  A ``custom_vjp`` hook groups the cotangents into
+  size-targeted buckets (``overlap_bucket_mb``,
+  ``comm/collectives/bucketer.py``) between ``optimization_barrier``
+  pairs, so each bucket forms one reduce wavefront the latency-hiding
+  scheduler can hide under the next layer's backward compute.
+* **stage 3** — layer params enter the body as their ZeRO shards and
+  the hook's fwd issues an explicit ``lax.all_gather`` per leaf at the
+  body top (bucket-barriered): with the 2x-unrolled scan
+  (``zero3_param_prefetch``) each trip holds two independent
+  gather->compute chains, so layer i+1's gather overlaps layer i's
+  compute — the double buffer.  The gather's AD transpose is an
+  explicit ``psum_scatter``: the grad reduce-scatter rides the
+  backward loop for free, per layer, no handles or waits.
+
+Residual discipline: the hooked (gathered) param values are tagged
+``overlap_params`` and the body is checkpointed with a policy that
+refuses to save the whole hook chain (:func:`_overlap_remat_policy`) —
+the backward re-derives them (a re-gather at stage 3) instead of
+saving every layer's gathered params, which would defeat stage-3
+partitioning (the carry-based double buffer tried earlier failed
+exactly this way; see the scan comment in models/transformer.py).
+
+The wrap is value-identity — per-shard compute is the same arithmetic
+and the explicit collectives compute the same sums — so overlap-on
+training is bit-exact with overlap-off (asserted per-run by
+``bench.py --ab-overlap`` and tests/unit/test_overlap.py).  Every
+bucket logs a trace-time collective event (``grad_bucket_reduce``)
+into the span ring; the engine publishes the exposure split
+(``telemetry/overlap.py``) as
+``deepspeed_tpu_train_overlapped_fraction`` /
+``_exposed_collective_seconds``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import PartitionSpec as P
+
+from ...comm.collectives.bucketer import assign_buckets
+from ...telemetry.spans import record_event
+from ...utils.logging import logger
+
+#: checkpoint_name tag on hook outputs (see module docstring)
+OVERLAP_TAG = "overlap_params"
+
+
+def _overlap_remat_policy():
+    """Residual policy for the wrapped block: save the default residual
+    set EXCEPT the hook's (gathered) parameter values — those are
+    re-derived in the backward loop from the sharded inputs (a
+    re-gather at stage 3), never stacked per layer.
+
+    ``save_anything_except_these_names(TAG)`` alone is NOT enough: the
+    name tag sits on the hook's final output, and partial eval simply
+    saves the nearest saveable ANCESTOR — the (identical) gather /
+    barrier output right above the tag.  The whole hook chain must be
+    unsaveable, and inside the wrapped body the hook is the only
+    producer of ``all_gather`` / ``optimization_barrier`` values, so
+    the policy blocks those primitives by NAME (stable public strings;
+    everything else keeps the default residual choice)."""
+    #: primitives only the hook emits inside the wrapped body — their
+    #: outputs are the (gathered) param values that must be re-derived,
+    #: not saved per layer
+    blocked = ("name", "all_gather", "optimization_barrier", "psum_scatter")
+
+    def policy(prim, *_args, **params):
+        pname = getattr(prim, "name", str(prim))
+        if pname == "name":
+            return params.get("name") != OVERLAP_TAG
+        return pname not in blocked
+
+    return policy
+
+
+class OverlapPlan:
+    """Static (trace-time) description of the shard_map'd block wrap.
+
+    Built once per engine from the abstract stacked layer tree; passed
+    to the model per trace (``TransformerConfig.overlap_plan``, the
+    same engine-set-per-trace pattern as ``qwz``).  Hashable by
+    identity — it is a ``custom_vjp`` nondiff argument."""
+
+    TAG = OVERLAP_TAG
+
+    def __init__(self, mesh, axis: str, treedef, paths: Sequence[str],
+                 leaf_specs: Sequence[P], gather_dims: Sequence[Optional[int]],
+                 buckets: Sequence[Sequence[int]],
+                 bucket_bytes: Sequence[int],
+                 bucket_step_bytes: Sequence[int]):
+        self.mesh = mesh
+        self.axis = axis
+        self.treedef = treedef
+        self.paths = tuple(paths)
+        self.leaf_specs = tuple(leaf_specs)
+        self.gather_dims = tuple(gather_dims)
+        self.buckets = tuple(tuple(b) for b in buckets)
+        self.bucket_bytes = tuple(int(b) for b in bucket_bytes)
+        #: per-optimizer-step coverage of each bucket (slice bytes x
+        #: n_layers) — what the trace-time events report, so the span
+        #: accounting adds up against the structural totals
+        self.bucket_step_bytes = tuple(int(b) for b in bucket_step_bytes)
+
+    # ------------------------------------------------------------- model API
+    def wrap_block(self, raw_block, has_mask: bool):
+        """Wrap ``raw_block(x, positions, mask, layer_tree) -> (y, aux)``
+        in the data-axis shard_map (model side; the scan body calls the
+        result with the same signature).  ``has_mask=False`` drops the
+        mask slot (shard_map in_specs cannot carry a None leaf)."""
+        from ...utils.jax_compat import shard_map
+
+        plan = self
+
+        def body(x, positions, *rest):
+            mask = rest[0] if has_mask else None
+            leaves = rest[1:] if has_mask else rest
+            leaves = _overlap_hook(tuple(leaves), plan)
+            leaves = tuple(checkpoint_name(v, OVERLAP_TAG) for v in leaves)
+            layer = jax.tree_util.tree_unflatten(plan.treedef, leaves)
+            return raw_block(x, positions, mask, layer)
+
+        # residual discipline INSIDE the body: the policy must see the
+        # hook call and its name tags, and shard_map residuals are
+        # opaque from outside — so the checkpoint sits under the
+        # shard_map
+        body = jax.checkpoint(body, policy=_overlap_remat_policy())
+
+        bsp = P(self.axis)  # batch-leading operands shard the lead dim
+        mask_specs = (bsp,) if has_mask else ()
+        sm = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(bsp, bsp) + mask_specs + self.leaf_specs,
+            out_specs=(bsp, P()),
+            check_vma=False, axis_names={self.axis})
+
+        world = int(self.mesh.shape[self.axis])
+
+        def wrapped(x, positions, mask, layer_tree):
+            if x.shape[0] % world != 0:
+                # e.g. an eval_batch whose batch does not divide the
+                # data axis: the wrap cannot shard it — run the plain
+                # GSPMD block (training batches divide by construction)
+                from ...utils.logging import warning_once
+
+                warning_once(
+                    f"overlap wrap bypassed: batch {x.shape[0]} does not "
+                    f"divide the data axis ({world})")
+                return raw_block(x, positions, mask, layer_tree)
+            leaves, treedef = jax.tree_util.tree_flatten(layer_tree)
+            if treedef != self.treedef:
+                raise ValueError(
+                    "overlap plan was built for a different layer structure "
+                    f"(plan {self.treedef} vs model {treedef}); rebuild the "
+                    "engine after changing the model")
+            args = (x, positions) + ((mask,) if has_mask else ()) + tuple(leaves)
+            return sm(*args)
+
+        return wrapped
+
+    # ------------------------------------------------------------ internals
+    def _fwd(self, leaves: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        """Inside the body: stage-3 leaves are local ZeRO shards — issue
+        their all-gathers per bucket at the body top, barrier-pinned, so
+        the unrolled trip's two chains start independent."""
+        if all(d is None for d in self.gather_dims):
+            return leaves
+        out = list(leaves)
+        for k, idxs in enumerate(self.buckets):
+            group = jax.lax.optimization_barrier(
+                tuple(out[i] for i in idxs))
+            gathered = []
+            for i, v in zip(idxs, group):
+                d = self.gather_dims[i]
+                if d is not None:
+                    v = lax.all_gather(v, self.axis, axis=d, tiled=True)
+                gathered.append(v)
+            group = jax.lax.optimization_barrier(tuple(gathered))
+            for i, v in zip(idxs, group):
+                out[i] = v
+        return tuple(out)
+
+    def _bwd(self, cts: Tuple[Any, ...]) -> Tuple[Any, ...]:
+        """Inside the transposed body: per bucket, group the cotangents
+        between barriers and issue the gather transposes (an explicit
+        ``psum_scatter`` — the per-layer grad reduce-scatter) as one
+        wavefront per backward trip.  Identity (stage <= 2) leaves pass
+        through barrier-grouped; shard_map's boundary then psums them
+        over the axis — also inside the trip."""
+        out: List[Any] = list(cts)
+        for k, idxs in enumerate(self.buckets):
+            group = jax.lax.optimization_barrier(
+                tuple(out[i] for i in idxs))
+            reduced = []
+            for i, v in zip(idxs, group):
+                d = self.gather_dims[i]
+                if d is not None:
+                    # all_gather's transpose, written out so the bucket
+                    # barriers pin it: this rank keeps ITS shard of the
+                    # summed cotangent
+                    v = lax.psum_scatter(v, self.axis,
+                                         scatter_dimension=d, tiled=True)
+                reduced.append(v)
+            group = jax.lax.optimization_barrier(tuple(reduced))
+            # trace-time collective event (the comm._log convention):
+            # one point per bucket per traced program, carrying the
+            # bytes the bucket reduces — the overlap accountant reads
+            # these against the compute spans
+            record_event("grad_bucket_reduce", cat="comm",
+                         bytes=self.bucket_step_bytes[k], bucket=k,
+                         leaves=len(idxs), overlapped=True)
+            for i, v in zip(idxs, group):
+                out[i] = v
+        return tuple(out)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _overlap_hook(leaves: Tuple[Any, ...], plan: OverlapPlan):
+    return plan._fwd(leaves)
+
+
+def _overlap_hook_fwd(leaves, plan):
+    return plan._fwd(leaves), None
+
+
+def _overlap_hook_bwd(plan, _res, cts):
+    return (plan._bwd(cts),)
+
+
+_overlap_hook.defvjp(_overlap_hook_fwd, _overlap_hook_bwd)
+
+
+def record_tail_reduce(nbytes: int) -> None:
+    """Trace-time event for gradient bytes NOT covered by the hook (the
+    non-layer leaves — embeddings, head, final norm — whose reduce stays
+    post-backward).  One owner site for the span name."""
+    record_event("grad_tail_reduce", cat="comm", bytes=int(nbytes),
+                 overlapped=False)
+
+
+def _entry_axes(entry) -> tuple:
+    if entry is None:
+        return ()
+    return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+
+def build_overlap_plan(zero_plan, abstract_layers: Any, *,
+                       bucket_bytes: int, axis: str, stage: int,
+                       grad_dtype) -> Optional[OverlapPlan]:
+    """Derive the wrap's static plan from the stacked layer tree.
+
+    ``abstract_layers``: ``state.params["layers"]`` (stacked, leading
+    dim = n_layers) — shapes/dtypes only.  ``axis``: the (single) batch
+    mesh axis the wrap manages manually.  At ``stage`` 3 each leaf's
+    in-body spec is its live ZeRO shard (gathered explicitly by the
+    hook); below 3 the leaves enter replicated over ``axis``.
+    """
+    from .strategy import _path_str
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_layers)
+    if not flat:
+        return None
+    mesh = zero_plan.topology.mesh
+    paths, leaf_specs, gather_dims, sizes, step_sizes = [], [], [], [], []
+    grad_itemsize = np.dtype(grad_dtype).itemsize
+    for path, leaf in flat:
+        pstr = "layers/" + _path_str(path)
+        shape = tuple(leaf.shape)
+        paths.append(pstr)
+        n_layers = shape[0] or 1
+        step_sizes.append(int(np.prod(shape)) * grad_itemsize)
+        sizes.append(int(np.prod(shape)) // n_layers * grad_itemsize)
+        gdim = None
+        if stage >= 3:
+            # the live param's stacked spec, restricted to `axis`, minus
+            # the leading layer dim = where this leaf's ZeRO shard lives
+            # inside the body (and therefore its explicit gather dim)
+            full = zero_plan.param_spec(pstr, shape)
+            for dim, entry in enumerate(tuple(full)[1:]):
+                if axis in _entry_axes(entry):
+                    gdim = dim
+                    break
+        if gdim is None:
+            leaf_specs.append(P(*((None,) * (len(shape) - 1))))
+        else:
+            entries = [None] * (len(shape) - 1)
+            entries[gdim] = axis
+            leaf_specs.append(P(*entries))
+        gather_dims.append(gdim)
+    buckets = assign_buckets(sizes, bucket_bytes)
+    bucket_sizes = [sum(sizes[i] for i in b) for b in buckets]
+    bucket_step = [sum(step_sizes[i] for i in b) for b in buckets]
+    logger.info(
+        f"overlap plan: {len(flat)} layer leaves -> {len(buckets)} "
+        f"bucket(s) (target {bucket_bytes / 2**20:.1f} MB, stage {stage}, "
+        f"gathered={sum(d is not None for d in gather_dims)})")
+    return OverlapPlan(mesh, axis, treedef, paths, leaf_specs, gather_dims,
+                       buckets, bucket_sizes, bucket_step)

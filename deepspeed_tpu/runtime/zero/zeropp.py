@@ -170,7 +170,8 @@ def _scatter_dim(target_spec: Optional[P], chunk_spec: P, axis: str) -> int:
 
 def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
                           axis: str = DATA_AXIS,
-                          target_specs: Any = None) -> Any:
+                          target_specs: Any = None,
+                          bucket_bytes: int = 0) -> Any:
     """Reduce vmap-chunked gradients (leading dim = data-axis chunks) with
     int8 on the wire.  ``chunk_specs``: per-leaf PartitionSpec of the
     chunked grads (leading entry = the data axis).
@@ -180,7 +181,13 @@ def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     the SCATTERED partition straight out of the all_to_all — one collective,
     no hop-2 gather (reference all_to_all_quant_reduce returns the
     partitioned result, coalesced_collectives.py:31).  Other leaves get the
-    fully-reduced value via the two-hop path."""
+    fully-reduced value via the two-hop path, coalesced into size-targeted
+    flat buckets (``bucket_bytes`` — ``zero_optimization.overlap_bucket_mb``;
+    0 = per-leaf): one collective chain per bucket instead of per leaf, so
+    small leaves stop paying a full two-hop each and the per-bucket chains
+    overlap (bucket k's exchange under bucket k+1's quantize)."""
+    from ...comm.collectives.bucketer import bucketed_map
+
     world = mesh.shape[axis]
     flat_chunk, treedef = jax.tree_util.tree_flatten(chunk_specs)
     flat_target = (jax.tree_util.tree_flatten(target_specs)[0]
@@ -190,12 +197,21 @@ def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
              for t, c in zip(flat_target, flat_chunk)]
 
     def body(flat_tree):
-        out = []
-        for g, sd in zip(flat_tree, sdims):
+        out: list = [None] * len(flat_tree)
+        flat_path = []
+        for i, (g, sd) in enumerate(zip(flat_tree, sdims)):
             if sd >= 0:
-                out.append(_a2a_quant_reduce_scattered(g[0], axis, world, sd))
+                # the slot layout IS the target sharding: per leaf by
+                # construction (distinct scatter layouts cannot coalesce)
+                out[i] = _a2a_quant_reduce_scattered(g[0], axis, world, sd)
             else:
-                out.append(_a2a_quant_reduce_flat(g[0], axis, world))
+                flat_path.append(i)
+        reduced = bucketed_map(
+            [flat_tree[i][0] for i in flat_path], bucket_bytes,
+            lambda flat, _k: _a2a_quant_reduce_flat(flat, axis, world),
+            out_dtype=jnp.float32)
+        for i, o in zip(flat_path, reduced):
+            out[i] = o
         return tuple(out)
 
     out_specs = tuple(

@@ -253,7 +253,8 @@ def test_golden_contracts_hold(contracts_mod, extracted):
     for required in ("train_step_zero0", "train_step_zero1",
                      "train_step_zero3", "prefill", "decode",
                      "paged_verify", "train_step_zero1_hier",
-                     "moe_dispatch_quantized"):
+                     "moe_dispatch_quantized", "train_step_zero1_overlap",
+                     "train_step_zero3_prefetch"):
         assert required in goldens, f"missing golden for {required}"
     errors = contracts_mod.diff_all(goldens, extracted)
     assert not errors, "\n".join(errors)
@@ -289,13 +290,15 @@ def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
 
 
 @pytest.mark.parametrize("program", ["prefill", "moe_dispatch_quantized",
-                                     "train_step_zero1_hier"])
+                                     "train_step_zero1_hier",
+                                     "train_step_zero1_overlap",
+                                     "train_step_zero3_prefetch"])
 def test_update_goldens_idempotent(contracts_mod, extracted, tmp_path,
                                    program):
     """Writing goldens twice — the second time from a fresh extraction of
     the same program — is byte-identical (covers the PR-11 compressed-
-    collective programs too: their topology setup must not leak state
-    between extractions)."""
+    collective programs AND the overlap/prefetch programs: their engine
+    + replay setup must not leak state between extractions)."""
     first = {program: extracted[program]}
     contracts_mod.write_goldens(str(tmp_path), first)
     path = os.path.join(contracts_mod.goldens_dir(str(tmp_path)),

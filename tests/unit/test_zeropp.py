@@ -260,7 +260,15 @@ def test_stage3_gathers_stay_inside_layer_loop(devices8):
     structural and checkable here.)
 
     gas=1 here, so the only while loops ARE the layer scans; gathers are
-    classified by REACHABILITY from the loop bodies."""
+    classified by REACHABILITY from the loop bodies.  Hoisted gathers are
+    judged by BYTES against a per-layer budget, not by count: GSPMD
+    legitimately emits small activation-sized top-level gathers (e.g. the
+    embedding-grad scatter-add's cotangent gather), and whether it does
+    varies with its cost model — an exact-zero assert made this test
+    compilation-order-sensitive (failed in isolation, passed in suite
+    order at PR 11 HEAD).  The failure this test exists to catch — the
+    full layer stack's params gathered outside the loop — is orders of
+    magnitude over the budget either way."""
     initialize_topology(MeshConfig(data=8), jax.devices()[:8])
     e = _engine({"stage": 3}, {"data": 8})
     hlo = _train_hlo(e)
@@ -270,10 +278,14 @@ def test_stage3_gathers_stay_inside_layer_loop(devices8):
     gather_comps = {k for k, v in comps.items() if "all-gather" in v}
     assert gather_comps & reachable, \
         "stage-3 step compiled with no per-layer gathers"
-    hoisted = gather_comps - reachable
-    assert not hoisted, (
-        f"all-gathers outside the layer loops in {sorted(hoisted)} — "
-        f"stage-3 would materialize all layers' params at once")
+    hoisted = sum(_gather_bytes(comps[c]) for c in gather_comps - reachable)
+    layers = e.state.params["layers"]
+    layer_bytes = sum(l.size * 2 // l.shape[0]
+                      for l in jax.tree_util.tree_leaves(layers))
+    assert hoisted <= 3 * layer_bytes, (
+        f"hoisted all-gather bytes {hoisted} exceed the ~one-layer budget "
+        f"({layer_bytes} per layer x3) — stage-3 is materializing the "
+        "layer stack's params outside the loop")
 
 
 def test_stage3_gather_bytes_bounded(devices8):

@@ -86,6 +86,22 @@ RUNGS = {
                             "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
                             "DSTPU_BENCH_STAGE": "3",
                             "DSTPU_BENCH_PREFETCH": "1"},
+    # compute/collective overlap A/Bs (runtime/zero/overlap.py): compare
+    # against 160m-zero1 / 160m-zero3-prefetch — every rung record now
+    # carries overlapped_fraction + the exposed-seconds estimate, so the
+    # perf trajectory records EXPOSURE, not just walls (a wall delta
+    # with an unchanged fraction is not an overlap regression)
+    "160m-zero1-overlap": {"DSTPU_BENCH_SIZE": "160m",
+                           "DSTPU_BENCH_SEQ": "1024",
+                           "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
+                           "DSTPU_BENCH_STAGE": "1",
+                           "DSTPU_BENCH_OVERLAP": "1"},
+    "160m-zero3-overlap": {"DSTPU_BENCH_SIZE": "160m",
+                           "DSTPU_BENCH_SEQ": "1024",
+                           "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
+                           "DSTPU_BENCH_STAGE": "3",
+                           "DSTPU_BENCH_PREFETCH": "1",
+                           "DSTPU_BENCH_OVERLAP": "1"},
     # optimizer offload boundary cost on hardware
     "160m-offload": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
                      "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "10",
@@ -182,9 +198,11 @@ def main() -> int:
             json.dump(merged + out, f, indent=1)
     for rec in out:
         r = rec.get("result", {})
+        ovl = (f" ovl={r.get('overlapped_fraction')}"
+               if r.get("overlapped_fraction") is not None else "")
         print(f"{rec['rung']:>14}: "
               + (f"{r.get('value')} {r.get('unit')} mfu={r.get('mfu')} "
-                 f"backend={r.get('backend')}" if r else
+                 f"backend={r.get('backend')}{ovl}" if r else
                  f"ERROR {rec.get('error', '')[:120]}"))
     return 0
 

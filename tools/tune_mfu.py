@@ -27,6 +27,9 @@ def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
 
     fused_opt = bool(model_overrides.pop("fused_opt", False))
     mu_dtype = model_overrides.pop("mu_dtype", None)
+    # zero-config override (the overlap before/after variants): merged
+    # over the default stage-1 block
+    zero_cfg = {"stage": 1, **model_overrides.pop("zero", {})}
     model = llama_model(size, max_seq_len=seq, **model_overrides)
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
@@ -36,7 +39,7 @@ def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
                                  **({"fused_kernel": True} if fused_opt else {}),
                                  **({"mu_dtype": mu_dtype} if mu_dtype else {})}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 1},
+        "zero_optimization": zero_cfg,
         "gradient_clipping": 1.0,
     }
     engine, *_ = deepspeed_tpu.initialize(model=model, config=config)
@@ -67,8 +70,10 @@ def timed_variant(name, size, seq, micro_bs, steps=12, **model_overrides):
     import bench
     peak = bench._peak_for(jax.devices()[0])  # per-chip bf16 peak by device kind
     mfu = flops / dt / peak
+    rep = engine.overlap_report()
+    ovl = f"  ovl={rep.overlapped_fraction:.2f}" if rep is not None else ""
     print(f"{name:36s} step={dt/steps*1e3:8.1f}ms  tok/s={tok_s:9.0f}  "
-          f"mfu={mfu:.3f}", flush=True)
+          f"mfu={mfu:.3f}{ovl}", flush=True)
     del engine
     return mfu
 
@@ -103,10 +108,46 @@ VARIANTS = {
     "1b-bs8-remat-dots": ("1b", 1024, 8, {
         "remat": True, "mu_dtype": "bf16", "fused_opt": True,
         "remat_policy": "dots_with_no_batch_dims_saveable"}),
+    # compute/collective overlap before/after (runtime/zero/overlap.py;
+    # docs/COMM.md "Overlap & scheduling"): run the off/on pairs in ONE
+    # session so the chip + flag state is identical — the wall delta IS
+    # the exposed-comm recovery, and the printed ovl= column shows the
+    # structural fraction backing it
+    "160m-z1-overlap-off": ("160m", 1024, 16, {"zero": {"stage": 1}}),
+    "160m-z1-overlap": ("160m", 1024, 16, {
+        "zero": {"stage": 1, "overlap_grad_reduce": True}}),
+    "160m-z3-overlap-off": ("160m", 1024, 16, {"zero": {"stage": 3}}),
+    "160m-z3-overlap": ("160m", 1024, 16, {
+        "zero": {"stage": 3, "overlap_grad_reduce": True,
+                 "zero3_param_prefetch": True}}),
 }
 
 
+def _tpu_expected() -> bool:
+    """Whether a TPU backend will initialize in this process — the
+    latency-hiding flags are TPU-only and abort CPU/GPU XLA startup, so
+    pin them only when a TPU plugin is actually present (an unset
+    JAX_PLATFORMS is the common case on CPU boxes and must NOT pin)."""
+    import importlib.util
+
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" in plat:
+        return False
+    if "tpu" in plat:
+        return True
+    return importlib.util.find_spec("libtpu") is not None
+
+
 def main():
+    # pin the latency-hiding scheduler flags BEFORE the backend comes up
+    # (compile/backend.py; the overlap variants are meaningless without
+    # them)
+    if _tpu_expected():
+        from deepspeed_tpu.compile.backend import pin_latency_hiding_flags
+
+        added = pin_latency_hiding_flags()
+        if added:
+            print(f"tune_mfu: pinned XLA flags {added}", flush=True)
     names = sys.argv[1:] or list(VARIANTS)
     # patch the special attn impl variants in via TransformerConfig.attn_impl
     import deepspeed_tpu.models.transformer as T
