@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,9 +37,10 @@ from ...telemetry.flight import dump_on_exception
 from ...telemetry.spans import begin_span, end_span, record_event, span
 from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
-from .model_runner import (paged_copy_page, paged_decode, paged_gather_pages,
-                           paged_prefill, paged_prefill_chunk,
-                           paged_scatter_pages, paged_verify)
+from .model_runner import (pad_pages_pow2, paged_copy_page, paged_decode,
+                           paged_gather_pages, paged_prefill,
+                           paged_prefill_chunk, paged_scatter_pages,
+                           paged_verify)
 from .ragged import (PRIORITY_NORMAL, BlockAllocator, KVBlockConfig,
                      KVPageBundle, PagedKVCache, PrefixCache, RejectedError,
                      SequenceState)
@@ -80,6 +81,17 @@ class RaggedInferenceConfig(ConfigModel):
     #: cap on cached-but-UNREFERENCED pages retained for reuse (LRU);
     #: 0 = bounded only by the pool itself
     prefix_cache_pages: int = 0
+    #: tiered KV cache (serving/kv_tier.py, docs/SERVING.md "Tiered KV
+    #: cache"): a ``KVTierConfig`` (or its dict form) enabling host-RAM
+    #: spill & restore of cold prefix pages — prefix-cache LRU
+    #: evictions are captured (D2H, async at step boundaries, pages
+    #: ref-pinned until the copy commits) into a byte-budgeted host LRU
+    #: and restored CRC-verified bit-identical when a later prefix walk
+    #: reaches past the device hit.  Requires ``enable_prefix_cache``.
+    #: Typed ``Any`` to keep this module import-light — the block's
+    #: home is ``serving/config.py`` (serving imports inference, never
+    #: the reverse at module scope)
+    kv_tier: Any = None
     #: recompile sentinel for the serving loop (telemetry/
     #: compile_sentinel.py): attribute XLA compiles to steps via the
     #: step's program shapes and warn on steady-state recompilation.
@@ -216,6 +228,29 @@ class InferenceEngineV2:
                          if self.config.enable_prefix_cache else 0))
         self.prefix_cache = (PrefixCache(block.page_size, self.allocator)
                              if self.config.enable_prefix_cache else None)
+        # tiered KV cache (serving/kv_tier.py): host-RAM spill & restore
+        # of cold prefix pages.  Deferred import like the admission hook
+        # in put(): serving imports inference, never the reverse at
+        # module scope.
+        self.kv_tier = None
+        self._pending_spills: List[Tuple[int, Any]] = []
+        self._pending_spill_keys: set = set()
+        self._prefetched = True  # armed per step (see step())
+        tier_cfg = self.config.kv_tier
+        if isinstance(tier_cfg, dict):
+            from ...serving.config import KVTierConfig
+
+            tier_cfg = self.config.kv_tier = KVTierConfig.from_dict(tier_cfg)
+        if tier_cfg is not None and tier_cfg.enabled:
+            if not self.config.enable_prefix_cache:
+                raise ValueError(
+                    "kv_tier.enabled requires enable_prefix_cache: the "
+                    "host tier captures prefix-cache LRU evictions")
+            tier_cfg.validate()
+            from ...serving.kv_tier import HostKVTier
+
+            self.kv_tier = HostKVTier(tier_cfg)
+            self.allocator.spill_hook = self._capture_evicted_page
         # serving counters (cache_stats / publish_metrics): token-level
         # admission vs. computation, so hit_rate is FLOP-meaningful
         self._stats = {"prefill_admitted_tokens": 0,
@@ -332,6 +367,10 @@ class InferenceEngineV2:
         _attach("kv_prefix_pinned",
                 lambda: {"device": self._pinned_page_bytes()},
                 informational=True)
+        if self.kv_tier is not None:
+            # spilled pages are real host RAM this engine owns
+            _attach("kv_host_tier",
+                    lambda: {"host": self.kv_tier.host_bytes})
         led.update_context(
             kv_num_pages=self.block.num_pages,
             kv_page_size=self.block.page_size,
@@ -786,6 +825,194 @@ class InferenceEngineV2:
             end_span(m["span"], released=reason, generated=m["n"])
         self._publish_pool_gauges()
 
+    # -- tiered KV cache: host-RAM spill & restore ---------------------------
+    def _capture_evicted_page(self, page: int, key: Any) -> bool:
+        """``BlockAllocator.spill_hook``: decide whether an LRU-evicted
+        prefix page is captured for the host tier.  Capturing only
+        QUEUES the page (bounded by ``kv_tier.spill_inflight``) — the
+        allocator pins it via refcount so it cannot be handed out, and
+        therefore never overwritten, until :meth:`_drain_spills` commits
+        the D2H copy at the next step boundary."""
+        tier = self.kv_tier
+        if tier is None or key is None:
+            return False
+        if len(self._pending_spills) >= tier.config.spill_inflight:
+            tier.note_capture_dropped()
+            return False
+        if tier.has(key) or key in self._pending_spill_keys:
+            # same chain key => bit-identical content (the programs are
+            # deterministic): the copy already sits in the host tier, or
+            # is already queued this drain window — don't pin a second
+            # page and D2H the same bytes twice
+            return False
+        self._pending_spills.append((page, key))
+        self._pending_spill_keys.add(key)
+        return True
+
+    def _drain_spills(self) -> None:
+        """Commit pending host-tier spills in ONE batched D2H gather
+        (step boundary, off the hot device path): gather the pinned
+        pages' slices across every pool leaf — the exact-dtype
+        ``paged_gather_pages`` layout KV migration uses — stamp the
+        wire format's per-page CRC32, insert into the host LRU, then
+        release the pins so the pages rejoin the free list."""
+        if not self._pending_spills:
+            return
+        from ...serving.kv_tier import batch_page_crcs, page_slices
+
+        pend, self._pending_spills = self._pending_spills, []
+        self._pending_spill_keys = set()
+        t0 = time.perf_counter()
+        # bucket the gather rows to powers of two (trash-padded) so the
+        # op-by-op path keeps a small fixed compiled-shape set
+        rows = pad_pages_pow2([p for p, _ in pend], self.block.trash_page)
+        self._step_parts.add(("kv_spill", len(rows)))
+        sentinel_expect_recompile("kv_tier_spill")
+        arrays = paged_gather_pages(self._pools, rows)
+        arrays = {n: a[:, :len(pend)] for n, a in arrays.items()}
+        crcs = batch_page_crcs(arrays)
+        for j, (page, key) in enumerate(pend):
+            self.kv_tier.insert(key, page_slices(arrays, j), crcs[j])
+            self.allocator.release_spill_pin(page)
+        self.kv_tier.note_spill(len(pend), time.perf_counter() - t0)
+
+    def flush_spills(self) -> None:
+        """Commit any pending host-tier spills NOW (tests, retirement,
+        bench leg boundaries) — the engine otherwise drains them at the
+        next step boundary."""
+        self._drain_spills()
+
+    def _current_match(self, seq: SequenceState):
+        """Memoized device prefix match for a queued sequence: walked
+        only when the registry generation moved, and RESUMED from the
+        memo's end when only registrations happened (see _admit)."""
+        if seq.match_gen != self.allocator.generation:
+            resume = (seq.cached_match
+                      if seq.match_evict_gen
+                      == self.allocator.evict_generation else None)
+            seq.cached_match = self.prefix_cache.match(seq.tokens,
+                                                       resume=resume)
+            seq.match_gen = self.allocator.generation
+            seq.match_evict_gen = self.allocator.evict_generation
+        return seq.cached_match
+
+    def _tier_restore(self, tokens: List[int], shared: List[int],
+                      keys: List[Any], park: bool = False
+                      ) -> Tuple[List[int], List[Any], List[int]]:
+        """Extend a device prefix match with HOST-tier pages: continue
+        the chain-key walk into the host LRU past the device hit,
+        allocate fresh pages, H2D-scatter the restored KV (the same
+        ``paged_scatter_pages`` path KV import uses, bucketed so one
+        compiled shape set serves all restores), and REGISTER the pages
+        under their chain keys — from here on they behave exactly like
+        device cache hits (suffix-only prefill, CoW on a full hit,
+        bit-identical streams).
+
+        Returns ``(shared, keys, restored)`` — new lists; ``restored``
+        pages arrive REFERENCED (their alloc ref), exactly like the
+        claimed device matches the admission holds — the caller keeps
+        the refs as the sequence's own, or frees them to re-park if it
+        blocks.  With ``park=True`` (the prefetch path) the refs are
+        dropped here: the pages sit registered + LRU-parked at the MRU
+        end, and the eventual admission maps them as device hits.  The
+        prefetch path spends only truly-free pages and never overflows
+        the LRU cap — prefetch must not evict content admission is
+        about to need."""
+        tier = self.kv_tier
+        ps = self.block.page_size
+        n_full = len(tokens) // ps
+        if tier is None or len(shared) >= n_full:
+            return shared, keys, []
+        host_keys = self.prefix_cache.host_extend(tokens, keys, tier)
+        # miss accounting (admission attempts only — prefetch re-walks a
+        # blocked head every step and must not inflate the rate): the
+        # tier missed when the walk needed pages it does not hold — an
+        # EMPTY extension past a short device match included
+        missed = len(shared) + len(host_keys) < n_full
+        if not host_keys:
+            if missed and not park:
+                tier.note_miss()
+            return shared, keys, []
+        if not park:
+            # hopeless-admission guard: every non-device-matched page
+            # (restored or computed, +1 for a possible CoW duplicate)
+            # must come out of the pool — if even that total cannot fit,
+            # the admission will block regardless, and restoring now
+            # would churn restore -> block -> park -> trim every step
+            n_total = -(-len(tokens) // ps)
+            if n_total - len(shared) + 1 > self.allocator.free_pages:
+                return shared, keys, []
+        cap = self.allocator.free_pages
+        if park:
+            cap = min(self.allocator.uncached_free_pages,
+                      (self.allocator.cache_cap - self.allocator.lru_pages
+                       if self.allocator.cache_cap > 0 else cap))
+        if cap <= 0:
+            return shared, keys, []
+        entries = []
+        for k in host_keys[:cap]:
+            e = tier.get(k)  # CRC-verified; a corrupt page refuses
+            if e is None:    # loudly and the chain ends here (miss)
+                break
+            entries.append(e)
+        if len(entries) < min(len(host_keys), cap):
+            missed = True  # a corrupt refusal cut the chain
+        if missed and not park:
+            tier.note_miss()
+        if not entries:
+            return shared, keys, []
+        host_keys = host_keys[:len(entries)]
+        t0 = time.perf_counter()
+        fresh = self.allocator.alloc(len(entries))
+        rows = pad_pages_pow2(fresh, self.block.trash_page)
+        arrays: Dict[str, Any] = {}
+        for name in entries[0]:
+            parts = [e[name] for e in entries]
+            if len(rows) > len(entries):
+                pad_shape = (parts[0].shape[0], len(rows) - len(entries)) \
+                    + parts[0].shape[2:]
+                parts.append(np.zeros(pad_shape, dtype=parts[0].dtype))
+            arrays[name] = np.concatenate(parts, axis=1)
+        self._step_parts.add(("kv_restore", len(rows)))
+        sentinel_expect_recompile("kv_tier_restore")
+        # pad rows point at the trash page: scattered zeros land where
+        # every step already writes garbage
+        self._pools = paged_scatter_pages(self._pools, rows, arrays)
+        for p, k in zip(fresh, host_keys):
+            self.allocator.register(p, k)
+        tier.note_restore(len(entries), time.perf_counter() - t0)
+        if park:
+            self.allocator.free(fresh)  # park at the LRU MRU end,
+            # registered: the next admission maps them as device hits
+            return shared + fresh, keys + host_keys, []
+        return shared + fresh, keys + host_keys, fresh
+
+    def _prefetch_restores(self) -> None:
+        """Host-tier restore prefetch for queued-but-not-admitted
+        requests: while the current batch decodes on device, the host
+        walks the head-of-queue prefixes into the host tier and stages
+        their pages back into the device pool (the H2D scatter chains
+        behind the in-flight decode program).  At most once per step."""
+        if self._prefetched:
+            return
+        self._prefetched = True
+        tier = self.kv_tier
+        if tier is None or not self._queue:
+            return
+        n = tier.config.prefetch_requests
+        if n <= 0:
+            return
+        heads = sorted(self._queue,
+                       key=lambda s: (s.priority, s.enqueue_order))[:n]
+        for seq in heads:
+            shared, keys = self._current_match(seq)
+            self._tier_restore(seq.tokens, shared, keys, park=True)
+
+    def tier_stats(self) -> Dict[str, float]:
+        """Host-tier counters (``HostKVTier.stats``); empty dict with
+        the tier off — dashboards need no conditional wiring."""
+        return dict(self.kv_tier.stats()) if self.kv_tier else {}
+
     # -- replica retirement --------------------------------------------------
     def drain(self, max_steps: int = 10_000) -> Dict[str, Any]:
         """Stop admission and run every ADMITTED sequence to completion.
@@ -815,6 +1042,7 @@ class InferenceEngineV2:
             self.step()
             steps += 1
         self._m_queue.set(len(self._queue))
+        self._drain_spills()  # retirement commits captures, frees pins
         record_event("engine_drain", cat="serve", finished=len(inflight),
                      requeued=len(pending), steps=steps)
         return {"finished": inflight, "pending": pending}
@@ -907,15 +1135,24 @@ class InferenceEngineV2:
                 # head of queue must not re-hash its prompt every step.
                 # Registrations only EXTEND a valid match, so unless an
                 # eviction happened the walk resumes from the memo's end
-                if seq.match_gen != self.allocator.generation:
-                    resume = (seq.cached_match
-                              if seq.match_evict_gen
-                              == self.allocator.evict_generation else None)
-                    seq.cached_match = self.prefix_cache.match(
-                        seq.tokens, resume=resume)
-                    seq.match_gen = self.allocator.generation
-                    seq.match_evict_gen = self.allocator.evict_generation
-                shared, keys = seq.cached_match
+                shared, keys = self._current_match(seq)
+                # CLAIM the matched pages (+1 ref) before any further
+                # allocation: the tier restore's alloc below — and this
+                # admission's own alloc — must never evict a page this
+                # sequence is about to map (an evicted-then-reused
+                # match would alias two prefix positions onto one
+                # physical page).  Released again if the admission
+                # blocks; share()/free() touch neither registry
+                # generation, so the memo above stays valid.
+                for p in shared:
+                    self.allocator.share(p)
+                # the host tier extends the device hit: spilled pages
+                # are restored (H2D, CRC-verified, registered) and from
+                # here on the admission treats them as device hits.
+                # Restored pages arrive referenced (alloc), exactly
+                # like the claimed matches above.
+                shared, keys, _restored = self._tier_restore(
+                    seq.tokens, shared, keys)
             n_total = -(-seq.length // ps)
             m = len(shared)
             # fully-cached prompt (page-aligned): the last cached page is
@@ -924,23 +1161,19 @@ class InferenceEngineV2:
             # its KV into the copy, never into the shared page
             full_hit = m > 0 and m * ps >= seq.length
             need_new = n_total - m + (1 if full_hit else 0)
-            # exact admission check WITHOUT touching the LRU: matched
-            # pages at refcount 0 are counted in free_pages but will be
-            # claimed by share(), not alloc() — exclude them so a blocked
-            # head of queue doesn't churn pages through the LRU each step
+            # exact admission check: every matched page is already
+            # referenced (claimed above), so free_pages alone is the
+            # allocatable budget — nothing here touches the LRU
             def _fits() -> bool:
-                lru_matched = sum(1 for p in shared
-                                  if self.allocator.refcount(p) == 0)
-                return need_new <= self.allocator.free_pages - lru_matched
+                return need_new <= self.allocator.free_pages
 
             while not _fits():
                 # priority admission: under pool pressure a high class
                 # preempts strictly-lower-class running sequences
                 # (lowest class, then youngest — cheapest prefix to
                 # recompute) instead of waiting behind them.  _fits()
-                # recomputes per eviction: a victim dropping its ref on
-                # a matched page moves that page into the LRU-matched
-                # set, not the allocatable one.
+                # recomputes per eviction; a victim's ref drop on a
+                # CLAIMED page changes nothing (we still hold it).
                 victims = [s for s in self._slots
                            if s is not None and s.priority > seq.priority]
                 if not victims:
@@ -956,10 +1189,14 @@ class InferenceEngineV2:
                 self._preempt(max(victims,
                                   key=lambda s: (s.priority, s.admit_order)))
             if not _fits():
+                if shared:
+                    # blocked: release the claims — device matches and
+                    # restored pages alike park (registered, MRU end) so
+                    # the next attempt re-maps them as plain device hits
+                    self.allocator.free(shared)
                 break  # head-of-line blocking, like the reference's FCFS
-            # protect matched pages from LRU eviction before allocating
-            for p in shared:
-                self.allocator.share(p)
+            # the claims above ARE this sequence's references: one ref
+            # per ``shared`` page is held from here on
             self._queue.remove(seq)
             seq.cached_match, seq.match_gen, seq.match_evict_gen = None, -1, -1
             if seq.queued_at > 0.0:
@@ -1167,8 +1404,12 @@ class InferenceEngineV2:
         to the recompile sentinel with the set of program shapes it
         dispatched (prefill buckets/chunks, decode, page copies)."""
         self._step_parts = set()
+        self._prefetched = False
         try:
             out = self._step_impl()
+            # idle / prefill-only steps still restore-prefetch for the
+            # queue head (the decode-overlap call site won if it ran)
+            self._prefetch_restores()
         except Exception as e:
             dump_on_exception("engine_v2.step", e)
             raise
@@ -1181,6 +1422,9 @@ class InferenceEngineV2:
         out: Dict[int, Dict[str, Any]] = {}
         ps = self.block.page_size
 
+        # step boundary: commit last step's captured evictions to the
+        # host tier (one batched D2H gather) and unpin their pages
+        self._drain_spills()
         self._expire_deadlines(out)
         admitted = self._admit()
         self._m_queue.set(len(self._queue))
@@ -1330,6 +1574,12 @@ class InferenceEngineV2:
                     jnp.asarray(self._page_table), jnp.asarray(act),
                     jnp.asarray(temps), self._sample_key,
                     jnp.asarray(self._decode_steps, jnp.uint32))
+                # restore-prefetch rides the in-flight decode: the host
+                # walks queued prefixes into the host tier while the
+                # device decodes, and the H2D scatter chains behind the
+                # decode program; the token fetch below waits only on
+                # decode's own output
+                self._prefetch_restores()
                 # dstpu-lint: allow[host-sync] THE one designed sync per
                 # decode step: [B] int32 tokens cross, never [B,vocab]
                 # logits (on-device sampling above is exactly for this)
@@ -1509,6 +1759,17 @@ class InferenceEngineV2:
         aborted LOUDLY (warning + closed request spans) — call
         ``drain()`` first for clean retirement that runs admitted
         sequences to completion and hands queued ones back."""
+        # pending spill captures die with the engine (their host tier
+        # does too): detach the hook FIRST — abort_all below frees
+        # sequence pages, and cap trims there must not capture fresh
+        # pins after this release — then drop the pins so a post-close
+        # allocator audit sees a clean pool
+        if self.kv_tier is not None:
+            self.allocator.spill_hook = None
+        for page, _key in self._pending_spills:
+            self.allocator.release_spill_pin(page)
+        self._pending_spills = []
+        self._pending_spill_keys = set()
         dropped = self.abort_all(reason="close")
         if dropped:
             logger.warning(
@@ -1575,6 +1836,14 @@ class InferenceEngineV2:
         self.allocator.evictions = 0
         if self.prefix_cache is not None:
             self.prefix_cache.hits = self.prefix_cache.misses = 0
+        if self.kv_tier is not None:
+            # tier CONTENTS are kept (like the device cache); only the
+            # counters re-baseline so a bench wave measures its own
+            # spill/restore traffic
+            t = self.kv_tier
+            t.spilled_pages = t.restored_pages = 0
+            t.hits = t.misses = 0
+            t.host_evictions = t.corrupt_pages = t.dropped_spills = 0
         self._cache_pub = {"hits": 0, "misses": 0, "evictions": 0}
 
     def publish_metrics(self, monitor, step: int) -> None:

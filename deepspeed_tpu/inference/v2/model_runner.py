@@ -176,11 +176,26 @@ def paged_copy_page(pools, src, dst):
         lambda a: a.at[:, dst].set(a[:, src]), pools)
 
 
+def pad_pages_pow2(pages, trash_page):
+    """Pad a page list to the next power-of-two length with trash rows.
+    The op-by-op gather/scatter path compiles one XLA program per row
+    COUNT; the host KV tier's spill drains and restores batch arbitrary
+    page counts every step, so bucketing keeps that a small fixed shape
+    set (gathered trash content is discarded; scattered pad rows write
+    zeros into the trash page, which every step clobbers anyway)."""
+    n = 1
+    while n < max(1, len(pages)):
+        n *= 2
+    return list(pages) + [trash_page] * (n - len(pages))
+
+
 def paged_gather_pages(pools, pages):
     """Host copy of the given pool pages (KV export): one numpy array
     per pool leaf, shaped ``[L, n_pages, page_size, KVH, D]`` in the
     pool's exact dtype (bf16 round-trips through ml_dtypes) — the
-    device half of KV-page migration and, later, host-RAM spill."""
+    device half of KV-page migration and of the host-RAM spill
+    (``serving/kv_tier.py`` captures evicted prefix pages through
+    exactly this layout, CRC-stamped by ``kv_transfer.page_crcs``)."""
     import numpy as np
 
     rows = jnp.asarray(np.asarray(pages, np.int32))
@@ -189,7 +204,8 @@ def paged_gather_pages(pools, pages):
 
 def paged_scatter_pages(pools, pages, arrays):
     """Write host page arrays (``paged_gather_pages`` layout) into pool
-    rows ``pages`` (KV import).  Dtypes must match the pool exactly —
+    rows ``pages`` (KV import, and the H2D half of host-tier restore —
+    one scatter path serves both).  Dtypes must match the pool exactly —
     a silent cast would break the bit-identical import contract.  Runs
     op-by-op outside jit (imports happen between steps, off the hot
     path); returns the updated pools dict."""

@@ -95,6 +95,20 @@ class BlockAllocator:
         self._key_of: Dict[int, Any] = {}   # page -> content key
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # oldest first
         self.evictions = 0
+        #: tiered KV cache (serving/kv_tier.py): called as
+        #: ``hook(page, key) -> bool`` for every page evicted from the
+        #: prefix-cache LRU.  Returning True CAPTURES the page for a
+        #: host-RAM spill: the allocator pins it (refcount 1, tracked in
+        #: ``_spill_pinned``) so it cannot be handed out — and therefore
+        #: never overwritten — until the spill's D2H copy commits and
+        #: the owner calls :meth:`release_spill_pin`.
+        self.spill_hook = None
+        self._spill_pinned: set = set()
+        #: pin headroom for the CURRENT ``alloc`` call: each captured
+        #: eviction consumes one unit of the capacity beyond the request,
+        #: so capturing can never starve the allocation mid-loop.
+        #: Outside ``alloc`` (cap trims) pinning is unconstrained.
+        self._pin_slack = num_pages
         #: bumped on every registry change (register/evict) so match
         #: results can be memoized: a blocked head-of-queue request must
         #: not re-hash its whole prompt every engine step when nothing
@@ -144,14 +158,23 @@ class BlockAllocator:
         if n > self.free_pages:
             raise MemoryError(f"KV pool exhausted: need {n} pages, "
                               f"{self.free_pages} free")
-        out = []
-        for _ in range(n):
-            if self._free:
-                p = self._free.pop()
-            else:
-                p = self._evict_lru()
-            self._ref[p] = 1
-            out.append(p)
+        # spill captures during the evictions below consume ONLY the
+        # headroom beyond this request: free_pages was just proven >= n,
+        # and every loop iteration takes one page from (free + LRU) for
+        # the caller plus at most slack pages for pins — the request
+        # itself can never fail mid-loop with refcounts half-mutated
+        self._pin_slack = self.free_pages - n
+        try:
+            out = []
+            for _ in range(n):
+                if self._free:
+                    p = self._free.pop()
+                else:
+                    p = self._evict_lru()
+                self._ref[p] = 1
+                out.append(p)
+        finally:
+            self._pin_slack = self.num_pages
         return out
 
     def share(self, page: int) -> int:
@@ -204,14 +227,18 @@ class BlockAllocator:
         * the free list has no duplicates and only refcount-0 pages;
         * every LRU page is refcount-0 AND registered;
         * ``_by_key``/``_key_of`` are a bijection over registered pages;
-        * ``cache_cap`` (when set) bounds the LRU.
+        * ``cache_cap`` (when set) bounds the LRU;
+        * every spill-pinned page (host-tier capture awaiting its D2H
+          commit) is referenced (its pin IS a reference) and
+          unregistered — it sits in the "referenced" partition with no
+          sequence owner.
 
         ``live_pages`` — one page list per live owner (e.g. every
         slotted sequence's ``seq.pages``) — additionally audits the
         refcounts *exactly*: each page's refcount must equal its total
-        occurrence count across owners.  A surplus refcount is a leak
-        (freed sequence still holding pages); a deficit is a
-        use-after-free in waiting."""
+        occurrence count across owners, PLUS one for an in-flight spill
+        pin.  A surplus refcount is a leak (freed sequence still holding
+        pages); a deficit is a use-after-free in waiting."""
         # explicit raises (not bare asserts) so ``python -O`` can't
         # compile the audit out and vacuously pass the leak gates
         free_set = set(self._free)
@@ -250,8 +277,19 @@ class BlockAllocator:
         if self.cache_cap > 0 and len(self._lru) > self.cache_cap:
             raise AssertionError(
                 f"LRU {len(self._lru)} exceeds cache_cap {self.cache_cap}")
+        for p in self._spill_pinned:
+            if self._ref[p] < 1:
+                raise AssertionError(
+                    f"spill-pinned page {p} has refcount {self._ref[p]} "
+                    "(the pin itself must hold a reference)")
+            if p in self._key_of:
+                raise AssertionError(
+                    f"spill-pinned page {p} is still registered (eviction "
+                    "must unregister before the capture)")
         if live_pages is not None:
             want: Dict[int, int] = {}
+            for p in self._spill_pinned:
+                want[p] = 1  # the in-flight spill's pin is a live ref
             for owner in live_pages:
                 for p in owner:
                     want[p] = want.get(p, 0) + 1
@@ -337,16 +375,60 @@ class BlockAllocator:
             self.generation += 1
             self.evict_generation += 1
 
-    def _evict_lru(self) -> int:
+    def _evict_one(self) -> Optional[int]:
+        """Pop + unregister the LRU tail and offer it to the spill hook.
+        Returns the page when it is immediately reusable, or None when
+        the hook captured it for a host-RAM spill (pinned at refcount 1
+        until :meth:`release_spill_pin` — never handed out, so the spill
+        copy can never race a new writer)."""
         page, _ = self._lru.popitem(last=False)
+        key = self._key_of.get(page)
         self._unregister(page)
         self.evictions += 1
+        if (self.spill_hook is not None and self._pin_slack > 0
+                and self.spill_hook(page, key)):
+            self._ref[page] = 1
+            self._spill_pinned.add(page)
+            self._pin_slack -= 1
+            return None
         return page
+
+    def _evict_lru(self) -> int:
+        """Evict LRU pages until one is NOT captured for spill; returns
+        that (allocatable) page.  Bounded: captures are limited by
+        ``_pin_slack``, so the loop always terminates with a page."""
+        while True:
+            p = self._evict_one()
+            if p is not None:
+                return p
 
     def _trim_cache(self) -> None:
         if self.cache_cap > 0:
             while len(self._lru) > self.cache_cap:
-                self._free.append(self._evict_lru())
+                # _evict_one, not _evict_lru: when the hook captures the
+                # tail page the LRU already shrank by one — looping for a
+                # returnable page here would over-evict content still
+                # within the cap
+                p = self._evict_one()
+                if p is not None:
+                    self._free.append(p)
+
+    # -- host-tier spill pins -------------------------------------------------
+    @property
+    def spill_pinned_pages(self) -> int:
+        """Pages pinned by in-flight host-tier spills: evicted from the
+        prefix-cache LRU but held out of circulation until their D2H
+        copy commits.  Counted in neither ``free_pages`` nor
+        ``lru_pages`` — they are temporarily ``used``."""
+        return len(self._spill_pinned)
+
+    def release_spill_pin(self, page: int) -> None:
+        """Drop a spill pin after its D2H copy committed (or was
+        abandoned): the page returns to the truly-free list."""
+        if page not in self._spill_pinned:
+            raise ValueError(f"page {page} is not spill-pinned")
+        self._spill_pinned.discard(page)
+        self.free([page])
 
 
 class PrefixCache:
@@ -392,8 +474,8 @@ class PrefixCache:
         return keys
 
     def match(self, tokens: Sequence[int],
-              resume: Optional[Tuple[List[int], List[Any]]] = None
-              ) -> Tuple[List[int], List[Any]]:
+              resume: Optional[Tuple[List[int], List[Any]]] = None,
+              host_tier: Any = None):
         """Longest cached page-aligned prefix of ``tokens``: walks the
         hash chain over full pages until a key misses.  Pure — the caller
         bumps hits/misses only when an admission actually consumes the
@@ -402,7 +484,14 @@ class PrefixCache:
         ``resume``: a previous (pages, keys) match for the SAME tokens,
         known still valid (allocator.evict_generation unchanged since) —
         the walk continues from its end, so a blocked head of queue under
-        heavy registration traffic re-hashes only the frontier page."""
+        heavy registration traffic re-hashes only the frontier page.
+
+        ``host_tier``: a :class:`~...serving.kv_tier.HostKVTier` (or
+        anything with ``has(key)``) consulted PAST the device hit: the
+        walk continues into the host tier's spilled pages and the return
+        grows a third element — the chain keys of consecutive host-held
+        pages the engine can restore (H2D) before prefilling the rest.
+        Without it the return stays the 2-tuple ``(pages, keys)``."""
         ps = self.page_size
         pages: List[int] = list(resume[0]) if resume else []
         keys: List[Any] = list(resume[1]) if resume else []
@@ -415,7 +504,26 @@ class PrefixCache:
             pages.append(page)
             keys.append(key)
             parent = key
+        if host_tier is not None:
+            return pages, keys, self.host_extend(tokens, keys, host_tier)
         return pages, keys
+
+    def host_extend(self, tokens: Sequence[int], keys: Sequence[Any],
+                    host_tier: Any) -> List[Any]:
+        """Continue a device match's hash-chain walk into the HOST tier:
+        chain keys for the consecutive full pages past the device hit
+        that ``host_tier`` holds.  Pure — no counters, no restore (the
+        engine restores and accounts when it consumes the extension)."""
+        ps = self.page_size
+        out: List[Any] = []
+        parent = keys[-1] if keys else None
+        for j in range(len(keys), len(tokens) // ps):
+            key = self.chain_key(parent, tokens[j * ps:(j + 1) * ps])
+            if not host_tier.has(key):
+                break
+            out.append(key)
+            parent = key
+        return out
 
     def count(self, matched_pages: int, n_full_pages: int) -> None:
         """Record a consumed match in the hit/miss counters."""

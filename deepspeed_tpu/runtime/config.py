@@ -587,7 +587,9 @@ class DeepSpeedConfig:
         self.hybrid_engine = HybridEngineConfig.from_dict(g("hybrid_engine"))
         self.resilience = ResilienceConfig.from_dict(g("resilience"))
         # fleet front tier (serving/config.py): router + replica pools;
-        # parsed here so one ds-config json describes the whole process
+        # parsed here so one ds-config json describes the whole process.
+        # Nested blocks (serving.speculative, serving.kv_tier — the
+        # tiered KV cache) coerce + validate inside ServingConfig.
         self.serving = ServingConfig.from_dict(g("serving"))
 
         if self.fp16.enabled and self.bf16.enabled:

@@ -10,9 +10,10 @@ docs/SERVING.md "Fleet serving".
 so config parsing never pulls in jax-facing engine code.
 """
 
-from .config import ServingConfig  # noqa: F401
+from .config import KVTierConfig, ServingConfig  # noqa: F401
 
 _LAZY = {
+    "HostKVTier": "kv_tier",
     "FleetRouter": "router", "build_fleet": "router",
     "affinity_key": "router", "hrw_score": "router",
     "pick_replica": "router",
@@ -26,7 +27,7 @@ _LAZY = {
     "retry_after_hint": "admission", "estimate_pages": "admission",
 }
 
-__all__ = ["ServingConfig"] + sorted(_LAZY)
+__all__ = ["ServingConfig", "KVTierConfig"] + sorted(_LAZY)
 
 
 def __getattr__(name):

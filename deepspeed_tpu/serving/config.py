@@ -18,6 +18,44 @@ from ..runtime.config_utils import ConfigModel
 
 
 @dataclasses.dataclass
+class KVTierConfig(ConfigModel):
+    """Tiered KV cache (docs/SERVING.md "Tiered KV cache"): host-RAM
+    spill & restore of cold prefix-cache pages.
+
+    Pages evicted from the device prefix-cache LRU are captured into a
+    byte-budgeted host LRU (``serving/kv_tier.py``) keyed by the PR 1
+    content-hash chain keys, in the pool's exact dtype (int8 codes +
+    scales under ``kv_quant``), and restored — CRC-verified, bit
+    identical — when a later request's prefix walks past the device
+    hit.  The block rides on :class:`RaggedInferenceConfig` (per
+    engine) and, fleet-wide, on ``serving.kv_tier`` where
+    ``build_fleet`` applies it to every replica."""
+
+    enabled: bool = False
+    #: byte budget for spilled pages resident in host RAM (LRU beyond
+    #: it); host RAM is typically 10-50x the HBM slice spared for
+    #: cached KV, so the default is deliberately generous
+    host_bytes: int = 1 << 30
+    #: bound on pages pinned awaiting their D2H spill commit (the
+    #: in-flight queue drained at step boundaries).  Evictions past the
+    #: bound are simply not captured — the device never blocks on the
+    #: host tier
+    spill_inflight: int = 64
+    #: queued-but-not-admitted requests whose host-tier restores are
+    #: prefetched while the current batch decodes (0 = admission-time
+    #: restore only)
+    prefetch_requests: int = 1
+
+    def validate(self) -> None:
+        if self.host_bytes < 0:
+            raise ValueError("kv_tier.host_bytes must be >= 0")
+        if self.spill_inflight < 1:
+            raise ValueError("kv_tier.spill_inflight must be >= 1")
+        if self.prefetch_requests < 0:
+            raise ValueError("kv_tier.prefetch_requests must be >= 0")
+
+
+@dataclasses.dataclass
 class ServingConfig(ConfigModel):
     """Fleet topology + routing policy (docs/SERVING.md "Fleet
     serving")."""
@@ -53,6 +91,12 @@ class ServingConfig(ConfigModel):
     #: re-dispatch bit-identity trivially).  None = inherit whatever the
     #: base engine config says
     speculative: Optional[SpeculativeConfig] = None
+    #: fleet-wide tiered KV cache (serving/kv_tier.py): applied by
+    #: ``build_fleet`` to EVERY replica's engine config (spill/restore
+    #: is bit-identical by contract, so uniform application keeps
+    #: migration / re-dispatch bit-identity trivially).  None = inherit
+    #: whatever the base engine config says
+    kv_tier: Optional[KVTierConfig] = None
 
     # -- admission control & load shedding (serving/admission.py) -----------
     #: fleet-wide bounded queue: submissions are shed (RejectedError
@@ -98,6 +142,12 @@ class ServingConfig(ConfigModel):
             # invalid speculative block must fail HERE, not at engine
             # construction
             self.speculative = SpeculativeConfig.from_dict(self.speculative)
+        if isinstance(self.kv_tier, dict):
+            # same Optional[...] coercion hazard as speculative above:
+            # an invalid kv_tier block must fail HERE with its own error
+            self.kv_tier = KVTierConfig.from_dict(self.kv_tier)
+        if self.kv_tier is not None:
+            self.kv_tier.validate()
         if self.prefill_replicas < 0 or self.decode_replicas < 0:
             raise ValueError("serving replica counts must be >= 0")
         if self.prefill_replicas + self.decode_replicas < 1:
@@ -139,4 +189,4 @@ class ServingConfig(ConfigModel):
                              "breaker_probe_steps must be >= 1")
 
 
-__all__ = ["ServingConfig"]
+__all__ = ["ServingConfig", "KVTierConfig"]

@@ -74,12 +74,17 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
-def _page_crcs(arrays: Dict[str, np.ndarray],
-               leaves: List[str]) -> List[int]:
+def page_crcs(arrays: Dict[str, np.ndarray],
+              leaves: List[str]) -> List[int]:
     """CRC32 per page: each page's slice of EVERY leaf (axis 1 is the
     page axis, ``[L, n_pages, ...]``), chained in sorted-leaf order.
     One checksum per page — a flipped bit, a torn page, or a shifted
-    byte stream names the exact page it corrupted."""
+    byte stream names the exact page it corrupted.
+
+    THE page-integrity serialization, shared by the wire format here
+    and the host KV tier (``serving/kv_tier.py``): spill capture stamps
+    it, restore recomputes and refuses mismatches — one layout, one
+    checksum rule, everywhere a page leaves the device."""
     if not leaves:
         return []
     n_pages = arrays[leaves[0]].shape[1]
@@ -130,7 +135,7 @@ def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
         "leaves": [{"name": n, "shape": list(bundle.arrays[n].shape),
                     "dtype": _dtype_name(bundle.arrays[n])}
                    for n in leaves],
-        "page_crcs": _page_crcs(bundle.arrays, leaves),
+        "page_crcs": page_crcs(bundle.arrays, leaves),
     }
     buf = io.BytesIO()
     hdr = json.dumps(header).encode()
@@ -186,7 +191,7 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
                        "bytes ignored")
     leaves = sorted(arrays)
     want = list(header.get("page_crcs", []))
-    got = _page_crcs(arrays, leaves)
+    got = page_crcs(arrays, leaves)
     if len(want) != len(got):
         raise CorruptBundleError(
             f"corrupt bundle: header carries {len(want)} page CRCs for "

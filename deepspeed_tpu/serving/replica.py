@@ -262,6 +262,13 @@ class EngineReplica:
             h.update(queue_depth=self.engine.queue_depth,
                      active=self.engine.active_count,
                      kv_free_fraction=round(self.kv_free_fraction(), 4))
+            tier = getattr(self.engine, "kv_tier", None)
+            if tier is not None:
+                # host-tier occupancy: the second-tier capacity signal
+                # next to the device pool's kv_free_fraction
+                h.update(kv_tier_host_pages=tier.host_pages,
+                         kv_tier_host_bytes=tier.host_bytes,
+                         kv_tier_hit_rate=round(tier.hit_rate, 4))
         return h
 
 

@@ -695,6 +695,13 @@ def build_fleet(model: Any, serving: Optional[ServingConfig] = None,
         # for greedy streams, so uniform application preserves the
         # migration / re-dispatch bit-identity contract as-is
         base = _dc.replace(base, speculative=serving.speculative)
+    if serving.kv_tier is not None:
+        # fleet-wide tiered KV cache: spill/restore is bit-identical by
+        # contract, so uniform application likewise preserves the
+        # migration / re-dispatch bit-identity (each replica owns its
+        # own host LRU — spilled pages are replica-local, like the
+        # device prefix cache they extend)
+        base = _dc.replace(base, kv_tier=serving.kv_tier)
     if params is None:
         params = model.init_params(jax.random.PRNGKey(seed))
     replicas: List[EngineReplica] = []

@@ -1,0 +1,503 @@
+"""Tiered KV cache tests (serving/kv_tier.py + the engine wiring).
+
+Fast tier: the host LRU's byte budget / eviction order / CRC refusal,
+the allocator's spill-pin machinery (capture on eviction, pin-until-
+commit, slack accounting, invariant audit), and the prefix-cache host
+consult — pure host logic, no model.
+
+Slow tier: engine-level oracles — a device prefix cache capped BELOW
+the distinct-prefix working set plus the host tier must reproduce an
+UNCAPPED engine's streams bit-identically, across plain prefix caching,
+chunked prefill, speculative decoding, kv_quant pools (spill in pool
+dtype), and preemption; restore-prefetch stages pages for queued
+requests; a corrupt host page refuses loudly and costs only recompute.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (BlockAllocator, InferenceEngineV2,
+                                        PrefixCache, RaggedInferenceConfig,
+                                        RaggedRequest)
+from deepspeed_tpu.serving.config import KVTierConfig, ServingConfig
+from deepspeed_tpu.serving.kv_tier import HostKVTier, batch_page_crcs
+
+PS = 8  # page size for the engine oracles
+
+
+def _page(v, nbytes=256):
+    """A fake gathered page: one leaf, [L=1, 1, ...] float32."""
+    return {"k": np.full((1, 1, nbytes // 4), float(v), np.float32)}
+
+
+def _put(tier, key, v, nbytes=256):
+    arrays = _page(v, nbytes)
+    return tier.insert(key, arrays, batch_page_crcs(arrays)[0])
+
+
+# ----------------------------- fast: host LRU -------------------------------
+def test_host_lru_byte_budget_and_eviction_order():
+    tier = HostKVTier(KVTierConfig(enabled=True, host_bytes=3 * 256))
+    for i in range(3):
+        assert _put(tier, f"k{i}".encode(), i)
+    assert tier.host_pages == 3 and tier.host_bytes == 3 * 256
+    _put(tier, b"k3", 3)  # over budget: k0 (oldest) evicted
+    assert tier.host_pages == 3 and not tier.has(b"k0") and tier.has(b"k3")
+    assert tier.host_evictions == 1
+    # a hit refreshes recency: k1 touched, so k2 is next to go
+    assert tier.get(b"k1") is not None
+    _put(tier, b"k4", 4)
+    assert tier.has(b"k1") and not tier.has(b"k2")
+
+
+def test_host_lru_restore_is_bit_identical_and_reput_replaces():
+    tier = HostKVTier(KVTierConfig(enabled=True, host_bytes=1 << 20))
+    arrays = _page(7)
+    tier.insert(b"a", arrays, batch_page_crcs(arrays)[0])
+    got = tier.get(b"a")
+    np.testing.assert_array_equal(got["k"], arrays["k"])
+    # re-put under the same key replaces without double-counting bytes
+    arrays2 = _page(9)
+    tier.insert(b"a", arrays2, batch_page_crcs(arrays2)[0])
+    assert tier.host_pages == 1 and tier.host_bytes == arrays2["k"].nbytes
+    np.testing.assert_array_equal(tier.get(b"a")["k"], arrays2["k"])
+
+
+def test_crc_refusal_drops_entry_loudly():
+    tier = HostKVTier(KVTierConfig(enabled=True, host_bytes=1 << 20))
+    _put(tier, b"good", 1)
+    _put(tier, b"bad", 2)
+    # simulate a host-RAM bit flip inside the stored page
+    tier._lru[b"bad"][0]["k"].view(np.uint8).reshape(-1)[3] ^= 0x40
+    assert tier.get(b"bad") is None          # refused, not garbage
+    assert not tier.has(b"bad")              # entry dropped
+    assert tier.corrupt_pages == 1
+    assert tier.get(b"good") is not None     # neighbors untouched
+
+
+def test_oversized_page_refused():
+    tier = HostKVTier(KVTierConfig(enabled=True, host_bytes=100))
+    assert not _put(tier, b"big", 1, nbytes=256)
+    assert tier.host_pages == 0 and tier.dropped_spills == 1
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        KVTierConfig(enabled=True, host_bytes=-1).validate()
+    with pytest.raises(ValueError):
+        KVTierConfig(enabled=True, spill_inflight=0).validate()
+    with pytest.raises(ValueError):
+        KVTierConfig(enabled=True, prefetch_requests=-1).validate()
+    # ds-config dict coercion through the serving block
+    sc = ServingConfig.from_dict(
+        {"kv_tier": {"enabled": True, "host_bytes": 1024}})
+    assert isinstance(sc.kv_tier, KVTierConfig)
+    assert sc.kv_tier.host_bytes == 1024
+    with pytest.raises(ValueError):
+        ServingConfig.from_dict({"kv_tier": {"enabled": True,
+                                             "spill_inflight": 0}})
+
+
+# ----------------------------- fast: allocator spill pins -------------------
+def test_spill_hook_pins_until_release():
+    a = BlockAllocator(4)
+    pc = PrefixCache(2, a)
+    captured = []
+    a.spill_hook = lambda page, key: captured.append((page, key)) or True
+    pages = a.alloc(2)
+    keys = [pc.chain_key(None, [i, i]) for i in range(2)]
+    for p, k in zip(pages, keys):
+        a.register(p, k)
+    a.free(pages)  # both park in the LRU
+    assert a.free_pages == 4
+    got = a.alloc(3)  # 2 truly free + 1 eviction; hook captures evictees
+    # the hook captured LRU pages until slack ran out (slack = 4-3 = 1):
+    # exactly one capture, then the next evictee was handed out
+    assert len(captured) == 1 and captured[0][1] == keys[0]
+    pinned = captured[0][0]
+    assert a.spill_pinned_pages == 1 and pinned not in got
+    # pinned page is allocatable by NOBODY until the commit lands
+    assert a.free_pages == 0
+    with pytest.raises(MemoryError):
+        a.alloc(1)
+    a.check_invariants()          # pins are a legal partition state
+    a.assert_no_leaks([got])      # exact audit accounts the pin
+    a.release_spill_pin(pinned)   # D2H commit landed
+    assert a.free_pages == 1 and a.spill_pinned_pages == 0
+    assert a.alloc(1) == [pinned]
+    with pytest.raises(ValueError):
+        a.release_spill_pin(pinned)  # double release
+
+
+def test_alloc_slack_never_starves_allocation():
+    """With zero headroom beyond the request, the hook is never offered
+    a page: the allocation itself always wins."""
+    a = BlockAllocator(2)
+    pc = PrefixCache(2, a)
+    a.spill_hook = lambda page, key: True  # greedy: captures anything
+    pages = a.alloc(2)
+    for i, p in enumerate(pages):
+        a.register(p, pc.chain_key(None, [i]))
+    a.free(pages)
+    got = a.alloc(2)  # needs everything: no slack, no captures
+    assert sorted(got) == sorted(pages) and a.spill_pinned_pages == 0
+
+
+def test_trim_capture_does_not_over_evict():
+    """Cap-trim with a capturing hook removes exactly the overage: a
+    captured page must not trigger an extra eviction of content still
+    within the cap."""
+    a = BlockAllocator(8, cache_pages=2)
+    pc = PrefixCache(2, a)
+    pages = a.alloc(3)
+    for i, p in enumerate(pages):
+        a.register(p, pc.chain_key(None, [i]))
+    a.spill_hook = lambda page, key: True
+    a.free(pages)  # parks 3, cap 2: ONE eviction, captured
+    assert a.lru_pages == 2 and a.spill_pinned_pages == 1
+    assert a.cached_pages == 2  # the two in-cap pages stay registered
+    a.check_invariants()
+
+
+def test_invariants_flag_spill_pin_corruption():
+    a = BlockAllocator(4, cache_pages=1)
+    pc = PrefixCache(2, a)
+    a.spill_hook = lambda page, key: True
+    pages = a.alloc(2)
+    for i, p in enumerate(pages):
+        a.register(p, pc.chain_key(None, [i]))
+    a.free(pages)  # cap 1 -> one eviction, captured + pinned
+    assert a.spill_pinned_pages == 1
+    a.assert_no_leaks([])  # pin accounted, no live owners
+    # a pin whose refcount was lost is a use-after-free in waiting
+    (pin,) = a._spill_pinned
+    a._ref[pin] = 0
+    with pytest.raises(AssertionError):
+        a.check_invariants()
+
+
+def test_prefix_match_consults_host_tier():
+    a = BlockAllocator(8)
+    pc = PrefixCache(2, a)
+    tokens = [1, 2, 3, 4, 5, 6, 7, 8]  # 4 full pages
+    keys = pc.page_keys(tokens, 4)
+    # device holds page 0; host holds pages 1 and 3 (not 2)
+    (p0,) = a.alloc(1)
+    a.register(p0, keys[0])
+
+    class FakeTier:
+        def has(self, k):
+            return k in (keys[1], keys[3])
+
+    pages, got_keys, host_keys = pc.match(tokens, host_tier=FakeTier())
+    assert pages == [p0] and got_keys == [keys[0]]
+    # host extension is CONSECUTIVE: page 1 hits, page 2 misses, page 3
+    # is unreachable past the gap
+    assert host_keys == [keys[1]]
+    # without a tier the 2-tuple contract is unchanged
+    assert pc.match(tokens) == ([p0], [keys[0]])
+
+
+# ----------------------------- slow: engine oracles -------------------------
+def _tiny(max_seq_len=128):
+    import jax
+
+    from deepspeed_tpu.models.llama import llama_model
+
+    model = llama_model("tiny", max_seq_len=max_seq_len)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, cap=3, tier=True, num_pages=48, max_seqs=4,
+            **kw):
+    cfg = RaggedInferenceConfig(
+        dtype=kw.pop("dtype", "fp32"), page_size=PS, num_pages=num_pages,
+        max_seqs=max_seqs, max_pages_per_seq=12, enable_prefix_cache=True,
+        prefix_cache_pages=cap,
+        kv_tier=(KVTierConfig(enabled=True) if tier else None), **kw)
+    return InferenceEngineV2(model, cfg, params=params)
+
+
+def _family_waves(vocab, n_fams=3, per_fam=2, rounds=2, gen=6, seed=11):
+    """Distinct-prefix family waves: families cycle so a capped cache
+    must evict (spill) each family before it returns (restore)."""
+    rng = np.random.RandomState(seed)
+    fams = [list(rng.randint(0, vocab, 2 * PS)) for _ in range(n_fams)]
+    waves = []
+    for _ in range(rounds):
+        for f in fams:
+            waves.append([RaggedRequest(
+                prompt_ids=f + list(rng.randint(0, vocab, 3 + i)),
+                max_new_tokens=gen) for i in range(per_fam)])
+    return waves
+
+
+def _play(eng, waves):
+    out = []
+    for wave in waves:
+        got = eng.generate_all([dataclasses.replace(r) for r in wave])
+        out.append([got[u] for u in sorted(got)])
+    return out
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("variant", ["plain", "chunked", "speculative",
+                                     "kv_quant"])
+def test_tier_bit_exact_vs_uncapped(variant):
+    """The headline contract: a capped device cache + host tier streams
+    bit-identically to an UNCAPPED engine (never-evicted), across the
+    serving feature matrix.  ``kv_quant`` is the spill-in-pool-dtype
+    parity proof: int8 codes + scales spill and restore bit-identical
+    to pages that never left the device."""
+    from deepspeed_tpu.inference.v2 import SpeculativeConfig
+
+    kw = {}
+    if variant == "chunked":
+        kw["prefill_chunk"] = PS
+    elif variant == "speculative":
+        kw["speculative"] = SpeculativeConfig(mode="ngram", k=4)
+    elif variant == "kv_quant":
+        kw["kv_quant"] = True
+    model, params = _tiny()
+    waves = _family_waves(model.config.vocab_size)
+    ctl = _engine(model, params, cap=0, tier=False, num_pages=64, **kw)
+    want = _play(ctl, waves)
+    ctl.close()
+    eng = _engine(model, params, cap=3, tier=True, **kw)
+    got = _play(eng, waves)
+    ts = eng.tier_stats()
+    assert got == want, f"{variant}: tiered streams diverged"
+    assert ts["spilled_pages"] > 0 and ts["restored_pages"] > 0, \
+        f"{variant}: the tier never engaged ({ts})"
+    assert ts["corrupt_pages"] == 0
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_tier_bit_exact_under_preemption():
+    """A pool tight enough to preempt running sequences composes with
+    the tier: preempted prefixes re-admit through the cache/tier and
+    streams stay bit-identical to a roomy uncapped control."""
+    model, params = _tiny()
+    waves = _family_waves(model.config.vocab_size, n_fams=2, per_fam=3,
+                          gen=10)
+    ctl = _engine(model, params, cap=0, tier=False, num_pages=64)
+    want = _play(ctl, waves)
+    ctl.close()
+    # 18 pages: 3 concurrent sequences x ~5 pages + cache pressure
+    eng = _engine(model, params, cap=2, tier=True, num_pages=18,
+                  max_seqs=3)
+    got = _play(eng, waves)
+    assert got == want
+    eng.assert_no_leaks()
+    eng.close()
+
+
+def _junk_wave(eng, vocab, salt=77, gen=4):
+    """Push earlier families out of a CAPPED LRU: a junk family's wave
+    parks its pages on retire, the cap trims the oldest — which the
+    spill hook captures (pinned, pending the next drain)."""
+    rng = np.random.RandomState(salt)
+    junk = list(rng.randint(0, vocab, 2 * PS))
+    eng.generate_all([RaggedRequest(prompt_ids=junk, max_new_tokens=gen)])
+
+
+@pytest.mark.slow
+def test_pin_until_commit_under_slow_drain():
+    """The async-spill window: between eviction and the step-boundary
+    drain (the 'slow copy'), captured pages stay ref-pinned — not
+    allocatable, not yet in the host tier, and the exact allocator
+    audit stays green.  The commit (flush) moves them host-side and
+    returns the pages."""
+    model, params = _tiny()
+    rng = np.random.RandomState(3)
+    vocab = model.config.vocab_size
+    fam = list(rng.randint(0, vocab, 2 * PS))
+    eng = _engine(model, params, cap=2, tier=True, num_pages=32,
+                  max_seqs=2)
+    eng.generate_all([RaggedRequest(prompt_ids=fam, max_new_tokens=4)])
+    _junk_wave(eng, vocab)  # trims fam's pages out: captured, pending
+    assert eng.allocator.spill_pinned_pages == 2
+    pinned = set(eng.allocator._spill_pinned)
+    assert eng.kv_tier.host_pages == 0          # D2H not committed yet
+    # pinned pages are allocatable by nobody until the commit lands
+    free0 = eng.allocator.free_pages
+    grabbed = eng.allocator.alloc(free0)
+    assert not (pinned & set(grabbed))
+    eng.allocator.free(grabbed)
+    eng.assert_no_leaks()                       # pins accounted exactly
+    eng.flush_spills()                          # the commit lands
+    assert eng.kv_tier.host_pages == len(pinned)
+    assert eng.allocator.spill_pinned_pages == 0
+    assert eng.allocator.free_pages == free0 + len(pinned)
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_restore_prefetch_for_queued_request():
+    """While an admitted batch decodes, the queue head's host-held
+    prefix is prefetched back into the device pool (registered +
+    LRU-parked), so its admission is a pure device hit — and the output
+    is bit-identical to an uncapped control."""
+    model, params = _tiny()
+    rng = np.random.RandomState(5)
+    vocab = model.config.vocab_size
+    fam = list(rng.randint(0, vocab, 2 * PS))
+    queued_req = RaggedRequest(
+        prompt_ids=fam + list(rng.randint(0, vocab, 3)), max_new_tokens=4)
+    long_req = RaggedRequest(
+        prompt_ids=list(rng.randint(0, vocab, 12)), max_new_tokens=24)
+    ctl = _engine(model, params, cap=0, tier=False, num_pages=64)
+    want = ctl.generate_all([dataclasses.replace(queued_req)])[0]
+    ctl.close()
+
+    eng = _engine(model, params, cap=2, tier=True, num_pages=32,
+                  max_seqs=1)  # ONE slot: the second request queues
+    eng.generate_all([RaggedRequest(prompt_ids=fam, max_new_tokens=4)])
+    _junk_wave(eng, vocab)  # fam evicted + captured
+    eng.flush_spills()      # ...and committed host-side
+    assert eng.kv_tier.host_pages >= 2
+    keys = eng.prefix_cache.page_keys(fam, 2)
+    assert all(eng.allocator.lookup(k) is None for k in keys)  # device-cold
+    # give the prefetch LRU-cap headroom for the restore-ahead phase
+    eng.allocator.cache_cap = 8
+
+    u_q = None
+    eng.put(long_req)
+    u_q = eng.put(queued_req)
+    prefetched_while_queued = False
+    got = {}
+    for _ in range(300):
+        for uid, rec in eng.step().items():
+            got.setdefault(uid, []).extend(rec["tokens"])
+        if (any(s.uid == u_q for s in eng._queue)
+                and all(eng.allocator.lookup(k) is not None
+                        for k in keys)):
+            prefetched_while_queued = True
+        if not eng.has_work():
+            break
+    assert prefetched_while_queued, \
+        "queue-head prefix was never staged back while waiting"
+    assert eng.kv_tier.restored_pages >= 2
+    assert got[u_q] == want  # bit-identical through the prefetch path
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_corrupt_host_page_refused_costs_only_recompute():
+    """A bit-flipped host page refuses restore LOUDLY; the request
+    recomputes its suffix and the stream is STILL bit-identical — the
+    device loses nothing on refusal."""
+    model, params = _tiny()
+    rng = np.random.RandomState(9)
+    vocab = model.config.vocab_size
+    fam = list(rng.randint(0, vocab, 2 * PS))
+    req = RaggedRequest(prompt_ids=fam + [1, 2, 3], max_new_tokens=6)
+    ctl = _engine(model, params, cap=0, tier=False, num_pages=64)
+    want = ctl.generate_all([dataclasses.replace(req)])[0]
+    ctl.close()
+
+    eng = _engine(model, params, cap=2, tier=True, num_pages=32,
+                  max_seqs=2)
+    eng.generate_all([RaggedRequest(prompt_ids=fam, max_new_tokens=4)])
+    _junk_wave(eng, vocab)
+    eng.flush_spills()
+    # flip one byte inside the family's FIRST spilled page
+    keys = eng.prefix_cache.page_keys(fam, 2)
+    assert eng.kv_tier.has(keys[0])
+    arrays0 = eng.kv_tier._lru[keys[0]][0]
+    next(iter(arrays0.values())).view(np.uint8).reshape(-1)[5] ^= 0x10
+    out = eng.generate_all([dataclasses.replace(req)])
+    got = out[max(out)]  # uids keep counting on a reused engine
+    assert got == want
+    assert eng.kv_tier.corrupt_pages >= 1
+    assert not eng.kv_tier.has(keys[0])  # refused entry dropped
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_restore_alloc_never_evicts_matched_pages():
+    """Regression: with the free list EMPTY and the request's device-
+    matched prefix pages sitting LRU-parked, the restore's own alloc
+    must not evict them (that would alias two prefix positions onto
+    one physical page).  The admission claims the matches first; when
+    nothing is left to allocate from it blocks instead of corrupting,
+    and admits bit-identically once pages free up."""
+    model, params = _tiny()
+    rng = np.random.RandomState(21)
+    vocab = model.config.vocab_size
+    fam = list(rng.randint(0, vocab, 3 * PS))  # 3 full prefix pages
+    req = RaggedRequest(prompt_ids=fam + [5, 6, 7], max_new_tokens=4)
+    ctl = _engine(model, params, cap=0, tier=False, num_pages=64)
+    want = ctl.generate_all([dataclasses.replace(req)])[0]
+    ctl.close()
+
+    eng = _engine(model, params, cap=2, tier=True, num_pages=24,
+                  max_seqs=1)
+    # warm: fam's 3 pages registered, then pushed out wholesale (junk
+    # wave + cap 2) and committed host-side
+    eng.generate_all([RaggedRequest(prompt_ids=fam, max_new_tokens=2)])
+    _junk_wave(eng, vocab)
+    eng.flush_spills()
+    # restore the chain HEAD back to the device: a 2-page-prefix
+    # request re-admits pages 0-1 (host hit), retires, parks them
+    eng.generate_all([RaggedRequest(prompt_ids=fam[:2 * PS] + [9, 9],
+                                    max_new_tokens=2)])
+    eng.flush_spills()
+    keys = eng.prefix_cache.page_keys(fam, 3)
+    dev = [eng.allocator.lookup(k) for k in keys]
+    host = [eng.kv_tier.has(k) for k in keys]
+    # the finding's shape: device-matched head + host-held continuation
+    assert dev[0] is not None and dev[1] is not None, (dev, host)
+    assert dev[2] is None and host[2], (dev, host)
+    # drain the free list completely (hold every truly-free page)
+    held = eng.allocator.alloc(len(eng.allocator._free))
+    assert not eng.allocator._free
+    uid = eng.put(dataclasses.replace(req))
+    out = dict(eng.step())  # admission must block or admit — not alias
+    for s in list(eng._slots):
+        if s is not None:
+            assert len(set(s.pages)) == len(s.pages), \
+                f"aliased page table: {s.pages}"
+    eng.allocator.free(held)  # capacity returns
+    got = {uid: []}
+    for uid_, rec in out.items():
+        got.setdefault(uid_, []).extend(rec.get("tokens", []))
+    while eng.has_work():
+        for uid_, rec in eng.step().items():
+            got.setdefault(uid_, []).extend(rec["tokens"])
+        for s in list(eng._slots):
+            if s is not None:
+                assert len(set(s.pages)) == len(s.pages), \
+                    f"aliased page table: {s.pages}"
+    assert got[uid] == want
+    eng.assert_no_leaks()
+    eng.close()
+
+
+@pytest.mark.slow
+def test_close_releases_pending_spill_pins():
+    model, params = _tiny()
+    rng = np.random.RandomState(13)
+    vocab = model.config.vocab_size
+    fam = list(rng.randint(0, vocab, 2 * PS))
+    eng = _engine(model, params, cap=2, tier=True, num_pages=32,
+                  max_seqs=2)
+    eng.generate_all([RaggedRequest(prompt_ids=fam, max_new_tokens=4)])
+    _junk_wave(eng, vocab)
+    assert eng.allocator.spill_pinned_pages > 0
+    # leave a request MID-FLIGHT: close()'s abort_all frees its pages,
+    # which parks + cap-trims — the detached hook must not pin anew
+    eng.put(RaggedRequest(prompt_ids=list(rng.randint(0, vocab, 2 * PS)),
+                          max_new_tokens=16))
+    for _ in range(3):
+        eng.step()
+    eng.close()  # releases pins WITHOUT committing (tier dies too)
+    assert eng.allocator.spill_pinned_pages == 0
+    eng.allocator.assert_no_leaks([])

@@ -138,6 +138,17 @@ RUNGS = {
                              "DSTPU_IBENCH_GEN": "128",
                              "DSTPU_IBENCH_NREQ": "32",
                              "DSTPU_IBENCH_CHUNK": "128"},
+    # tiered KV cache (serving/kv_tier.py): prefix families cycling
+    # through a device prefix cache capped below the working set, host
+    # tier off vs on — prefill tokens computed at the FIXED device pool
+    # is the figure of merit; the run hard-gates bit-identity and zero
+    # steady-state recompiles
+    "serving-160m-kvtier": {"_tool": "bench_serving",
+                            "_args": ["--ab-kv-tier"],
+                            "DSTPU_SBENCH_SIZE": "160m",
+                            "DSTPU_SBENCH_PREFIX": "256",
+                            "DSTPU_SBENCH_SUFFIX": "32",
+                            "DSTPU_SBENCH_GEN": "32"},
 }
 
 
@@ -158,6 +169,7 @@ def main() -> int:
                            or k.startswith("DSTPU_IBENCH_"))}
         rung = dict(RUNGS[name])
         tool = rung.pop("_tool", None)
+        extra_args = rung.pop("_args", [])
         env = {**ambient, **rung, **overrides}
         script = os.path.join(ROOT, "tools", tool + ".py") if tool \
             else os.path.join(ROOT, "bench.py")
@@ -171,7 +183,7 @@ def main() -> int:
             # whole ladder plus fallback fits the rung-set timeout
             env.setdefault("DSTPU_BENCH_RUNG_TIMEOUT", "600")
             proc = subprocess.run(
-                [sys.executable, script, *args],
+                [sys.executable, script, *extra_args, *args],
                 capture_output=True, text=True, env=env, timeout=5400)
             line = (proc.stdout.strip().splitlines() or [""])[-1]
             try:

@@ -37,10 +37,19 @@ weights:
   streams finish
   elsewhere **bit-identical** to the control, and after the cooldown
   the breaker recovers through half-open probing on live traffic.
+* **Tiered-KV leg** (fresh 1+1 fleet) — the device prefix cache is
+  capped BELOW the leg's distinct-prefix working set with the host-RAM
+  KV tier on (``serving.kv_tier`` through ``build_fleet``): families
+  cycle, cold prefixes spill to host on LRU eviction and restore
+  (CRC-verified) when their family returns; streams must be
+  **bit-identical** to an UNCAPPED single-engine control, the
+  allocator audit must stay green with in-flight spill pins accounted,
+  and the host-tier occupancy must surface in replica ``health()``.
 * **Metric-name lint** — the run registers the
   ``deepspeed_tpu_serving_fleet_*`` + ``deepspeed_tpu_serving_slo_*``
-  families, then ``tools/check_metric_names.py`` must pass over the
-  tree and see them.
+  + ``deepspeed_tpu_serving_kv_tier_*`` families, then
+  ``tools/check_metric_names.py`` must pass over the tree and see
+  them.
 
 Writes ``fleet_drill.json`` under ``--out``, prints ONE JSON summary
 line, and exits non-zero when any check fails — the acceptance gate for
@@ -150,7 +159,57 @@ def _build(n_requests: int, new_tokens: int, seed: int = 7):
 
         return fl, slo_control
 
-    return fleet, make_requests, control_run, build_slo_fleet
+    def build_tier_fleet():
+        """Fresh 1-prefill + 1-decode fleet with the device prefix
+        cache capped BELOW the tier leg's working set and the host-RAM
+        KV tier on — the ``serving.kv_tier`` block flows through
+        ``build_fleet`` to every replica.  The control is an UNCAPPED
+        single engine (no tier): the tier must make the capped fleet
+        reproduce its streams bit-identically."""
+        from deepspeed_tpu.serving import KVTierConfig
+
+        tier_base = RaggedInferenceConfig(
+            dtype="fp32", page_size=PAGE_SIZE, num_pages=48, max_seqs=4,
+            max_pages_per_seq=12, enable_prefix_cache=True,
+            prefix_cache_pages=3)  # 1.5 families of 2 prefix pages
+        tier_serving = ServingConfig(
+            enabled=True, prefill_replicas=1, decode_replicas=1,
+            disaggregated=True, affinity_pages=2, prefill_chunk=PAGE_SIZE,
+            kv_tier=KVTierConfig(enabled=True))
+        fl = build_fleet(model, tier_serving, engine_config=tier_base,
+                         params=params)
+        uncapped = RaggedInferenceConfig(
+            dtype="fp32", page_size=PAGE_SIZE, num_pages=64, max_seqs=4,
+            max_pages_per_seq=12, enable_prefix_cache=True)
+        ctl = InferenceEngineV2(model, uncapped, params=params)
+
+        def tier_control(requests):
+            got = ctl.generate_all([RaggedRequest(
+                prompt_ids=list(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens) for r in requests])
+            return [got[u] for u in sorted(got)]
+
+        return fl, tier_control
+
+    def make_tier_waves(new_tokens, n_fams=3, per_fam=2, rounds=2,
+                        salt=12):
+        """Distinct-prefix FAMILY waves for the tier leg: each wave is
+        one family's burst; families cycle over ``rounds`` so the
+        capped device cache must evict (spill) a family before it comes
+        around again (restore)."""
+        rq = np.random.RandomState(seed * 100 + salt)
+        fams = [list(rq.randint(0, vocab, PREFIX_TOKENS))
+                for _ in range(n_fams)]
+        waves = []
+        for _r in range(rounds):
+            for f in fams:
+                waves.append([RaggedRequest(
+                    prompt_ids=f + list(rq.randint(0, vocab, 3 + i)),
+                    max_new_tokens=new_tokens) for i in range(per_fam)])
+        return waves
+
+    return (fleet, make_requests, control_run, build_slo_fleet,
+            build_tier_fleet, make_tier_waves)
 
 
 def run_demo(out: str, n_requests: int, new_tokens: int,
@@ -161,7 +220,8 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
     os.makedirs(out)
     print(f"fleet drill: {n_requests} requests x {new_tokens} tokens, "
           f"1 prefill + 2 decode replicas, seed {seed} -> {out}")
-    fleet, make_requests, control_run, build_slo_fleet = _build(
+    (fleet, make_requests, control_run, build_slo_fleet,
+     build_tier_fleet, make_tier_waves) = _build(
         n_requests, new_tokens, seed)
     reg = get_registry()
 
@@ -430,6 +490,45 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
                 slo_leaks.append(f"{name}: {e}")
     _check(checks, "slo_fleet_no_leaks", not slo_leaks, slo_leaks[:2])
 
+    # ---- leg 6: tiered KV cache — capped device cache + host-RAM tier
+    print("  leg 6: tiered KV cache (host-RAM spill & restore)")
+    tier_fleet, tier_control = build_tier_fleet()
+    sp0 = counter("deepspeed_tpu_serving_kv_tier_spilled_pages_total")
+    rs0 = counter("deepspeed_tpu_serving_kv_tier_restored_pages_total")
+    got_tier, want_tier = [], []
+    for wave in make_tier_waves(new_tokens):
+        want_tier.extend(tier_control(wave))
+        wave_uids = [tier_fleet.submit(r) for r in wave]
+        for _ in range(300):
+            if not tier_fleet.has_work():
+                break
+            tier_fleet.step()
+        got_tier.extend(tier_fleet.request_state(u)["emitted"]
+                        for u in wave_uids)
+    sp = counter("deepspeed_tpu_serving_kv_tier_spilled_pages_total") - sp0
+    rs = counter("deepspeed_tpu_serving_kv_tier_restored_pages_total") - rs0
+    _check(checks, "kv_tier_spills_and_restores_ran", sp > 0 and rs > 0,
+           f"{sp:.0f} pages spilled, {rs:.0f} restored")
+    _check(checks, "kv_tier_streams_bit_identical_to_uncapped_control",
+           got_tier == want_tier,
+           f"{sum(g == w for g, w in zip(got_tier, want_tier))}"
+           f"/{len(want_tier)} match")
+    tier_leaks = []
+    for name, rep in tier_fleet.replicas.items():
+        try:
+            rep.engine.assert_no_leaks()  # accounts in-flight spill pins
+        except AssertionError as e:
+            tier_leaks.append(f"{name}: {e}")
+    _check(checks, "kv_tier_no_leaks_after_churn", not tier_leaks,
+           tier_leaks[:2] if tier_leaks else
+           f"{len(tier_fleet.replicas)} replicas audited (spill pins "
+           "accounted)")
+    tier_health = tier_fleet.health()
+    _check(checks, "kv_tier_occupancy_in_replica_health",
+           any(h.get("kv_tier_host_pages", 0) > 0
+               for h in tier_health.values()),
+           {n: h.get("kv_tier_host_pages") for n, h in tier_health.items()})
+
     # ---- metric-name lint over the tree (fleet family included)
     import check_metric_names as lint
 
@@ -444,6 +543,10 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
                        if n.startswith("deepspeed_tpu_serving_slo_"))
     _check(checks, "slo_metric_family_registered", len(slo_names) >= 8,
            slo_names[:4])
+    tier_names = sorted(n for n in lint.collect(_REPO_DIR)
+                        if n.startswith("deepspeed_tpu_serving_kv_tier_"))
+    _check(checks, "kv_tier_metric_family_registered",
+           len(tier_names) >= 5, tier_names[:4])
 
     ok = all(c["ok"] for c in checks)
     summary = {"demo": "fleet_drill", "ok": ok, "out": out, "seed": seed,
