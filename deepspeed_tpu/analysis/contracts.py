@@ -198,7 +198,7 @@ def _replay_train(engine, batch, steps: int = 3) -> Dict[str, Any]:
             "compiles_after_warmup": (int(c1 - c0) if monitoring else None)}
 
 
-def _v2_engine():
+def _v2_engine(horizon: int = 1):
     import jax
 
     from ..inference.v2 import (InferenceEngineV2, RaggedInferenceConfig,
@@ -207,10 +207,15 @@ def _v2_engine():
 
     model = llama_model("tiny", max_seq_len=64)
     params = model.init_params(jax.random.PRNGKey(0))
+    # a fused decode horizon and a proposer are mutually exclusive (the
+    # engine stands the horizon down): the multistep program gets a
+    # speculation-free engine, every other program keeps the verify path
+    spec = (SpeculativeConfig(mode="off") if horizon > 1
+            else SpeculativeConfig(mode="ngram", k=3))
     return InferenceEngineV2(model, RaggedInferenceConfig(
         dtype="fp32", page_size=8, num_pages=32, max_seqs=2,
-        max_pages_per_seq=8,
-        speculative=SpeculativeConfig(mode="ngram", k=3)), params=params)
+        max_pages_per_seq=8, decode_horizon=horizon,
+        speculative=spec), params=params)
 
 
 def _v2_extras(eng) -> Dict[str, Any]:
@@ -249,9 +254,78 @@ def _decode_program() -> Dict[str, Any]:
             jnp.asarray(eng._page_table),
             jnp.asarray(np.zeros((B,), bool)),
             jnp.asarray(np.zeros((B,), np.float32)),
-            jax.random.PRNGKey(0), jnp.asarray(1, jnp.uint32))
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jax.random.PRNGKey(0))
     return {"fn": eng._decode, "args": args, "mesh": None,
             "extras": _v2_extras(eng), "replay": None}
+
+
+def _multi_decode_program() -> Dict[str, Any]:
+    """Fused multi-step decode (model_runner.paged_multi_decode): the
+    K-step on-device decode scan with in-scan sampling and per-row
+    EOS/budget masking — pins its collective counts, the donated pool
+    buffers (a lost donation doubles the KV pool's HBM), and a 3-step
+    same-shape replay across MIXED per-row produced lengths at 0
+    recompiles (mixed budgets/EOS are data, never shapes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _v2_engine(horizon=4)
+    B, K = eng.block.max_seqs, eng._horizon
+    args = (eng.params, eng._pools,
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(eng._page_table),
+            jnp.asarray(np.zeros((B,), bool)),
+            jnp.asarray(np.zeros((B,), np.float32)),
+            jnp.asarray(np.full((B,), -1, np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jax.random.PRNGKey(0), K)
+    return {"fn": eng._multi, "args": args, "mesh": None,
+            "extras": _v2_extras(eng),
+            "replay": lambda: _replay_multi_decode(eng, K)}
+
+
+def _replay_multi_decode(eng, K: int) -> Dict[str, Any]:
+    """Dispatch the fused decode scan 3 times with the SAME shapes but
+    DIFFERENT per-row budget/EOS mixes (mixed produced lengths) and
+    count XLA backend compiles after the first dispatch — pinned at 0:
+    every acceptance outcome of the horizon must reuse one compiled
+    program, like the speculative verify width does."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..telemetry.compile_sentinel import (compile_counts,
+                                              install_compile_listener)
+
+    monitoring = install_compile_listener()
+    B = eng.block.max_seqs
+    key = jax.random.PRNGKey(0)
+
+    def dispatch(budgets, eos):
+        _toks, produced, eng._pools = eng._multi(
+            eng.params, eng._pools,
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(np.zeros((B,), np.int32)),
+            jnp.asarray(eng._page_table),
+            jnp.asarray(np.ones((B,), bool)),
+            jnp.asarray(np.zeros((B,), np.float32)),
+            jnp.asarray(np.asarray(eos, np.int32)),
+            jnp.asarray(np.asarray(budgets, np.int32)),
+            jnp.asarray(np.arange(B, dtype=np.int32)),
+            key, K)
+        jax.block_until_ready(produced)
+
+    dispatch([1 + (i % K) for i in range(B)], [-1] * B)  # warmup
+    c0, _ = compile_counts()
+    dispatch([K - (i % K) for i in range(B)], [-1] * B)
+    dispatch([max(1, K // 2)] * B, [0] * B)  # EOS-capable rows
+    c1, _ = compile_counts()
+    return {"steps": 3,
+            "compiles_after_warmup": (int(c1 - c0) if monitoring else None)}
 
 
 def _verify_program() -> Dict[str, Any]:
@@ -395,6 +469,11 @@ PROGRAM_BUILDERS: Dict[str, Tuple[Callable[[], Dict[str, Any]], str]] = {
     "decode": (
         _decode_program,
         "engine_v2 paged decode + on-device sampling, all slots"),
+    "decode_multistep": (
+        _multi_decode_program,
+        "engine_v2 fused multi-step decode: K=4 on-device decode scan "
+        "with in-scan sampling and per-row EOS/budget masking, ONE "
+        "[B, K] host pull per dispatch"),
     "paged_verify": (
         _verify_program,
         "engine_v2 speculative batched verify (width k+1) + greedy argmax"),

@@ -38,9 +38,9 @@ from ...telemetry.spans import begin_span, end_span, record_event, span
 from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
 from .model_runner import (pad_pages_pow2, paged_copy_page, paged_decode,
-                           paged_gather_pages, paged_prefill,
-                           paged_prefill_chunk, paged_scatter_pages,
-                           paged_verify)
+                           paged_gather_pages, paged_multi_decode,
+                           paged_prefill, paged_prefill_chunk,
+                           paged_scatter_pages, paged_verify, sample_tokens)
 from .ragged import (PRIORITY_NORMAL, BlockAllocator, KVBlockConfig,
                      KVPageBundle, PagedKVCache, PrefixCache, RejectedError,
                      SequenceState)
@@ -115,6 +115,19 @@ class RaggedInferenceConfig(ConfigModel):
     #: (sampling guard) so the output distribution is never touched
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
+    #: fused multi-step decode (docs/SERVING.md "Multi-step decode"):
+    #: decode up to this many tokens per host round-trip via an
+    #: on-device ``lax.scan`` over the decode body — ONE ``[B, K]``
+    #: token pull per dispatch instead of one ``[B]`` pull per token,
+    #: with per-row EOS/length/deadline masking computed in-scan
+    #: (finished rows write to the trash page and stop consuming
+    #: pages).  Greedy AND sampled streams are bit-identical across
+    #: horizons (the sampling key folds per position, never per
+    #: dispatch).  1 = the classic one-step decode loop.  Engines with
+    #: speculative decoding enabled stand the horizon down loudly —
+    #: one designed exclusive decode path at a time, like the
+    #: sampling guard
+    decode_horizon: int = 1
     #: bounded request queue (admission control): once this many
     #: requests wait for admission, ``put()`` raises
     #: :class:`RejectedError` (load shedding — the submitter backs off
@@ -156,6 +169,41 @@ class RaggedRequest:
     #: with ``finish_reason="deadline"`` instead of letting it wait (or
     #: decode) forever
     deadline_s: Optional[float] = None
+
+
+def _horizon_pages_needed(length: int, budget: int, page_size: int) -> int:
+    """Pages a decode row needs to emit ``budget`` more tokens: its t-th
+    token this dispatch (1-indexed) writes KV at position
+    ``length - 2 + t``, so the page table must cover position
+    ``length - 2 + budget`` — the headroom-reservation arithmetic of
+    the fused multi-step decode (pure, unit-tested)."""
+    return (length - 2 + budget) // page_size + 1
+
+
+def _shrink_horizon(k: int, cap: int) -> int:
+    """Walk the halving chain ``K, ceil(K/2), ...`` down to the smallest
+    value still covering ``cap`` (floor 1).  The dispatch horizon only
+    ever takes values ON the chain, so the fused scan's compiled-shape
+    set is O(log K) — short row budgets and pool pressure shrink the
+    dispatch instead of minting arbitrary scan lengths (pure,
+    unit-tested)."""
+    while k > 1 and (k + 1) // 2 >= cap:
+        k = (k + 1) // 2
+    return max(1, k)
+
+
+def _deadline_clamp(budget: int, deadline_left: float,
+                    tpot_est: Optional[float]) -> int:
+    """Clamp a row's effective horizon when its deadline lands
+    mid-horizon: at ~``tpot_est`` seconds per fused step, emit only the
+    tokens that fit the remaining budget (floor 1 — a single step would
+    emit one token before the boundary sweep too).  Without an
+    estimate (first dispatch) the budget passes through: the boundary
+    sweep still expires the row, at most one horizon late (pure,
+    unit-tested)."""
+    if tpot_est is None or tpot_est <= 0.0:
+        return budget
+    return min(budget, max(1, int(deadline_left / tpot_est)))
 
 
 class InferenceEngineV2:
@@ -260,6 +308,7 @@ class InferenceEngineV2:
         # invocations vs tokens produced is THE speculative-decoding
         # figure of merit — tokens per invocation
         self._dstats = {"decode_model_invocations": 0, "decode_tokens": 0,
+                        "decode_host_syncs": 0, "decode_horizon_shrinks": 0,
                         "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
                         "spec_verify_calls": 0, "spec_rollback_pages": 0,
                         "spec_fallback_requests": 0}
@@ -280,16 +329,15 @@ class InferenceEngineV2:
         cfg = self.cfg
 
         def _decode_and_sample(params, pools, last, pos, table, act, temps,
-                               key, ctr):
+                               sids, key):
             logits, pools = paged_decode(cfg, params, pools, last, pos,
                                          table, act)
-            z = logits.astype(jnp.float32)
-            greedy = jnp.argmax(z, axis=-1).astype(jnp.int32)
-            sampled = jax.random.categorical(
-                jax.random.fold_in(key, ctr),  # fold inside the program:
-                z / jnp.maximum(temps[:, None], 1e-6),  # no extra dispatch
-                axis=-1).astype(jnp.int32)
-            return jnp.where(temps > 0.0, sampled, greedy), pools
+            # sample_tokens folds the key per (request uid, position)
+            # INSIDE the program — no extra dispatch, and the SAME fold
+            # the fused multi-step scan uses, so decode horizons are
+            # stream-identical (greedy and sampled alike) and a sampled
+            # stream keeps its noise through preemption / migration
+            return sample_tokens(logits, temps, key, sids, pos + 1), pools
 
         self._decode = jax.jit(_decode_and_sample, donate_argnums=(1,))
         self._prefill = jax.jit(
@@ -326,6 +374,41 @@ class InferenceEngineV2:
                         .astype(jnp.int32), pools)
 
             self._verify = jax.jit(_verify_and_greedy, donate_argnums=(1,))
+        # fused multi-step decode (docs/SERVING.md "Multi-step decode"):
+        # one designed exclusive decode path at a time — a configured
+        # proposer owns the decode loop, so the horizon stands down
+        # LOUDLY (the multi-step twin of the sampling guard)
+        self._horizon = int(self.config.decode_horizon)
+        if self._horizon < 1:
+            raise ValueError(
+                f"decode_horizon must be >= 1, got {self._horizon}")
+        if self._proposer is not None and self._horizon > 1:
+            logger.warning(
+                f"multi-step decode: speculative decoding is enabled and "
+                f"owns the decode loop — decode_horizon {self._horizon} "
+                "stands down to 1 (disable speculative.mode to fuse "
+                "decode steps)")
+            self._horizon = 1
+        #: EMA of per-token decode wall time: the deadline clamp's TPOT
+        #: estimate (None until a WARM dispatch lands — a dispatch that
+        #: compiled its horizon shape would seed the EMA with XLA
+        #: compile seconds and poison the clamp for ~10 dispatches)
+        self._tpot_ema: Optional[float] = None
+        self._warm_horizons: set = set()
+        if self._horizon > 1:
+            def _multi_fn(params, pools, last, pos, table, act, temps,
+                          eos, budg, sids, key, horizon):
+                return paged_multi_decode(cfg, params, pools, last, pos,
+                                          table, act, temps, eos, budg,
+                                          sids, key, horizon)
+
+            # horizon is static (the scan length); the engine only ever
+            # dispatches halving-chain values, so the compiled-shape
+            # set stays O(log decode_horizon)
+            self._multi = jax.jit(_multi_fn, donate_argnums=(1,),
+                                  static_argnums=(11,))
+        else:
+            self._multi = None
         # request lifecycle bookkeeping: enqueue/first-token stamps + the
         # open request span, keyed by uid (survives preemption, which
         # resets the SequenceState but not the request)
@@ -486,6 +569,25 @@ class InferenceEngineV2:
         self._m_spec_verify_h = reg.histogram(
             "deepspeed_tpu_serving_spec_verify_seconds",
             "one batched speculative verify program wall time")
+        # fused multi-step decode family (decode_horizon > 1,
+        # docs/SERVING.md "Multi-step decode"): the dispatch economics
+        # of the K-step decode scan — tokens banked per device
+        # round-trip, round-trips paid, horizons shrunk under pressure
+        self._m_tokens_per_dispatch = reg.histogram(
+            "deepspeed_tpu_serving_decode_tokens_per_dispatch",
+            "tokens emitted per decode-phase device dispatch (a fused "
+            "multi-step scan emits up to horizon x batch per dispatch; "
+            "the K=1 loop at most batch)")
+        self._m_host_syncs = reg.counter(
+            "deepspeed_tpu_serving_decode_host_syncs_total",
+            "decode-phase host round-trips (device token pulls): the "
+            "fused multi-step scan pays ONE per horizon where the K=1 "
+            "loop pays one per token")
+        self._m_horizon_shrink = reg.counter(
+            "deepspeed_tpu_serving_decode_horizon_shrink_total",
+            "multi-step dispatches whose horizon was shrunk below "
+            "decode_horizon (KV-pool headroom pressure or short row "
+            "budgets) instead of preempting mid-scan")
         # serving-SLO family (docs/OBSERVABILITY.md): deadline expiry,
         # queue wait, and TTFT/TPOT SLO-violation accounting live on the
         # engine; the shed + breaker halves of the family live on the
@@ -516,14 +618,19 @@ class InferenceEngineV2:
         return PhaseTimer(name, sink=lambda _n, dt: hist.observe(dt), **attrs)
 
     # -- request lifecycle bookkeeping ---------------------------------------
-    def _note_tokens(self, seq: SequenceState, n: int = 1) -> None:
+    def _note_tokens(self, seq: SequenceState, n: int = 1,
+                     t: Optional[float] = None) -> None:
         """Account ``n`` newly emitted tokens against the request: the
         first one closes the TTFT window (enqueue -> first token,
-        queue wait included)."""
+        queue wait included).  ``t`` is the token's emit timestamp — a
+        fused multi-step dispatch passes per-token timestamps
+        RECONSTRUCTED from the horizon (token j landed ~j+1 device
+        steps in), so TTFT/TPOT and their SLO-violation checks never
+        see a K-token burst stamped at one instant."""
         m = self._req_meta.get(seq.uid)
         if m is None:
             return
-        now = time.perf_counter()
+        now = t if t is not None else time.perf_counter()
         if m["t_first"] is None:
             m["t_first"] = now
             ttft = now - m["t0"]
@@ -1552,18 +1659,14 @@ class InferenceEngineV2:
         else:
             decode_seqs = active
 
-        if decode_seqs:
-            B = self.block.max_seqs
-            last = np.zeros((B,), np.int32)
-            pos = np.zeros((B,), np.int32)
-            act = np.zeros((B,), bool)
-            temps = np.zeros((B,), np.float32)
-            for seq in decode_seqs:
-                last[seq.slot] = seq.tokens[-1]
-                pos[seq.slot] = seq.length - 1
-                act[seq.slot] = True
-                temps[seq.slot] = max(seq.temperature, 0.0)
-
+        if decode_seqs and self._horizon > 1:
+            # fused multi-step decode: K tokens per host round-trip
+            # through ONE on-device scan (docs/SERVING.md "Multi-step
+            # decode"); speculative engines never reach here (the
+            # horizon stood down at construction)
+            self._multi_decode(decode_seqs, out)
+        elif decode_seqs:
+            last, pos, act, temps, sids = self._decode_inputs(decode_seqs)
             self._decode_steps += 1
             self._step_parts.add("decode")
             with self._phase("decode", self._m_decode_h,
@@ -1572,21 +1675,25 @@ class InferenceEngineV2:
                     self.params, self._pools,
                     jnp.asarray(last), jnp.asarray(pos),
                     jnp.asarray(self._page_table), jnp.asarray(act),
-                    jnp.asarray(temps), self._sample_key,
-                    jnp.asarray(self._decode_steps, jnp.uint32))
+                    jnp.asarray(temps), jnp.asarray(sids),
+                    self._sample_key)
                 # restore-prefetch rides the in-flight decode: the host
                 # walks queued prefixes into the host tier while the
                 # device decodes, and the H2D scatter chains behind the
                 # decode program; the token fetch below waits only on
                 # decode's own output
                 self._prefetch_restores()
-                # dstpu-lint: allow[host-sync] THE one designed sync per
-                # decode step: [B] int32 tokens cross, never [B,vocab]
-                # logits (on-device sampling above is exactly for this)
+                # dstpu-lint: allow[host-sync] THE designed sync of the
+                # K=1 decode path: [B] int32 tokens cross, never
+                # [B,vocab] logits; decode_horizon > 1 amortizes this
+                # to one [B,K] pull per horizon (_multi_decode)
                 tokens = np.asarray(tokens)
             self._m_gen_tokens.inc(len(decode_seqs))
             self._m_invocations.inc()
+            self._m_host_syncs.inc()
+            self._m_tokens_per_dispatch.observe(len(decode_seqs))
             self._dstats["decode_model_invocations"] += 1
+            self._dstats["decode_host_syncs"] += 1
             self._dstats["decode_tokens"] += len(decode_seqs)
 
             for seq in decode_seqs:
@@ -1608,6 +1715,176 @@ class InferenceEngineV2:
                     rec["finish_reason"] = seq.finish_reason
         self._sync_cache_counters()
         return out
+
+    def _decode_inputs(self, seqs: List[SequenceState]):
+        """Dense ``[max_seqs]`` dispatch arrays for a decode-phase
+        batch — ONE assembly shared by the K=1 and fused paths (the two
+        are asserted stream-identical; independently-built inputs could
+        silently diverge)."""
+        B = self.block.max_seqs
+        last = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        act = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        sids = np.zeros((B,), np.int32)
+        for seq in seqs:
+            last[seq.slot] = seq.tokens[-1]
+            pos[seq.slot] = seq.length - 1
+            act[seq.slot] = True
+            temps[seq.slot] = max(seq.temperature, 0.0)
+            sids[seq.slot] = seq.uid % (1 << 31)  # stable sampling id
+        return last, pos, act, temps, sids
+
+    # -- fused multi-step decode ---------------------------------------------
+    def _multi_decode(self, seqs: List[SequenceState],
+                      out: Dict[int, Dict[str, Any]]) -> None:
+        """One fused multi-step decode dispatch (docs/SERVING.md
+        "Multi-step decode"): clamp each row's effective horizon
+        (remaining max_new / model window / deadline), shrink the
+        dispatch horizon along the halving chain under KV-pool
+        pressure — never preempting mid-scan — pre-reserve every row's
+        page headroom, run the K-step on-device scan, then advance ALL
+        published state (tokens, prefilled, page registration,
+        retirement) from the ONE ``[B, K]`` host pull.  Prefix-cache
+        registration, deadline expiry, admission, spill drains, and
+        restore-prefetch all stay at host boundaries, exactly as for
+        the K=1 loop."""
+        ps = self.block.page_size
+        B = self.block.max_seqs
+        now = time.perf_counter()
+        budgets: Dict[int, int] = {}
+        for seq in seqs:
+            b = min(self._horizon,
+                    seq.max_new_tokens - seq.generated,
+                    self.max_seq_len - seq.length)
+            if seq.deadline > 0.0:
+                # deadline lands mid-horizon: clamp the row's effective
+                # K so a fused dispatch cannot overshoot the deadline
+                # by K x TPOT; the boundary sweep then expires it on
+                # time with the tokens it legitimately produced
+                b = _deadline_clamp(b, seq.deadline - now, self._tpot_ema)
+            budgets[seq.uid] = max(1, b)
+
+        # dispatch horizon: the smallest halving-chain value covering
+        # the largest row budget (short tails don't scan dead
+        # iterations), shrunk further while the TRULY-free pool cannot
+        # cover the headroom — headroom backs tokens a row may never
+        # produce (mid-horizon EOS), so like speculative draft
+        # reservation it never evicts prefix-cache LRU content; the
+        # horizon shrinks instead.  k=1 always fits: the page-boundary
+        # loop in _step_impl already guaranteed every pending token's
+        # page (claiming LRU pages there exactly like the K=1 loop).
+        k = _shrink_horizon(self._horizon, max(budgets.values()))
+
+        def _extra_pages(k_: int) -> int:
+            return sum(
+                max(0, _horizon_pages_needed(
+                    s.length, min(k_, budgets[s.uid]), ps) - len(s.pages))
+                for s in seqs)
+
+        while k > 1 and _extra_pages(k) > self.allocator.uncached_free_pages:
+            k = (k + 1) // 2
+        if k < self._horizon:
+            self._m_horizon_shrink.inc()
+            self._dstats["decode_horizon_shrinks"] += 1
+            record_event("horizon_shrink", cat="serve", horizon=k,
+                         configured=self._horizon,
+                         **self._pool_occupancy())
+
+        # pre-reserve each row's horizon headroom; a refused
+        # reservation (spill pins landed between the check and here)
+        # clamps THAT row to the headroom it already holds — the
+        # dispatch never fails and nothing is preempted mid-scan
+        for seq in seqs:
+            b = min(k, budgets[seq.uid])
+            extra = _horizon_pages_needed(seq.length, b, ps) \
+                - len(seq.pages)
+            if extra > 0:
+                fresh = self.allocator.try_alloc(extra, uncached_only=True)
+                if fresh is None:
+                    b = max(1, len(seq.pages) * ps - seq.length + 1)
+                else:
+                    base = len(seq.pages)
+                    seq.pages.extend(fresh)
+                    self._page_table[seq.slot, base:base + extra] = fresh
+            budgets[seq.uid] = b
+
+        last, pos, act, temps, sids = self._decode_inputs(seqs)
+        eos = np.full((B,), -1, np.int32)
+        budg = np.zeros((B,), np.int32)
+        for seq in seqs:
+            if seq.eos_id is not None:
+                eos[seq.slot] = seq.eos_id
+            budg[seq.slot] = budgets[seq.uid]
+
+        self._decode_steps += 1
+        self._step_parts.add(("multi_decode", k))
+        warm = k in self._warm_horizons
+        self._warm_horizons.add(k)
+        t0 = time.perf_counter()
+        with self._phase("multi_decode", self._m_decode_h,
+                         batch=len(seqs), horizon=k):
+            toks, produced, self._pools = self._multi(
+                self.params, self._pools,
+                jnp.asarray(last), jnp.asarray(pos),
+                jnp.asarray(self._page_table), jnp.asarray(act),
+                jnp.asarray(temps), jnp.asarray(eos), jnp.asarray(budg),
+                jnp.asarray(sids), self._sample_key, k)
+            # restore-prefetch rides the in-flight scan, like K=1
+            self._prefetch_restores()
+            # dstpu-lint: allow[host-sync] THE designed sync per decode horizon
+            # [B,K] int32 tokens + [B] produced counts cross the link
+            # once per K tokens — the fused form of the per-step decode
+            # sync, amortized K-fold
+            toks, produced = np.asarray(toks), np.asarray(produced)
+        t1 = time.perf_counter()
+
+        # the scan ALWAYS executes k iterations (finished rows run
+        # masked, they don't shorten the program): per-device-step wall
+        # is wall / k, not wall / produced — dividing by produced would
+        # inflate the estimate on every stream tail
+        per_step = (t1 - t0) / k
+        # EMA of per-token decode wall, the deadline clamp's estimate —
+        # updated only from WARM dispatches: a dispatch that compiled
+        # its horizon shape measures XLA compile time, not decode time
+        if warm:
+            self._tpot_ema = (per_step if self._tpot_ema is None
+                              else 0.5 * self._tpot_ema + 0.5 * per_step)
+        total = int(produced.sum())
+        self._m_gen_tokens.inc(total)
+        self._m_invocations.inc()
+        self._m_host_syncs.inc()
+        self._m_tokens_per_dispatch.observe(total)
+        self._dstats["decode_model_invocations"] += 1
+        self._dstats["decode_host_syncs"] += 1
+        self._dstats["decode_tokens"] += total
+
+        for seq in seqs:
+            n = int(produced[seq.slot])
+            rec = out.setdefault(seq.uid, {"tokens": [], "done": False})
+            reason = ""
+            for j in range(n):
+                tok = int(toks[seq.slot, j])
+                seq.tokens.append(tok)
+                rec["tokens"].append(tok)
+                # token j landed ~(j+1) device steps into the dispatch:
+                # reconstructed per-token emit timestamps, so
+                # TTFT/TPOT and the SLO-violation checks never see a
+                # K-token burst stamped at one instant
+                self._note_tokens(seq, t=t0 + (j + 1) * per_step)
+                reason = self._finish_reason_for(seq, tok)
+                if reason:
+                    break  # the scan stopped the row here by contract
+            # the scan wrote KV for every token it consumed; the last
+            # emitted token is the pending one, exactly like K=1
+            seq.prefilled = seq.length - 1
+            self._register_pages(seq)
+            if reason:
+                seq.finish_reason = reason
+                self._retire(seq)  # frees unused horizon headroom too
+            rec["done"] = seq.done
+            if seq.done:
+                rec["finish_reason"] = seq.finish_reason
 
     # -- speculative decoding ------------------------------------------------
     def _spec_step(self, seqs: List[SequenceState],
@@ -1695,7 +1972,9 @@ class InferenceEngineV2:
             # round; acceptance is per-row host logic by design
             greedy = np.asarray(greedy)  # [B, W] argmax per position
         self._m_invocations.inc()
+        self._m_host_syncs.inc()
         self._dstats["decode_model_invocations"] += 1
+        self._dstats["decode_host_syncs"] += 1
         self._dstats["spec_verify_calls"] += 1
 
         # -- accept + emit + rollback (host) --
@@ -1812,6 +2091,11 @@ class InferenceEngineV2:
         inv = s["decode_model_invocations"]
         s["decode_tokens_per_invocation"] = (
             s["decode_tokens"] / inv) if inv else 0.0
+        syncs = s["decode_host_syncs"]
+        # the multi-step figure of merit (bench_serving --ab-multistep):
+        # decode tokens banked per host round-trip
+        s["decode_tokens_per_host_sync"] = (
+            s["decode_tokens"] / syncs) if syncs else 0.0
         prop = s["spec_proposed_tokens"]
         s["spec_acceptance_rate"] = (
             s["spec_accepted_tokens"] / prop) if prop else 0.0

@@ -464,6 +464,10 @@ def paged_decode(cfg: TransformerConfig, params, pools,
     positions: [B] position of that token; page_table: [B, MP]
     (trash-filled beyond each sequence's pages); active: [B] bool.
     Returns (logits [B, V], pools).
+
+    This is also the per-iteration body of :func:`paged_multi_decode` —
+    ONE formulation, so the fused K-step scan cannot diverge from the
+    single-step program it must be bit-identical to.
     """
     quant = "k_scale" in pools
     B = last_tokens.shape[0]
@@ -476,8 +480,16 @@ def paged_decode(cfg: TransformerConfig, params, pools,
         x = _norm(x, params["embed"]["norm"]["scale"],
                   params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
 
-    page_idx = jnp.where(active,
-                         page_table[jnp.arange(B), positions // ps], trash)
+    # clamp the page lookup for INACTIVE rows: inside the multi-step
+    # scan a finished row's position stops advancing but may already sit
+    # one past its last page; the gathered index is discarded (the
+    # jnp.where routes the write to the trash page), active rows always
+    # index in range by the engine's headroom-reservation contract
+    page_idx = jnp.where(
+        active,
+        page_table[jnp.arange(B),
+                   jnp.minimum(positions // ps, page_table.shape[1] - 1)],
+        trash)
     off = positions % ps
     S = page_table.shape[1] * ps
     slot_pos = jnp.arange(S)[None]  # [1, S]
@@ -524,3 +536,86 @@ def paged_decode(cfg: TransformerConfig, params, pools,
                    params["final_norm"].get("bias"), cfg.norm, cfg.norm_eps)
     logits = logits_fn(cfg, params, hidden)[:, 0]
     return logits, out_pools
+
+
+def sample_tokens(logits, temps, key, sids, positions) -> jnp.ndarray:
+    """On-device sampling shared by the single-step decode program and
+    the fused multi-step scan: greedy argmax, or Gumbel-max categorical
+    at temperature > 0.
+
+    The sampling key is folded per **(request id, position)** — the
+    engine passes each row's uid, a STABLE identity, and the position
+    of the token being generated — never per dispatch and never per
+    decode slot: a K-step fused scan draws exactly the noise K
+    single-step dispatches would (sampled rows bit-identical across
+    decode horizons, greedy trivially so), a preempted-and-readmitted
+    or migrated sampled stream continues with ITS noise regardless of
+    which slot it lands in, and co-batched requests at equal positions
+    never share noise.  logits: [B, V]; temps: [B] (<= 0 = greedy);
+    sids: [B] int32 per-row request ids; positions: [B] position the
+    sampled token will occupy.  Returns [B] int32 token ids.
+    """
+    z = logits.astype(jnp.float32)
+    greedy = jnp.argmax(z, axis=-1).astype(jnp.int32)
+
+    def _one(sid, p, zrow, t):
+        k = jax.random.fold_in(jax.random.fold_in(key, sid), p)
+        return jax.random.categorical(
+            k, zrow / jnp.maximum(t, 1e-6)).astype(jnp.int32)
+
+    sampled = jax.vmap(_one)(sids.astype(jnp.int32),
+                             positions.astype(jnp.int32), z, temps)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+def paged_multi_decode(cfg: TransformerConfig, params, pools,
+                       last_tokens, positions, page_table, active,
+                       temps, eos_ids, budgets, sids, key, horizon: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, Any]:
+    """``horizon`` decode steps in ONE device program: a ``lax.scan``
+    over the :func:`paged_decode` body (paged KV write → attention →
+    on-device :func:`sample_tokens` with the per-position key fold →
+    position/page-index advance), with per-row active/EOS/budget
+    masking computed **in-scan** — finished rows write to the trash
+    page and stop consuming pages.  ONE host pull per K tokens instead
+    of K round-trips (engine_v2 ``_multi_decode``).
+
+    last_tokens/positions/active/temps: as :func:`paged_decode`;
+    page_table: [B, MP] covering each row's PRE-RESERVED horizon
+    headroom (the engine reserves pages for ``budgets[b]`` tokens
+    before dispatch — nothing allocates mid-scan); eos_ids: [B] int32
+    (-1 = no EOS); budgets: [B] int32 tokens row ``b`` may emit this
+    dispatch (min of the request's remaining max_new / model-window /
+    deadline/headroom clamps and the horizon; 0 = inactive); sids: [B]
+    int32 per-row request ids for the sampling fold.
+
+    Returns ``(tokens [B, K] int32, produced [B] int32, pools)``:
+    row ``b``'s emitted tokens are ``tokens[b, :produced[b]]``
+    (positions past ``produced`` hold -1).  A row stops — and its
+    later iterations write to the trash page — after its EOS token or
+    its budget'th token, exactly where K single steps would have
+    retired it; contract: the emitted stream is bit-identical to K
+    single-step dispatches (greedy AND sampled — see sample_tokens).
+    """
+    B = last_tokens.shape[0]
+
+    def step(carry, _):
+        pools, last, pos, act, produced = carry
+        logits, pools = paged_decode(cfg, params, pools, last, pos,
+                                     page_table, act)
+        tok = sample_tokens(logits, temps, key, sids, pos + 1)
+        emit = act
+        tok = jnp.where(emit, tok, jnp.int32(-1))
+        produced = produced + emit.astype(jnp.int32)
+        eos_hit = emit & (eos_ids >= 0) & (tok == eos_ids)
+        act = emit & jnp.logical_not(eos_hit) & (produced < budgets)
+        last = jnp.where(emit, tok, last)
+        pos = pos + emit.astype(jnp.int32)
+        return (pools, last, pos, act, produced), tok
+
+    act0 = active & (budgets > 0)
+    carry0 = (pools, last_tokens, positions, act0,
+              jnp.zeros((B,), jnp.int32))
+    (pools, _l, _p, _a, produced), toks = jax.lax.scan(
+        step, carry0, None, length=horizon)
+    return jnp.transpose(toks), produced, pools

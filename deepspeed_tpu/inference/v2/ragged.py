@@ -177,6 +177,28 @@ class BlockAllocator:
             self._pin_slack = self.num_pages
         return out
 
+    def try_alloc(self, n: int,
+                  uncached_only: bool = False) -> Optional[List[int]]:
+        """Headroom reservation (fused multi-step decode): allocate ``n``
+        pages, or return ``None`` — allocator untouched — when the pool
+        cannot cover them.  The engine pre-reserves each decode row's
+        page headroom for the whole horizon before dispatch and SHRINKS
+        the horizon on refusal instead of preempting mid-scan, so this
+        is the non-raising twin of :meth:`alloc` for callers whose
+        fallback is "ask for less", not "crash the step".
+
+        ``uncached_only=True`` spends TRULY-free pages only: horizon
+        headroom backs tokens a row may never produce (mid-horizon
+        EOS), so — exactly like speculative draft reservation — it must
+        never evict prefix-cache LRU content (guaranteed future
+        savings) to cover it; ``alloc`` prefers the free list, so a
+        grant within it never touches the LRU."""
+        budget = self.uncached_free_pages if uncached_only \
+            else self.free_pages
+        if n > budget:
+            return None
+        return self.alloc(n)
+
     def share(self, page: int) -> int:
         """Map an already-written page into another sequence (+1 ref).
         A cached page at refcount 0 leaves the LRU: it is live again."""

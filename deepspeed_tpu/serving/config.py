@@ -97,6 +97,15 @@ class ServingConfig(ConfigModel):
     #: migration / re-dispatch bit-identity trivially).  None = inherit
     #: whatever the base engine config says
     kv_tier: Optional[KVTierConfig] = None
+    #: fleet-wide fused multi-step decode horizon (docs/SERVING.md
+    #: "Multi-step decode"): applied by ``build_fleet`` to EVERY
+    #: replica's engine config.  Decode horizons are stream-identical
+    #: by contract (greedy and sampled alike), so uniform application
+    #: keeps migration / re-dispatch bit-identity trivially; replicas
+    #: with speculative decoding enabled stand the horizon down on
+    #: their own (one exclusive decode path at a time).  None =
+    #: inherit whatever the base engine config says
+    decode_horizon: Optional[int] = None
 
     # -- admission control & load shedding (serving/admission.py) -----------
     #: fleet-wide bounded queue: submissions are shed (RejectedError
@@ -148,6 +157,9 @@ class ServingConfig(ConfigModel):
             self.kv_tier = KVTierConfig.from_dict(self.kv_tier)
         if self.kv_tier is not None:
             self.kv_tier.validate()
+        if self.decode_horizon is not None and self.decode_horizon < 1:
+            raise ValueError("serving.decode_horizon must be >= 1 "
+                             "(1 = the classic one-step decode loop)")
         if self.prefill_replicas < 0 or self.decode_replicas < 0:
             raise ValueError("serving replica counts must be >= 0")
         if self.prefill_replicas + self.decode_replicas < 1:

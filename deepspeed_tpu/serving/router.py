@@ -702,6 +702,12 @@ def build_fleet(model: Any, serving: Optional[ServingConfig] = None,
         # own host LRU — spilled pages are replica-local, like the
         # device prefix cache they extend)
         base = _dc.replace(base, kv_tier=serving.kv_tier)
+    if serving.decode_horizon is not None:
+        # fleet-wide fused multi-step decode: horizons are
+        # stream-identical by contract, so uniform application keeps
+        # migration / re-dispatch bit-identity trivially (speculative
+        # replicas stand the horizon down themselves)
+        base = _dc.replace(base, decode_horizon=serving.decode_horizon)
     if params is None:
         params = model.init_params(jax.random.PRNGKey(seed))
     replicas: List[EngineReplica] = []

@@ -56,7 +56,8 @@ from .registry import MetricsRegistry, get_registry
 
 #: span/phase names whose enters/exits feed the per-phase watermarks
 DEFAULT_WATCH_PHASES = ("train_batch", "forward", "backward",
-                        "optimizer_step", "prefill", "decode")
+                        "optimizer_step", "prefill", "decode",
+                        "multi_decode")
 
 #: substrings that mark an exception as a device-memory exhaustion; XLA
 #: surfaces OOM as XlaRuntimeError("RESOURCE_EXHAUSTED: ..."), the KV

@@ -252,7 +252,8 @@ def test_golden_contracts_hold(contracts_mod, extracted):
     assert len(goldens) >= 6, sorted(goldens)
     for required in ("train_step_zero0", "train_step_zero1",
                      "train_step_zero3", "prefill", "decode",
-                     "paged_verify", "train_step_zero1_hier",
+                     "paged_verify", "decode_multistep",
+                     "train_step_zero1_hier",
                      "moe_dispatch_quantized", "train_step_zero1_overlap",
                      "train_step_zero3_prefetch"):
         assert required in goldens, f"missing golden for {required}"
@@ -292,7 +293,8 @@ def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
 @pytest.mark.parametrize("program", ["prefill", "moe_dispatch_quantized",
                                      "train_step_zero1_hier",
                                      "train_step_zero1_overlap",
-                                     "train_step_zero3_prefetch"])
+                                     "train_step_zero3_prefetch",
+                                     "decode_multistep"])
 def test_update_goldens_idempotent(contracts_mod, extracted, tmp_path,
                                    program):
     """Writing goldens twice — the second time from a fresh extraction of
@@ -327,6 +329,23 @@ def test_train_replay_recompile_contract(contracts_mod, extracted):
             assert replay["compiles_after_warmup"] == 0, (
                 f"{prog}: steady-state steps recompiled "
                 f"{replay['compiles_after_warmup']}x")
+
+
+def test_multistep_decode_replay_and_donation_contract(contracts_mod,
+                                                       extracted):
+    """The fused multi-step decode program's contract: the KV pool
+    buffers stay donated (a lost donation doubles the pool's HBM), and
+    the 3-dispatch replay across MIXED per-row produced lengths —
+    different budget/EOS mixes, same shapes — compiles exactly once."""
+    c = extracted["decode_multistep"]["contract"]
+    assert c["donated_inputs"] >= 2, c  # the k/v pool leaves
+    replay = c.get("replay")
+    assert replay is not None and replay["steps"] == 3
+    if replay["compiles_after_warmup"] is not None:
+        assert replay["compiles_after_warmup"] == 0, (
+            "fused decode recompiled across mixed produced-lengths: "
+            f"{replay['compiles_after_warmup']}x (budgets/EOS must be "
+            "data, never shapes)")
 
 
 def test_contract_set_hash_tracks_goldens(contracts_mod, tmp_path):

@@ -149,6 +149,17 @@ RUNGS = {
                             "DSTPU_SBENCH_PREFIX": "256",
                             "DSTPU_SBENCH_SUFFIX": "32",
                             "DSTPU_SBENCH_GEN": "32"},
+    # fused multi-step decode (decode_horizon): K tokens per host
+    # round-trip through one on-device decode scan — host syncs per
+    # token is the figure of merit; the run hard-gates bit-identity
+    # vs the K=1 loop and zero steady-state recompiles
+    "serving-160m-multistep": {"_tool": "bench_serving",
+                               "_args": ["--ab-multistep"],
+                               "DSTPU_SBENCH_SIZE": "160m",
+                               "DSTPU_SBENCH_PREFIX": "256",
+                               "DSTPU_SBENCH_SUFFIX": "32",
+                               "DSTPU_SBENCH_GEN": "128",
+                               "DSTPU_SBENCH_HORIZON": "8"},
 }
 
 

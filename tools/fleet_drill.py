@@ -208,8 +208,21 @@ def _build(n_requests: int, new_tokens: int, seed: int = 7):
                     max_new_tokens=new_tokens) for i in range(per_fam)])
         return waves
 
+    def build_multistep_fleet():
+        """Fresh 1-prefill + 1-decode fleet with the fused multi-step
+        decode horizon applied fleet-wide (``serving.decode_horizon``
+        flows through ``build_fleet`` to every replica): the decode
+        pool pulls K tokens per host round-trip and must reproduce the
+        single-engine K=1 control's greedy streams bit-identically."""
+        ms_serving = ServingConfig(
+            enabled=True, prefill_replicas=1, decode_replicas=1,
+            disaggregated=True, affinity_pages=2, prefill_chunk=PAGE_SIZE,
+            decode_horizon=8)
+        return build_fleet(model, ms_serving, engine_config=base,
+                           params=params)
+
     return (fleet, make_requests, control_run, build_slo_fleet,
-            build_tier_fleet, make_tier_waves)
+            build_tier_fleet, make_tier_waves, build_multistep_fleet)
 
 
 def run_demo(out: str, n_requests: int, new_tokens: int,
@@ -221,7 +234,7 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
     print(f"fleet drill: {n_requests} requests x {new_tokens} tokens, "
           f"1 prefill + 2 decode replicas, seed {seed} -> {out}")
     (fleet, make_requests, control_run, build_slo_fleet,
-     build_tier_fleet, make_tier_waves) = _build(
+     build_tier_fleet, make_tier_waves, build_multistep_fleet) = _build(
         n_requests, new_tokens, seed)
     reg = get_registry()
 
@@ -529,6 +542,40 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
                for h in tier_health.values()),
            {n: h.get("kv_tier_host_pages") for n, h in tier_health.items()})
 
+    # ---- leg 7: fused multi-step decode pool vs single-step control
+    print("  leg 7: fused multi-step decode (decode_horizon=8)")
+    ms_reqs = make_requests(4, salt=21)
+    # control FIRST: its K=1 engine pays one host sync per token on the
+    # same process-shared counter the fused pool is measured against
+    want_ms = control_run(ms_reqs)
+    ms_fleet = build_multistep_fleet()
+    sync0 = counter("deepspeed_tpu_serving_decode_host_syncs_total")
+    ms_uids = [ms_fleet.submit(r) for r in ms_reqs]
+    for _ in range(300):
+        if not ms_fleet.has_work():
+            break
+        ms_fleet.step()
+    got_ms = [ms_fleet.request_state(u)["emitted"] for u in ms_uids]
+    ms_tokens = len(ms_reqs) * new_tokens
+    ms_syncs = counter("deepspeed_tpu_serving_decode_host_syncs_total") \
+        - sync0
+    _check(checks, "multistep_pool_bit_identical_to_single_step_control",
+           got_ms == want_ms,
+           f"{sum(g == w for g, w in zip(got_ms, want_ms))}"
+           f"/{len(want_ms)} match")
+    _check(checks, "multistep_decode_amortizes_host_syncs",
+           0 < ms_syncs <= ms_tokens / 2,
+           f"{ms_syncs:.0f} decode host pulls for {ms_tokens} tokens")
+    ms_leaks = []
+    for name, rep in ms_fleet.replicas.items():
+        try:
+            rep.engine.assert_no_leaks()
+        except AssertionError as e:
+            ms_leaks.append(f"{name}: {e}")
+    _check(checks, "multistep_no_leaks_after_horizon_churn", not ms_leaks,
+           ms_leaks[:2] if ms_leaks else
+           f"{len(ms_fleet.replicas)} replicas audited")
+
     # ---- metric-name lint over the tree (fleet family included)
     import check_metric_names as lint
 
@@ -547,6 +594,12 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
                         if n.startswith("deepspeed_tpu_serving_kv_tier_"))
     _check(checks, "kv_tier_metric_family_registered",
            len(tier_names) >= 5, tier_names[:4])
+    ms_family = ("deepspeed_tpu_serving_decode_tokens_per_dispatch",
+                 "deepspeed_tpu_serving_decode_host_syncs_total",
+                 "deepspeed_tpu_serving_decode_horizon_shrink_total")
+    ms_names = sorted(n for n in lint.collect(_REPO_DIR) if n in ms_family)
+    _check(checks, "multistep_metric_family_registered",
+           len(ms_names) == len(ms_family), ms_names)
 
     ok = all(c["ok"] for c in checks)
     summary = {"demo": "fleet_drill", "ok": ok, "out": out, "seed": seed,
