@@ -241,6 +241,11 @@ def build_model_and_config(size: str, seq: int, micro_bs: int, env=None,
     if env.get("DSTPU_BENCH_OVERLAP_BUCKET_MB"):
         zero_cfg["overlap_bucket_mb"] = float(
             env["DSTPU_BENCH_OVERLAP_BUCKET_MB"])
+    if env.get("DSTPU_BENCH_OVERLAP_COMPRESSION"):
+        # compressed overlap A/B (docs/COMM.md "Compressed overlap"):
+        # int8/fp8 codes + per-bucket EF residuals inside the loop
+        zero_cfg["overlap_compression"] = \
+            env["DSTPU_BENCH_OVERLAP_COMPRESSION"]
     opt_params = {"lr": 1e-4, "weight_decay": 0.1}
     if env.get("DSTPU_BENCH_MU_DTYPE"):
         # bf16 exp_avg: -2 bytes/param of optimizer HBM (helps the 1b
@@ -482,39 +487,56 @@ def _ab_overlap() -> None:
       * ``unbucketed`` — overlap wrap with ``overlap_bucket_mb=0``
         (per-leaf buckets, no coalescing);
       * ``on``         — overlap wrap, default buckets (+
-        ``zero3_param_prefetch`` at stage 3).
+        ``zero3_param_prefetch`` at stage 3);
+      * ``int8``       — COMPRESSED overlap (docs/COMM.md "Compressed
+        overlap"): the in-loop exchange moves int8 codes + scales with
+        ONE error-feedback residual per bucket in train state (stage 1
+        via ``zero_quantized_gradients``, stage 3 via
+        ``overlap_compression``), plus its own unbucketed twin.
 
     Machine-checked claims in the JSON:
-      * determinism — the ``on`` arm re-run from scratch reproduces its
-        loss curve bit-for-bit;
-      * ``identical_to_unbucketed`` — ``on`` vs ``unbucketed`` losses
-        are BIT-EXACT (bucketing/prefetch are scheduling, not math);
-      * ``loss_parity_max_rel`` — ``on`` vs ``off``: the wrap fixes the
-        per-shard summation order, while GSPMD is free to pick another
-        (it even differs between stages at HEAD), so this is fp
-        reassociation noise, asserted < 1e-4;
-      * ``overlapped_fraction`` per arm (0 for ``off``) and the bucket
-        count, traceable to the ``train_step_zero1_overlap`` /
-        ``train_step_zero3_prefetch`` goldens via ``contract_set_hash``.
+      * determinism — the ``on`` AND ``int8`` arms re-run from scratch
+        reproduce their loss curves bit-for-bit;
+      * ``identical_to_unbucketed`` — per compression setting, bucketed
+        vs unbucketed losses are BIT-EXACT (fp: scheduling only; int8:
+        block-aligned coalescing + layout-stable hop-1 residuals);
+      * ``loss_parity_max_rel`` — ``on`` vs ``off`` is fp reassociation
+        noise, asserted < 1e-4; ``int8`` vs ``on`` is codec noise,
+        asserted at the PR-11 tolerance (< 0.05);
+      * ``wire_reduction`` — compressed-subset logical/wire bytes from
+        the comms logger during the ``int8`` arm, gated >= 2x vs the
+        fp32-overlap payloads;
+      * ``overlapped_fraction`` per arm (0 for ``off``), the bucket
+        count, compression + residual bytes, traceable to the
+        ``train_step_zero*_overlap*`` goldens via ``contract_set_hash``.
     """
     import jax
     import jax.numpy as jnp
 
     import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
     from deepspeed_tpu.models.llama import llama_model
     from deepspeed_tpu.parallel.mesh import reset_topology
 
     steps = _int_env("DSTPU_BENCH_AB_STEPS", 6)
     repeats = _int_env("DSTPU_BENCH_AB_REPEATS", 3)
     seq, micro_bs = 32, 1
+    cl = comm.configure_comms_logger(enabled=True)
 
-    def run(stage, overlap, bucket_mb=4.0, prefetch=False):
+    def run(stage, overlap, bucket_mb=4.0, prefetch=False,
+            compressed=False):
         reset_topology()
+        cl.reset()
         model = llama_model("tiny", max_seq_len=seq)
         zero_cfg = {"stage": stage, "overlap_grad_reduce": overlap,
                     "overlap_bucket_mb": bucket_mb}
         if prefetch:
             zero_cfg["zero3_param_prefetch"] = True
+        if compressed:
+            if stage <= 2:
+                zero_cfg["zero_quantized_gradients"] = True
+            else:
+                zero_cfg["overlap_compression"] = "int8"
         engine, *_ = deepspeed_tpu.initialize(model=model, config={
             "train_micro_batch_size_per_gpu": micro_bs,
             "gradient_accumulation_steps": 1,
@@ -528,6 +550,13 @@ def _ab_overlap() -> None:
             rng.randint(0, vocab, (1, micro_bs * dp, seq)).astype(np.int32))}
             for _ in range(steps)]
         losses = [float(engine.train_batch(b)) for b in batches]
+        # compressed-subset bytes are TRACE-time (captured while the
+        # curve ran its compiles): what the quantized payloads moved vs
+        # what fp32 would have moved for the same payloads
+        comp_logical = sum(r[3] for axes in cl.comms_dict.values()
+                           for r in axes.values())
+        comp_wire = sum(r[4] for axes in cl.comms_dict.values()
+                        for r in axes.values())
         walls = []
         for _ in range(repeats):
             t0 = time.perf_counter()
@@ -540,15 +569,21 @@ def _ab_overlap() -> None:
                 "wall_median_s": sorted(walls)[len(walls) // 2],
                 "overlapped_fraction": (round(rep.overlapped_fraction, 4)
                                         if rep else 0.0),
-                "buckets": rep.buckets if rep else 0}
+                "buckets": rep.buckets if rep else 0,
+                "compression": rep.compression if rep else None,
+                "residual_bytes": rep.residual_bytes if rep else 0,
+                "comp_logical": comp_logical, "comp_wire": comp_wire}
 
     out = {"metric": "ab-overlap: per-layer-bucket grad reduce + stage-3 "
-                     f"gather prefetch vs the post-backward block (tiny "
-                     f"llama, seq={seq}, steps={steps})",
+                     f"gather prefetch vs the post-backward block, with a "
+                     f"compressed (int8-in-loop + EF) arm (tiny llama, "
+                     f"seq={seq}, steps={steps})",
            "unit": "overlapped fraction of grad-exchange bytes",
            "comparable": True,  # deterministic pinned-seed CPU tier
            "stages": {}}
     worst_parity = 0.0
+    worst_qparity = 0.0
+    worst_wire = float("inf")
     for stage in (1, 3):
         off = run(stage, overlap=False)
         unb = run(stage, overlap=True, bucket_mb=0.0,
@@ -562,30 +597,72 @@ def _ab_overlap() -> None:
             f"stage {stage}: bucketed overlap diverged from the "
             f"unbucketed path — scheduling changed the math\n"
             f"on:  {on['losses']}\nunb: {unb['losses']}")
+        q = run(stage, overlap=True, prefetch=(stage == 3),
+                compressed=True)
+        q2 = run(stage, overlap=True, prefetch=(stage == 3),
+                 compressed=True)
+        assert q["losses"] == q2["losses"], \
+            f"stage {stage}: compressed arm is not deterministic"
+        q_unb = run(stage, overlap=True, bucket_mb=0.0,
+                    prefetch=(stage == 3), compressed=True)
+        q_identical = q["losses"] == q_unb["losses"]
+        assert q_identical, (
+            f"stage {stage}: compressed bucketed overlap diverged from "
+            f"its unbucketed twin — the block-aligned coalesce / "
+            f"layout-stable residual contract broke\n"
+            f"int8:  {q['losses']}\nunb:   {q_unb['losses']}")
+        assert q["compression"] == "int8", q["compression"]
+        # wire claim: the quantized in-loop payloads move >= 2x fewer
+        # bytes than the same payloads at fp32 width (the fp32-overlap
+        # arm's wire volume for the compressed subset)
+        wire_reduction = (q["comp_logical"] / q["comp_wire"]
+                          if q["comp_wire"] else 0.0)
+        assert wire_reduction >= 2.0, (
+            f"stage {stage}: compressed overlap wire reduction "
+            f"{wire_reduction:.2f}x < 2x")
+        worst_wire = min(worst_wire, wire_reduction)
         parity = max(abs(a - b) / max(abs(a), 1e-9)
                      for a, b in zip(off["losses"], on["losses"]))
         worst_parity = max(worst_parity, parity)
+        qparity = max(abs(a - b) / max(abs(a), 1e-9)
+                      for a, b in zip(on["losses"], q["losses"]))
+        worst_qparity = max(worst_qparity, qparity)
         out["stages"][f"zero{stage}"] = {
             "contract": ("train_step_zero1_overlap" if stage == 1
                          else "train_step_zero3_prefetch"),
+            "contract_int8": ("train_step_zero1_overlap_int8" if stage == 1
+                              else "train_step_zero3_prefetch_int8"),
             "identical_to_unbucketed": identical,
+            "int8_identical_to_unbucketed": q_identical,
             "loss_parity_max_rel_vs_off": round(parity, 7),
+            "loss_parity_max_rel_int8_vs_fp_overlap": round(qparity, 7),
             "final_loss_off": off["losses"][-1],
             "final_loss_on": on["losses"][-1],
+            "final_loss_int8": q["losses"][-1],
             "overlapped_fraction": on["overlapped_fraction"],
+            "overlapped_fraction_int8": q["overlapped_fraction"],
             "buckets": on["buckets"],
+            "wire_reduction_int8": round(wire_reduction, 3),
+            "residual_bytes_int8": q["residual_bytes"],
             "wall_median_s": {"off": round(off["wall_median_s"], 4),
                               "unbucketed": round(unb["wall_median_s"], 4),
-                              "on": round(on["wall_median_s"], 4)},
+                              "on": round(on["wall_median_s"], 4),
+                              "int8": round(q["wall_median_s"], 4)},
         }
+    cl.configure(enabled=False)
     assert worst_parity < 1e-4, \
         f"overlap-on vs overlap-off loss gap {worst_parity} is not " \
         "reassociation-sized"
+    assert worst_qparity < 0.05, \
+        f"int8-overlap vs fp32-overlap loss gap {worst_qparity} exceeds " \
+        "the PR-11 codec tolerance"
     import jax as _jax
 
     out["backend"] = _jax.default_backend()
     out["value"] = out["stages"]["zero1"]["overlapped_fraction"]
-    out["loss_parity_ok"] = worst_parity < 1e-4
+    out["loss_parity_ok"] = worst_parity < 1e-4 and worst_qparity < 0.05
+    out["wire_reduction_min"] = round(worst_wire, 3)
+    out["wire_reduction_ok"] = worst_wire >= 2.0
     from deepspeed_tpu.analysis.contracts import contract_set_hash
 
     out["contract_set_hash"] = contract_set_hash(

@@ -68,6 +68,22 @@ def donated_input_count(stablehlo_text: str) -> int:
     return len(re.findall(r"tf\.aliasing_output", stablehlo_text))
 
 
+def s8_collective_count(hlo_text: str) -> int:
+    """Collective ops moving int8 codes: ops whose result type (plain or
+    combiner tuple) mentions ``s8[`` — what "int8 on the wire" means in
+    optimized HLO.  The compressed-overlap goldens pin this so a silent
+    fall-back to fp32 wire (a lost optimization_barrier, a folded
+    convert) is a named diff, not a perf mystery."""
+    tuple_ty = r"\([^()]*\)"
+    count = 0
+    for kind in COLLECTIVE_KINDS:
+        for m in re.finditer(
+                rf"=\s*({tuple_ty}|\S+)\s+{kind}(?:-start)?\(", hlo_text):
+            if "s8[" in m.group(1):
+                count += 1
+    return count
+
+
 def shape_signature_strings(*trees: Any) -> List[str]:
     """The ``compile/backend.py`` shape signature, as stable strings."""
     from ..compile.backend import shape_signature
@@ -84,9 +100,13 @@ def _cost_dict(compiled) -> Dict[str, float]:
 
 
 def extract_contract(jit_fn, args: Sequence[Any],
-                     mesh: Any = None) -> Dict[str, Any]:
+                     mesh: Any = None,
+                     want_s8: bool = False) -> Dict[str, Any]:
     """Lower + compile ``jit_fn(*args)`` and extract its contract dict
-    (the compared section only; callers add replay/state fields)."""
+    (the compared section only; callers add replay/state fields).
+    ``want_s8``: also pin :func:`s8_collective_count` from the SAME
+    compile (the compressed-overlap programs; opt-in so pre-existing
+    goldens keep their key set byte-identical)."""
     import contextlib
 
     ctx = mesh if mesh is not None else contextlib.nullcontext()
@@ -94,13 +114,17 @@ def extract_contract(jit_fn, args: Sequence[Any],
         lowered = jit_fn.lower(*args)
         compiled = lowered.compile()
     cost = _cost_dict(compiled)
-    return {
-        "collectives": collective_counts(compiled.as_text()),
+    hlo = compiled.as_text()
+    out = {
+        "collectives": collective_counts(hlo),
         "flops": float(cost.get("flops", 0.0)),
         "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
         "donated_inputs": donated_input_count(lowered.as_text()),
         "arg_shapes": shape_signature_strings(*args),
     }
+    if want_s8:
+        out["s8_collectives"] = s8_collective_count(hlo)
+    return out
 
 
 # ------------------------------------------------- representative programs
@@ -379,14 +403,20 @@ def _moe_dispatch_program() -> Dict[str, Any]:
             "mesh": topo.mesh, "extras": {}, "replay": None}
 
 
-def _train_overlap_program(stage: int, prefetch: bool = False
-                           ) -> Dict[str, Any]:
+def _train_overlap_program(stage: int, prefetch: bool = False,
+                           compressed: bool = False) -> Dict[str, Any]:
     """Fused train step with the compute/collective overlap wrap
     (runtime/zero/overlap.py) on a tiny SCANNED llama — the MLP spec has
     no layer scan, and the overlap contract exists precisely to pin the
     in-loop collective structure (bucketed grad reduce; stage 3: explicit
     prefetched gathers + reduce-scatters).  Replay is pinned at 0
-    recompiles: the wrap must not introduce shape-signature churn."""
+    recompiles: the wrap must not introduce shape-signature churn.
+
+    ``compressed``: the compressed-overlap variant (docs/COMM.md
+    "Compressed overlap") — stage 1 via ``zero_quantized_gradients``
+    (the qgZ compose), stage 3 via ``overlap_compression`` — which
+    additionally pins the s8-on-wire collective count and the donated
+    EF-residual state bytes."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -398,6 +428,11 @@ def _train_overlap_program(stage: int, prefetch: bool = False
     zero_cfg: Dict[str, Any] = {"stage": stage, "overlap_grad_reduce": True}
     if prefetch:
         zero_cfg["zero3_param_prefetch"] = True
+    if compressed:
+        if stage <= 2:
+            zero_cfg["zero_quantized_gradients"] = True
+        else:
+            zero_cfg["overlap_compression"] = "int8"
     model = llama_model("tiny", max_seq_len=16, vocab_size=64, n_layers=2,
                         attn_impl="xla")
     engine, *_ = deepspeed_tpu.initialize(model=model, config={
@@ -417,8 +452,15 @@ def _train_overlap_program(stage: int, prefetch: bool = False
     if report is not None:
         extras["overlap_buckets"] = int(report.buckets)
         extras["overlapped_fraction"] = round(report.overlapped_fraction, 6)
+    if compressed:
+        # s8_collectives itself is pinned by extract_contract (want_s8)
+        # from the ONE compile — no second lowering here
+        extras["comm_residual_bytes"] = sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(engine.state.comm_errors))
     return {"fn": engine._train_batch, "args": args,
             "mesh": engine.topology.mesh, "extras": extras,
+            "want_s8": compressed,
             "replay": lambda: _replay_train(engine, batch)}
 
 
@@ -459,6 +501,20 @@ PROGRAM_BUILDERS: Dict[str, Tuple[Callable[[], Dict[str, Any]], str]] = {
         "(tiny scanned llama; explicit in-loop param all-gathers, "
         "2x-unrolled double buffer, per-layer reduce-scatter in the "
         "backward loop)"),
+    "train_step_zero1_overlap_int8": (
+        lambda: _train_overlap_program(1, compressed=True),
+        "fused train step, ZeRO stage 1 + COMPRESSED overlap "
+        "(zero_quantized_gradients composed with overlap_grad_reduce: "
+        "per-layer-bucket int8 two-hop grad reduce inside the backward "
+        "scan, ONE error-feedback residual per bucket in train state; "
+        "pins s8-on-wire collective count, bucket count, donated "
+        "residual bytes, replay recompiles == 0)"),
+    "train_step_zero3_prefetch_int8": (
+        lambda: _train_overlap_program(3, prefetch=True, compressed=True),
+        "fused train step, ZeRO stage 3 + overlap + prefetch + "
+        "overlap_compression=int8 (per-layer QUANTIZED reduce-scatters "
+        "in the backward loop with per-bucket EF residuals; fp param "
+        "gathers untouched)"),
     "moe_dispatch_quantized": (
         _moe_dispatch_program,
         "expert-parallel dropless MoE dispatch with int8-quantized "
@@ -486,7 +542,8 @@ def extract_program(name: str) -> Dict[str, Any]:
 
     builder, description = PROGRAM_BUILDERS[name]
     prog = builder()
-    contract = extract_contract(prog["fn"], prog["args"], prog["mesh"])
+    contract = extract_contract(prog["fn"], prog["args"], prog["mesh"],
+                                want_s8=prog.get("want_s8", False))
     contract.update(prog["extras"])
     if prog["replay"] is not None:
         contract["replay"] = prog["replay"]()
@@ -551,7 +608,8 @@ def diff_contract(name: str, golden: Dict[str, Any],
                     f"{g.get('arg_shapes')} -> {n.get('arg_shapes')} "
                     "(every caller recompiles)")
     for field in ("state_bytes_device", "state_bytes_host", "param_bytes",
-                  "kv_pool_bytes", "overlap_buckets", "overlapped_fraction"):
+                  "kv_pool_bytes", "overlap_buckets", "overlapped_fraction",
+                  "s8_collectives", "comm_residual_bytes"):
         if field in g or field in n:
             a, b = g.get(field), n.get(field)
             if a != b:

@@ -49,12 +49,13 @@ jobs at runtime but are perfectly visible at review time:
 ``grad-overlap``
     Regression guard for the compute/collective overlap structure
     (runtime/zero/overlap.py, docs/COMM.md "Overlap & scheduling"): the
-    explicit gradient reducers must route their leaves through the
-    shared bucketer, and the transformer forward must keep its overlap
-    hook point.  A refactor that quietly reverts to a monolithic
-    post-backward grad reduce — per-leaf collectives after the whole
-    backward, nothing overlapped — fails this rule by name instead of
-    silently regressing MFU.
+    explicit gradient reducers — including the COMPRESSED in-loop
+    bucket reducer of the overlap hook (docs/COMM.md "Compressed
+    overlap") — must route their leaves through the shared bucketer,
+    and the transformer forward must keep its overlap hook point.  A
+    refactor that quietly reverts to a monolithic post-backward (or
+    per-leaf in-loop quantized) grad reduce fails this rule by name
+    instead of silently regressing MFU.
 
 Suppression: every rule honors an inline allowlist comment on the
 violation line or the line above::
@@ -442,6 +443,13 @@ _GRAD_OVERLAP_CONTRACTS: Dict[str, Tuple[str, Set[str], str]] = {
         "the transformer forward lost its overlap hook point "
         "(OverlapPlan.wrap_block) — the ZeRO grad reduce falls back to "
         "one monolithic post-backward block"),
+    os.path.join("deepspeed_tpu", "runtime", "zero", "overlap.py"): (
+        "_compressed_bucket_reduce",
+        {"bucketed_map", "assign_buckets", "coalesce_flat"},
+        "the compressed in-loop bucket reducer no longer routes leaves "
+        "through the shared bucketer (comm/collectives/bucketer.py) — a "
+        "monolithic per-leaf quantized reduce reappeared inside the "
+        "overlap hook"),
 }
 
 

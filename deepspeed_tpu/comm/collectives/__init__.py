@@ -28,13 +28,14 @@ from .bucketer import (assign_buckets, bucketed_map, coalesce_flat,
 from .codec import (CompressionSpec, compensate, dequantize_blockwise,
                     init_error, logical_bytes, qdq, quantize_blockwise,
                     wire_bytes)
-from .compressed import bucketed_all_reduce
+from .compressed import all_to_all_ef, bucketed_all_reduce, ppermute_ef
 from .hierarchical import hier_all_reduce, hierarchical_grad_reduce
 
 __all__ = [
-    "CompressionSpec", "assign_buckets", "bucketed_all_reduce", "bucketer",
+    "CompressionSpec", "all_to_all_ef", "assign_buckets",
+    "bucketed_all_reduce", "bucketer",
     "bucketed_map", "coalesce_flat", "compensate", "compressed", "dequantize_blockwise",
     "hier_all_reduce", "hierarchical", "hierarchical_grad_reduce",
-    "init_error", "logical_bytes", "qdq", "quantize_blockwise",
-    "split_flat", "wire_bytes",
+    "init_error", "logical_bytes", "ppermute_ef", "qdq",
+    "quantize_blockwise", "split_flat", "wire_bytes",
 ]

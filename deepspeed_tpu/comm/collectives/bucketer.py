@@ -64,7 +64,8 @@ def leaf_bytes(leaf: Any) -> int:
     return int(size) * int(itemsize)
 
 
-def coalesce_flat(leaves: Sequence[Any]) -> Tuple[Any, List[Tuple[int, Tuple[int, ...]]]]:
+def coalesce_flat(leaves: Sequence[Any], align: int = 0
+                  ) -> Tuple[Any, List[Tuple[int, Tuple[int, ...]]]]:
     """Concatenate raveled array leaves into one flat fp32 payload.
 
     Returns ``(flat, layout)`` where ``layout`` is the per-leaf
@@ -72,6 +73,14 @@ def coalesce_flat(leaves: Sequence[Any]) -> Tuple[Any, List[Tuple[int, Tuple[int
     fp32: the callers are gradient reducers whose accumulation dtype is
     fp32 anyway, and mixing dtypes in one payload would make the codec
     block scale meaningless.
+
+    ``align`` (compressed callers: the codec block size): zero-pad each
+    leaf up to a multiple of ``align`` so no codec block ever spans a
+    leaf boundary — the quantization scales of a coalesced payload then
+    match the per-leaf payloads exactly, which is what makes
+    bucketed == unbucketed BIT-EXACT under a fixed compression setting
+    (docs/COMM.md "Compressed overlap").  0 = dense concat (the exact
+    fp reducers, where reassociation is the only concern).
     """
     import jax.numpy as jnp
 
@@ -82,8 +91,12 @@ def coalesce_flat(leaves: Sequence[Any]) -> Tuple[Any, List[Tuple[int, Tuple[int
         shape = tuple(leaf.shape)
         n = int(leaf.size)
         layout.append((off, shape))
-        parts.append(jnp.ravel(leaf).astype(jnp.float32))
-        off += n
+        flat = jnp.ravel(leaf).astype(jnp.float32)
+        pad = (-n) % align if align > 0 else 0
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        parts.append(flat)
+        off += n + pad
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0], layout
 
 
@@ -101,7 +114,7 @@ def split_flat(flat: Any, layout: Sequence[Tuple[int, Tuple[int, ...]]],
 
 def bucketed_map(leaves: Sequence[Any], bucket_bytes: int, fn,
                  out_dtype: Any = None,
-                 buckets: Any = None) -> List[Any]:
+                 buckets: Any = None, align: int = 0) -> List[Any]:
     """The one coalesce -> reduce -> split pipeline every bucketed
     reducer shares: assign ``leaves`` to buckets, concatenate each
     bucket's raveled leaves into one flat fp32 payload, call
@@ -112,14 +125,16 @@ def bucketed_map(leaves: Sequence[Any], bucket_bytes: int, fn,
     ``buckets``: a precomputed :func:`assign_buckets` result (callers
     that validate against the bucket structure first); None assigns
     here.  Per-bucket side state (e.g. error-feedback residuals) rides
-    ``fn``'s closure, keyed by the bucket index it receives."""
+    ``fn``'s closure, keyed by the bucket index it receives.
+    ``align``: see :func:`coalesce_flat` (compressed callers pass the
+    codec block so bucketing stays bit-exact)."""
     leaves = list(leaves)
     if buckets is None:
         buckets = assign_buckets([leaf_bytes(l) for l in leaves],
                                  bucket_bytes)
     out: List[Any] = [None] * len(leaves)
     for k, idxs in enumerate(buckets):
-        flat, layout = coalesce_flat([leaves[i] for i in idxs])
+        flat, layout = coalesce_flat([leaves[i] for i in idxs], align=align)
         red = fn(flat, k)
         dtypes = [out_dtype if out_dtype is not None else leaves[i].dtype
                   for i in idxs]

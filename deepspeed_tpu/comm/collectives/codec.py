@@ -58,6 +58,12 @@ class CompressionSpec:
     #: carry a caller-owned residual: the compressed verbs then take and
     #: return an ``error`` buffer alongside the result
     error_feedback: bool = False
+    #: differentiated verbs (``all_to_all``, ``ppermute``): also quantize
+    #: the BACKWARD exchange — the custom_vjp applies the codec to the
+    #: transposed permute/a2a instead of moving the exact cotangent.
+    #: Off by default (the PR-11 straight-through contract); callers that
+    #: turn it on can carry a residual slot via the ``error=`` variants.
+    compress_backward: bool = False
 
     def __post_init__(self):
         if self.format not in _FORMATS:

@@ -97,7 +97,8 @@ def _two_hop_flat(comp: jnp.ndarray, op: str, axis, spec: CompressionSpec,
 
 def all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
                spec: CompressionSpec = CompressionSpec(),
-               error: Optional[jnp.ndarray] = None, out_dtype=None
+               error: Optional[jnp.ndarray] = None, out_dtype=None,
+               hop2_ef: bool = True
                ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Compressed all-reduce over a named mesh axis.
 
@@ -113,6 +114,14 @@ def all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
     owner — rank r quantized the reduced slot r everyone receives, so r
     reinjects that slot's dropped mass into its own next-step payload
     (scaled by ``world`` under mean, whose 1/world then cancels it).
+
+    ``hop2_ef=False`` keeps only the LOCAL hop-1 residual.  The hop-2
+    reinjection is slot-OWNER-local — which rank carries a position's
+    dropped mass depends on the payload's slot layout, and quantization
+    is nonlinear in who carries it — so a caller whose contract is
+    "bucketed == unbucketed bit-exact" (the compressed overlap hook,
+    runtime/zero/overlap.py) must use the layout-stable hop-1-only
+    residual; hop 2 runs straight-through there.
     """
     world = _axis_world(axis)
     if not spec.error_feedback:
@@ -124,6 +133,8 @@ def all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
     comp = compensate(tensor, error)
     reduced, sent, hop2_delta = _two_hop_flat(comp, op, axis, spec, world,
                                               out_dtype)
+    if not hop2_ef:
+        return reduced, comp - sent
     n = comp.size
     slot = hop2_delta.shape[0]
     r = lax.axis_index(axis)
@@ -186,13 +197,26 @@ def bucketed_all_reduce(leaves: Sequence[jnp.ndarray], op: str = "sum",
 # ----------------------------------------------------------- reduce_scatter
 def reduce_scatter(tensor: jnp.ndarray, op: str = "sum", axis="data",
                    spec: CompressionSpec = CompressionSpec(),
-                   scatter_dim: int = 0, out_dtype=None) -> jnp.ndarray:
+                   scatter_dim: int = 0, out_dtype=None,
+                   error: Optional[jnp.ndarray] = None
+                   ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """Compressed reduce-scatter: one all_to_all whose slot layout IS the
     target sharding (reference all_to_all_quant_reduce returns the
     scattered partition; no gather back).  Rank r keeps its shard of the
-    reduction along ``scatter_dim``.  ``out_dtype``: see ``all_reduce``."""
+    reduction along ``scatter_dim``.  ``out_dtype``: see ``all_reduce``.
+
+    Error feedback (``spec.error_feedback``): compensates the FULL local
+    payload with the carried residual and returns ``(scattered,
+    new_error)`` — the residual is full-tensor-shaped per rank (the
+    quantization error of what this rank sent), caller-owned like the
+    all_reduce residual.  The reduction is single-hop, so one residual
+    covers the whole wire."""
     world = _axis_world(axis)
-    gm = jnp.moveaxis(tensor, scatter_dim, 0)
+    if spec.error_feedback and error is None:
+        error = jnp.zeros(tensor.shape, jnp.float32)
+    comp = (compensate(tensor.astype(jnp.float32), error)
+            if spec.error_feedback else tensor)
+    gm = jnp.moveaxis(comp, scatter_dim, 0)
     if gm.shape[0] % world:
         raise ValueError(
             f"compressed reduce_scatter: dim {scatter_dim} size "
@@ -206,8 +230,15 @@ def reduce_scatter(tensor: jnp.ndarray, op: str = "sum", axis="data",
     s_r = lax.all_to_all(s, axis, split_axis=0, concat_axis=0)
     partials = dequantize_blockwise(q_r, s_r, d, jnp.float32)
     reduced = _sum_partials(partials, op)
-    return jnp.moveaxis(reduced.reshape(shard, *rest), 0,
-                        scatter_dim).astype(out_dtype or tensor.dtype)
+    out = jnp.moveaxis(reduced.reshape(shard, *rest), 0,
+                       scatter_dim).astype(out_dtype or tensor.dtype)
+    if not spec.error_feedback:
+        return out
+    sent = dequantize_blockwise(q, s, d, jnp.float32)
+    new_error = jnp.moveaxis(
+        (chunks.astype(jnp.float32) - sent).reshape(world * shard, *rest),
+        0, scatter_dim)
+    return out, new_error
 
 
 # --------------------------------------------------------------- all_gather
@@ -260,7 +291,11 @@ def all_to_all(tensor: jnp.ndarray, axis="sequence",
 
     Straight-through backward: the cotangent rides the TRANSPOSED exact
     all-to-all (split/concat swapped) at full precision — see
-    ``ppermute`` for the rationale."""
+    ``ppermute`` for the rationale.  With ``spec.compress_backward`` the
+    cotangent exchange is ALSO quantized (codes + scales on the
+    transposed layout): the backward wire volume matches the forward's,
+    closing the "fwd-only" gap for MoE dispatch.  For a caller-owned
+    residual on that backward exchange, use :func:`all_to_all_ef`."""
     return _all_to_all_impl(tensor, axis, spec, split_dim, concat_dim, tiled)
 
 
@@ -270,11 +305,59 @@ def _all_to_all_fwd(tensor, axis, spec, split_dim, concat_dim, tiled):
 
 
 def _all_to_all_bwd(axis, spec, split_dim, concat_dim, tiled, _res, ct):
+    if spec.compress_backward:
+        return (_all_to_all_impl(ct, axis, spec, concat_dim, split_dim,
+                                 tiled),)
     return (lax.all_to_all(ct, axis, split_axis=concat_dim,
                            concat_axis=split_dim, tiled=tiled),)
 
 
 all_to_all.defvjp(_all_to_all_fwd, _all_to_all_bwd)
+
+
+# ------------------------------------------------- residual-slot variants
+#
+# The compress_backward path above is straight-through: the backward
+# quantization error is dropped.  These variants give the BACKWARD
+# exchange its own error-feedback residual slot: the residual enters as
+# a differentiable input and its *cotangent* carries the NEW residual
+# out — so a caller that differentiates w.r.t. (inputs, residual) gets
+# the updated buffer exactly where train state expects it (the same
+# cotangent-channel contract the overlap hook uses for its in-loop
+# residuals; runtime/zero/overlap.py).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def all_to_all_ef(tensor: jnp.ndarray, error: jnp.ndarray, axis="sequence",
+                  spec: CompressionSpec = CompressionSpec(),
+                  split_dim: int = 0, concat_dim: int = 0,
+                  tiled: bool = True) -> jnp.ndarray:
+    """Compressed all-to-all whose BACKWARD exchange is quantized with
+    error feedback.  ``error``: the carried residual (cotangent shape =
+    ``tensor`` shape, fp32); its cotangent out of ``jax.grad`` is the
+    new residual to carry."""
+    return _all_to_all_impl(tensor, axis, spec, split_dim, concat_dim, tiled)
+
+
+def _a2a_ef_fwd(tensor, error, axis, spec, split_dim, concat_dim, tiled):
+    out = _all_to_all_impl(tensor, axis, spec, split_dim, concat_dim, tiled)
+    return out, (error,)
+
+
+def _a2a_ef_bwd(axis, spec, split_dim, concat_dim, tiled, res, ct):
+    (error,) = res
+    comp = compensate(ct.astype(jnp.float32), error)
+    q, s, d = quantize_blockwise(comp, spec)
+    _log("all_to_all", comp, axis, wire_bytes(q, s))
+    q_r = lax.all_to_all(q, axis, split_axis=concat_dim,
+                         concat_axis=split_dim, tiled=tiled)
+    s_r = lax.all_to_all(s, axis, split_axis=concat_dim,
+                         concat_axis=split_dim, tiled=tiled)
+    ct_out = dequantize_blockwise(q_r, s_r, d, ct.dtype)
+    sent = dequantize_blockwise(q, s, d, jnp.float32)
+    return ct_out, (comp - sent).astype(error.dtype)
+
+
+all_to_all_ef.defvjp(_a2a_ef_fwd, _a2a_ef_bwd)
 
 
 # ----------------------------------------------------------------- ppermute
@@ -295,7 +378,9 @@ def ppermute(tensor: jnp.ndarray, perm, axis,
     Straight-through backward: the cotangent rides the INVERSE permutation
     at full precision — quantizing gradients again would compound error
     across ring hops, and the K/V forward volume is where the wire savings
-    live."""
+    live.  ``spec.compress_backward`` opts the backward rotation into the
+    codec anyway (the compounding trade is the caller's, e.g. long rings
+    over slow links); :func:`ppermute_ef` adds a residual slot."""
     return _ppermute_impl(tensor, perm, axis, spec)
 
 
@@ -305,7 +390,38 @@ def _ppermute_fwd(tensor, perm, axis, spec):
 
 def _ppermute_bwd(perm, axis, spec, _res, ct):
     inv = tuple((dst, src) for src, dst in perm)
+    if spec.compress_backward:
+        return (_ppermute_impl(ct, inv, axis, spec),)
     return (lax.ppermute(ct, axis, inv),)
 
 
 ppermute.defvjp(_ppermute_fwd, _ppermute_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def ppermute_ef(tensor: jnp.ndarray, error: jnp.ndarray, perm, axis,
+                spec: CompressionSpec = CompressionSpec()) -> jnp.ndarray:
+    """Compressed ring shift whose BACKWARD rotation is quantized with
+    error feedback — ``error``'s cotangent carries the new residual (see
+    :func:`all_to_all_ef`)."""
+    return _ppermute_impl(tensor, perm, axis, spec)
+
+
+def _ppermute_ef_fwd(tensor, error, perm, axis, spec):
+    return _ppermute_impl(tensor, perm, axis, spec), (error,)
+
+
+def _ppermute_ef_bwd(perm, axis, spec, res, ct):
+    (error,) = res
+    inv = tuple((dst, src) for src, dst in perm)
+    comp = compensate(ct.astype(jnp.float32), error)
+    q, s, d = quantize_blockwise(comp, spec)
+    _log("ppermute", comp, axis, wire_bytes(q, s))
+    q_r = lax.ppermute(q, axis, inv)
+    s_r = lax.ppermute(s, axis, inv)
+    ct_out = dequantize_blockwise(q_r, s_r, d, ct.dtype)
+    sent = dequantize_blockwise(q, s, d, jnp.float32)
+    return ct_out, (comp - sent).astype(error.dtype)
+
+
+ppermute_ef.defvjp(_ppermute_ef_fwd, _ppermute_ef_bwd)

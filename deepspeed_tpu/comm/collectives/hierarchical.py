@@ -38,16 +38,26 @@ from .compressed import _axis_world, _log
 
 def hier_all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
                     inner: Optional[int] = None,
-                    spec: Optional[CompressionSpec] = None) -> jnp.ndarray:
+                    spec: Optional[CompressionSpec] = None,
+                    error: Optional[jnp.ndarray] = None):
     """Two-hop all-reduce over ``axis`` (see module docstring).
 
     ``inner``: intra-slice group size (None = auto via hierarchy_split).
     ``spec``: codec for the inter-slice hop (None = full precision).
-    """
+
+    Error feedback (``spec.error_feedback``): the residual covers the
+    ONE lossy point — this rank's hop-2 quantization of its reduced
+    slot.  The dropped mass re-enters this rank's next payload at its
+    own slot positions, so the next hop-1 reduce-scatter routes it back
+    to exactly the slot it was dropped from (no world-gain needed under
+    either op: the reinjection rides the same scaling path).  Returns
+    ``(reduced, new_error)`` with ``error`` shaped like ``tensor``
+    (fp32, caller-owned — thread it through train state)."""
     world = _axis_world(axis)
     inner, outer = hierarchy_split(world, inner)
     ig = inner_groups(world, inner)
     og = outer_groups(world, inner)
+    ef = spec is not None and spec.error_feedback
 
     n = tensor.size
     slot = -(-n // inner)
@@ -55,6 +65,10 @@ def hier_all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
         slot = -(-slot // spec.block) * spec.block
     pad = slot * inner - n
     flat = tensor.reshape(-1).astype(jnp.float32)
+    if ef:
+        if error is None:
+            error = jnp.zeros(tensor.shape, jnp.float32)
+        flat = flat + error.reshape(-1).astype(jnp.float32)
     if pad:
         flat = jnp.pad(flat, (0, pad))
 
@@ -67,6 +81,7 @@ def hier_all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
 
     # hop 2: inter-slice exchange — gather every slice's partial of this
     # slot, reduce locally; the only bytes that cross slices
+    hop2_delta = None
     if spec is not None:
         q, s, _ = quantize_blockwise(part, spec)
         _log("all_gather", part, axis, wire_bytes(q, s))
@@ -75,6 +90,8 @@ def hier_all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
         s_g = lax.all_gather(s, axis, axis_index_groups=og, axis=0,
                              tiled=False)
         partials = dequantize_blockwise(q_g, s_g, slot, jnp.float32)
+        if ef:
+            hop2_delta = part - dequantize_blockwise(q, s, slot, jnp.float32)
     else:
         _log("all_gather", part, axis, None)
         partials = lax.all_gather(part, axis, axis_index_groups=og, axis=0,
@@ -91,14 +108,24 @@ def hier_all_reduce(tensor: jnp.ndarray, op: str = "sum", axis="data",
         out = out / world
     elif op not in ("sum", "SUM"):
         raise ValueError(f"Unsupported hierarchical reduce op {op}")
-    return out.astype(tensor.dtype)
+    out = out.astype(tensor.dtype)
+    if not ef:
+        return out
+    # this rank's slot offset in the flat payload = its position within
+    # its contiguous inner group (inner_groups layout: rank s*inner+i
+    # holds slot i of slice s)
+    gp = lax.axis_index(axis) % inner
+    new_error = lax.dynamic_update_slice(
+        jnp.zeros((slot * inner,), jnp.float32), hop2_delta, (gp * slot,))
+    return out, new_error[:n].reshape(tensor.shape)
 
 
 def hierarchical_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
                              axis: Optional[str] = None,
                              inner: Optional[int] = None,
                              compression: Optional[CompressionSpec] = None,
-                             bucket_bytes: int = 0) -> Any:
+                             bucket_bytes: int = 0,
+                             errors: Optional[Any] = None) -> Any:
     """Hierarchical mean-reduce of vmap-chunked gradients (leading dim =
     ``axis`` chunks) — the two-hop sibling of
     ``runtime/zero/zeropp.quantized_grad_reduce``, sharing its chunked
@@ -110,6 +137,13 @@ def hierarchical_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     (``comm/collectives/bucketer.py``) — one three-hop chain per bucket
     instead of per leaf, so small leaves stop paying full hop latency
     each and the independent per-bucket chains overlap.
+
+    ``errors`` (with ``compression.error_feedback``): per-BUCKET
+    residuals from the previous step — a sequence of global ``[W, S_k]``
+    fp32 arrays (axis-sharded: each rank stores its own compensation,
+    ``engine.state.comm_errors`` carries them across steps/checkpoints).
+    Returns ``(grads, new_errors)`` then; with ``errors=None`` the
+    legacy single-value return and exact payload layout are unchanged.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -122,16 +156,38 @@ def hierarchical_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     inner, _ = hierarchy_split(world, inner)
     flat_chunk, treedef = jax.tree_util.tree_flatten(chunk_specs)
     grads_flat = treedef.flatten_up_to(grads_chunked)
+    ef = (errors is not None and compression is not None
+          and compression.error_feedback)
+    errors = list(errors) if ef else []
+    n_leaves = len(flat_chunk)
 
-    def body(flat_tree):
-        return tuple(bucketed_map(
-            [g[0] for g in flat_tree], bucket_bytes,
-            lambda flat, _k: hier_all_reduce(flat, op="mean", axis=axis,
-                                             inner=inner, spec=compression),
-            out_dtype=jnp.float32))
+    def body(flat_tree, errs):
+        new_errs = []
 
-    out_specs = tuple(P(*tuple(c)[1:]) for c in flat_chunk)
-    fn = shard_map(body, mesh=mesh, in_specs=(tuple(flat_chunk),),
+        def reduce_bucket(flat, k):
+            if not ef:
+                return hier_all_reduce(flat, op="mean", axis=axis,
+                                       inner=inner, spec=compression)
+            red, ne = hier_all_reduce(flat, op="mean", axis=axis,
+                                      inner=inner, spec=compression,
+                                      error=errs[k][0])
+            new_errs.append(ne[None])
+            return red
+
+        outs = tuple(bucketed_map(
+            [g[0] for g in flat_tree], bucket_bytes, reduce_bucket,
+            out_dtype=jnp.float32,
+            align=(compression.block if ef else 0)))
+        return outs + tuple(new_errs)
+
+    out_specs = tuple(P(*tuple(c)[1:]) for c in flat_chunk) \
+        + tuple(P(axis) for _ in errors)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(tuple(flat_chunk),
+                             tuple(P(axis) for _ in errors)),
                    out_specs=out_specs, check_vma=False)
-    out_flat = fn(tuple(grads_flat))
-    return jax.tree_util.tree_unflatten(treedef, out_flat)
+    out_flat = fn(tuple(grads_flat), tuple(errors))
+    grads = jax.tree_util.tree_unflatten(treedef, out_flat[:n_leaves])
+    if not ef:
+        return grads
+    return grads, list(out_flat[n_leaves:])

@@ -603,8 +603,16 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
     attn_fn = _pick_attn(cfg)
 
     plan = getattr(cfg, "overlap_plan", None)
+    # compressed-overlap comm state (runtime/zero/overlap.py): the engine
+    # injects per-bucket gslot/eslot stacks under this params key; they
+    # ride the layer scan as extra xs so each trip sees its layer's
+    # slices.  Absent (eval / exact overlap) the wrap runs comm-free.
+    comm_state = (params.get("_overlap_comm")
+                  if isinstance(params, dict) else None)
+    if plan is None or getattr(plan, "compression", None) is None:
+        comm_state = None
     if plan is None:
-        block = lambda x, layer: _block(cfg, x, layer, positions, mask, attn_fn)  # noqa: E731
+        block = lambda x, layer, comm_s=None: _block(cfg, x, layer, positions, mask, attn_fn)  # noqa: E731
     else:
         # ZeRO overlap wrap (runtime/zero/overlap.py): the block runs in
         # a shard_map over the data axis, where each layer-bucket's grad
@@ -614,16 +622,12 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
         wrapped = plan.wrap_block(
             lambda x, pos, m, layer: _block(cfg, x, layer, pos, m, attn_fn),
             has_mask=mask is not None)
-        block = lambda x, layer: wrapped(x, positions, mask, layer)  # noqa: E731
+        block = lambda x, layer, comm_s=None: wrapped(x, positions, mask, layer, comm_s)  # noqa: E731
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         block = jax.checkpoint(block, policy=policy)
 
     if cfg.scan_layers:
-        def scan_body(carry, layer):
-            y, aux = block(carry, layer)
-            return y, aux
-
         # stage-3 manual prefetch (zero3_prefetch, engine-set per trace):
         # unroll the layer scan 2x so each trip holds TWO independent
         # gather->compute chains — layer i+1's param all-gather has no
@@ -632,14 +636,33 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
         # iterations (tried: the carry becomes a bwd residual and
         # materializes EVERY gathered layer, defeating stage 3), unroll
         # keeps residuals sharded and per-layer — same memory, real slack.
-        x, auxs = jax.lax.scan(scan_body, x, params["layers"],
-                               unroll=2 if cfg.zero3_prefetch else 1)
+        unroll = 2 if cfg.zero3_prefetch else 1
+        if comm_state is not None:
+            def scan_body(carry, xs):
+                layer, comm_s = xs
+                y, aux = block(carry, layer, comm_s)
+                return y, aux
+
+            x, auxs = jax.lax.scan(scan_body, x,
+                                   (params["layers"], comm_state),
+                                   unroll=unroll)
+        else:
+            def scan_body(carry, layer):
+                y, aux = block(carry, layer)
+                return y, aux
+
+            x, auxs = jax.lax.scan(scan_body, x, params["layers"],
+                                   unroll=unroll)
         aux = jnp.sum(auxs)
     else:
         aux = jnp.asarray(0.0, jnp.float32)
         for i in range(cfg.n_layers):
             layer = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
-            x, a = block(x, layer)
+            if comm_state is not None:
+                comm_s = jax.tree_util.tree_map(lambda a: a[i], comm_state)
+                x, a = block(x, layer, comm_s)
+            else:
+                x, a = block(x, layer)
             aux = aux + a
 
     if cfg.post_norm:

@@ -140,10 +140,24 @@ class ZeroConfig(ConfigModel):
     #: (runtime/zero/overlap.py custom_vjp hook; Domino-style — the
     #: collective rides the dataflow graph, no post-backward block).
     #: Scheduling only: bit-exact with the unbucketed path, A/B'd by
-    #: ``bench.py --ab-overlap``.  Needs a models/* transformer; under
-    #: qgZ / hierarchical reduce the overlap instead rides the bucketed
-    #: explicit reducers (see overlap_bucket_mb).
+    #: ``bench.py --ab-overlap``.  Needs a models/* transformer.  With
+    #: qgZ (or ``overlap_compression``) also set, the in-loop exchange
+    #: itself compresses — docs/COMM.md "Compressed overlap"; with
+    #: ``overlap_compression: false`` the wrap stands down under qgZ /
+    #: hierarchical and those bucketed explicit reducers own the
+    #: exchange (see overlap_bucket_mb).
     overlap_grad_reduce: bool = False
+    #: compress the IN-LOOP bucketed gradient exchange (docs/COMM.md
+    #: "Compressed overlap"): None (default) derives it — int8 +
+    #: error feedback when ``zero_quantized_gradients`` is also on,
+    #: exact fp otherwise; "int8"/"fp8" or a CompressionSpec kwargs
+    #: dict forces a codec (error_feedback defaults ON for this path —
+    #: pass {"format": ..., "error_feedback": false} to drop the
+    #: residual); False forces the exact fp exchange even under qgZ
+    #: (the wrap then stands down and qgZ keeps its post-backward
+    #: bucketed reduce).  Residuals live in TrainState.comm_errors —
+    #: ONE per bucket — and survive checkpoint/preemption-resume.
+    overlap_compression: Any = None
     #: size target (MB) for the ONE shared bucketer
     #: (comm/collectives/bucketer.py): the overlap hook's per-layer
     #: reduce groups AND the leaf coalescing inside the explicit
@@ -164,6 +178,13 @@ class ZeroConfig(ConfigModel):
     #: intra-slice group size for that split (0 = auto:
     #: utils/groups.hierarchy_split — local device count, else ~sqrt)
     zero_hierarchy_inner: int = 0
+    #: error feedback on the POST-BACKWARD qgZ / hierarchical gradient
+    #: reduce (the path that runs when the in-loop overlap wrap is off
+    #: or unsupported): per-bucket residuals carried in
+    #: TrainState.comm_errors["reduce"], so checkpoint/resume keeps
+    #: them (docs/COMM.md).  Off by default — it changes the reduce's
+    #: numerics vs HEAD (convergence improves, bit-compat breaks).
+    grad_reduce_error_feedback: bool = False
     # MiCS-style replica-group sharding: shard within groups of this size,
     # replicate across groups (reference zero/mics.py).
     mics_shard_size: int = -1
@@ -179,6 +200,14 @@ class ZeroConfig(ConfigModel):
         if self.overlap_bucket_mb < 0:
             raise ValueError("zero_optimization.overlap_bucket_mb must be "
                              f">= 0, got {self.overlap_bucket_mb}")
+        if self.overlap_compression not in (None, False):
+            from ..comm.collectives.codec import CompressionSpec
+
+            try:
+                CompressionSpec.parse(self.overlap_compression)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"zero_optimization.overlap_compression: {e}") from e
 
     @classmethod
     def deprecated_fields(cls):

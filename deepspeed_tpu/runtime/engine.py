@@ -67,6 +67,14 @@ class TrainState:
     loss_scale: Optional[LossScaleState]
     skipped_steps: jnp.ndarray
     global_grad_norm: jnp.ndarray  # from the last boundary
+    #: compressed-collective error-feedback residuals, ONE leaf per
+    #: bucket, axis-sharded [.., W, S] (each rank's row is its own
+    #: compensation).  Carried here — not in a step-local dict — so
+    #: residuals survive donation, checkpoint and preemption-resume
+    #: bit-identically (docs/COMM.md "Compressed overlap").  Slots:
+    #: "overlap" (in-loop compressed overlap), "reduce" (post-backward
+    #: qgZ/hierarchical EF).  {} when no compressed path carries EF.
+    comm_errors: Any = dataclasses.field(default_factory=dict)
 
 
 class DeepSpeedTPUEngine:
@@ -215,6 +223,7 @@ class DeepSpeedTPUEngine:
 
         self.state = self._init_state()
         self._build_overlap_plan()
+        self._init_comm_errors()
         self._compile_steps()
         self._wire_memory_ledger()
         # ZeRO-Infinity param offload (reference offload_param config): the
@@ -328,6 +337,29 @@ class DeepSpeedTPUEngine:
                     "falling back to the "
                     + ("qgZ all-to-all reduce" if self._qgz
                        else "XLA fp reduce"))
+        # in-loop overlap compression (docs/COMM.md "Compressed overlap"):
+        # an explicit overlap_compression knob wins; with qgZ also on it
+        # defaults to the qgZ wire format + error feedback, so
+        # zero_quantized_gradients composes with overlap_grad_reduce
+        # instead of standing the wrap down.  False forces the exact wrap.
+        self._overlap_spec = None
+        raw = zc.overlap_compression
+        if raw not in (None, False):
+            from ..comm.collectives.codec import CompressionSpec
+
+            spec = CompressionSpec.parse(raw)
+            if not isinstance(raw, CompressionSpec) \
+                    and not (isinstance(raw, dict)
+                             and "error_feedback" in raw):
+                # EF is the default contract for this path; an explicit
+                # dict key or an already-built spec is the opt-out
+                spec = dataclasses.replace(spec, error_feedback=True)
+            self._overlap_spec = spec
+        elif raw is None and self._qgz:
+            from ..comm.collectives.codec import CompressionSpec
+
+            self._overlap_spec = CompressionSpec(format="int8",
+                                                 error_feedback=True)
 
     def _overlap_unsupported_reason(self) -> Optional[str]:
         """Why the overlap wrap cannot apply on this engine (None = ok).
@@ -352,10 +384,15 @@ class DeepSpeedTPUEngine:
                     f"(got {dict(others)})")
         if self.topology.axis_size(DATA_AXIS) <= 1:
             return "data axis is 1: there is no grad exchange to overlap"
-        if self._qgz or self._hier_inner:
+        if (self._qgz or self._hier_inner) and self._overlap_spec is None:
+            # reachable via overlap_compression=False, or hierarchical
+            # WITHOUT qgZ (full-precision hops: no in-loop codec derives;
+            # under qgZ the default spec composes the wrap instead —
+            # docs/COMM.md "Compressed overlap")
             return ("qgZ/hierarchical explicit reducers own the grad "
-                    "exchange (overlap there rides their bucketed "
-                    "collectives; see overlap_bucket_mb)")
+                    "exchange and no in-loop compression is resolved "
+                    "(set zero_quantized_gradients or overlap_compression "
+                    "to compose; overlap rides their bucketed collectives)")
         if self._qwz:
             return "zero_quantized_weights owns the stage-3 gathers"
         if getattr(mc, "moe_experts", 0):
@@ -386,6 +423,13 @@ class DeepSpeedTPUEngine:
         params = self.state.params
         has_layers = isinstance(params, dict) and "layers" in params
         reason = self._overlap_unsupported_reason() if wanted else None
+        if not wanted and self.config.zero_config.overlap_compression \
+                not in (None, False):
+            logger.warning(
+                "overlap_compression is set but the overlap wrap is not "
+                "requested (overlap_grad_reduce / zero3_param_prefetch "
+                "are off) — the in-loop exchange stays uncompressed; "
+                "enable overlap_grad_reduce to compose")
         if wanted and reason is not None:
             logger.warning(f"compute/collective overlap disabled: {reason}")
         if wanted and reason is None:
@@ -396,7 +440,9 @@ class DeepSpeedTPUEngine:
                 self.zero_plan, jax.eval_shape(lambda: params["layers"]),
                 bucket_bytes=int(zc.overlap_bucket_mb * 2**20),
                 axis=DATA_AXIS, stage=zc.stage,
-                grad_dtype=self.grad_accum_dtype)
+                grad_dtype=self.grad_accum_dtype,
+                compression=self._overlap_spec,
+                hier_inner=getattr(self, "_hier_inner", 0))
             if self._overlap_plan is not None:
                 from ..compile.backend import validate_latency_hiding_flags
 
@@ -415,13 +461,107 @@ class DeepSpeedTPUEngine:
         total_bytes = sum(
             l.size for l in jax.tree_util.tree_leaves(params)) * itemsize
         covered = layer_bytes if self._overlap_plan is not None else 0
+        plan = self._overlap_plan
+        comp = plan.compression if plan is not None else None
         self._overlap_struct = {
             "total_bytes": int(total_bytes),
             "overlapped_bytes": int(covered),
             "tail_bytes": int(total_bytes - covered),
-            "buckets": (len(self._overlap_plan.buckets)
-                        if self._overlap_plan is not None else 0),
+            "buckets": (len(plan.buckets) if plan is not None else 0),
+            "compression": (comp.format if comp is not None else None),
+            "residual_bytes": (plan.residual_bytes()
+                               if comp is not None else 0),
         }
+
+    def _init_comm_errors(self) -> None:
+        """Populate ``TrainState.comm_errors`` (docs/COMM.md "Compressed
+        overlap"): per-bucket error-feedback residual leaves for the
+        in-loop compressed overlap and/or the post-backward qgZ/hier EF
+        reduce.  Runs after the overlap plan is built and BEFORE step
+        compilation, so the state pytree the jitted programs donate is
+        fixed.  A checkpoint that predates the residuals restores them
+        as zeros with the loader's loud per-key warning (the documented
+        reset); a checkpoint that has them resumes bit-identically."""
+        errors = {}
+        plan = getattr(self, "_overlap_plan", None)
+        if plan is not None and plan.error_feedback:
+            errors["overlap"] = plan.init_errors()
+        reduce_errors = self._init_reduce_errors()
+        if reduce_errors:
+            errors["reduce"] = reduce_errors
+        if errors:
+            self.state = dataclasses.replace(self.state, comm_errors=errors)
+
+    def _init_reduce_errors(self):
+        """Residual layout for the POST-backward qgZ / hierarchical EF
+        path (``grad_reduce_error_feedback``): one ``[W, S_k]`` fp32
+        leaf per flat-path bucket, mirroring exactly the bucket
+        assignment ``quantized_grad_reduce`` / ``hierarchical_grad_reduce``
+        derive in-body (flatten order, compute-dtype byte sizes,
+        QBLOCK-aligned coalesce layout)."""
+        zc = self.config.zero_config
+        overlap_compressed = (
+            getattr(self, "_overlap_plan", None) is not None
+            and self._overlap_plan.compression is not None)
+        if (not zc.grad_reduce_error_feedback or overlap_compressed
+                or not (self._qgz or self._hier_inner)):
+            return {}
+        if self._hier_inner and not self._qgz:
+            # full-precision hierarchical hops have no lossy point —
+            # residual state would be dead fp32 HBM, never read
+            logger.warning(
+                "grad_reduce_error_feedback: the hierarchical reduce runs "
+                "full-precision hops without zero_quantized_gradients — "
+                "nothing to compensate; no residual state allocated")
+            return {}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..comm.collectives.bucketer import assign_buckets
+        from ..parallel.mesh import DATA_AXIS
+        from .zero.strategy import _path_str
+        from .zero.zeropp import QBLOCK, _scatter_dim
+
+        W = self.topology.axis_size(DATA_AXIS)
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.state.params)
+        itemsize = np.dtype(self.compute_dtype).itemsize
+        sizes, elems = [], []
+        for path, leaf in flat:
+            pstr = _path_str(path)
+            shape = tuple(leaf.shape)
+            pspec = self.zero_plan.param_spec(pstr, shape)
+            if self._hier_inner:
+                sd = -1  # hierarchical: every leaf rides the flat path
+            else:
+                cs = P(DATA_AXIS, *tuple(pspec))
+                sd = _scatter_dim(self.zero_plan.grad_spec(pstr, shape),
+                                  cs, DATA_AXIS)
+            if sd >= 0:
+                continue  # scattered path: single-hop, EF-free
+            # the in-body reducers see each leaf's TP-LOCAL block (the
+            # chunk specs carry the param's TP entries), so the residual
+            # layout must be sized from the local shard shape
+            local = []
+            for dim, entry in enumerate(shape):
+                axes = (tuple(pspec)[dim] if dim < len(tuple(pspec))
+                        else None)
+                axes = (tuple(axes) if isinstance(axes, (tuple, list))
+                        else (axes,) if axes is not None else ())
+                div = int(np.prod([self.topology.axis_size(a)
+                                   for a in axes]) or 1)
+                local.append(entry // div if div else entry)
+            n = int(np.prod(local) or 1)
+            sizes.append(n * itemsize)
+            elems.append(-(-n // QBLOCK) * QBLOCK)
+        if not sizes:
+            return {}
+        buckets = assign_buckets(
+            sizes, int(zc.overlap_bucket_mb * 2**20))
+        sh = NamedSharding(self.topology.mesh, P(DATA_AXIS))
+        return {
+            f"b{k:03d}": jax.device_put(
+                jnp.zeros((W, sum(elems[i] for i in idxs)), jnp.float32),
+                sh)
+            for k, idxs in enumerate(buckets)}
 
     # ------------------------------------------------------------------ init
     def _init_state(self) -> TrainState:
@@ -538,7 +678,9 @@ class DeepSpeedTPUEngine:
         return self.zero_plan.constrain(p, "param")
 
     def _micro_grads(self, state: TrainState, batch, rng, compute_params=None):
-        """One micro-batch's gradients (accum dtype, grad-sharded) + loss.
+        """One micro-batch's gradients (accum dtype, grad-sharded) + loss
+        + the updated compressed-collective EF residuals (None when no
+        compressed path carries error feedback on this trace).
 
         ``compute_params``: pre-cast compute-dtype params — the fused
         gas>1 scan casts the fp32 master ONCE outside the scan instead of
@@ -553,10 +695,43 @@ class DeepSpeedTPUEngine:
                 return loss.astype(jnp.float32) * state.loss_scale.cur_scale, loss
             return loss, loss
 
-        if self._qgz or self._hier_inner:
-            grads, loss = self._qgz_grads(scaled_loss_fn, compute_params, batch)
+        new_comm = None
+        plan = getattr(self, "_overlap_plan", None)
+        if plan is not None and plan.compression is not None:
+            # compressed overlap (docs/COMM.md "Compressed overlap"): the
+            # in-loop hook owns the layer-grad exchange.  The gslot/eslot
+            # channels enter as differentiable params-tree leaves; their
+            # "gradients" are the reduced buckets and the new residuals
+            # (the cotangent-channel contract, runtime/zero/overlap.py).
+            p2 = dict(compute_params)
+            p2["_overlap_comm"] = {"g": plan.grad_slots(),
+                                   "e": plan.eslot_state(state.comm_errors)}
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(p2)
+            grads = dict(grads)
+            comm_g = grads.pop("_overlap_comm")
+            grads["layers"] = plan.merge_comm_grads(grads["layers"],
+                                                    tuple(comm_g["g"]))
+            if plan.error_feedback:
+                new_comm = dict(state.comm_errors)
+                new_comm["overlap"] = comm_g["e"]
+        elif self._qgz or self._hier_inner:
+            grads, loss, new_comm = self._qgz_grads(
+                scaled_loss_fn, compute_params, batch, state.comm_errors)
+            if new_comm is not None:
+                new_comm = {**state.comm_errors, **new_comm}
         else:
             grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
+        if new_comm is not None and self.fp16_enabled:
+            # an fp16 overflow step must not poison the carried residuals:
+            # the backward's inf/nan rides the quantize (scale=inf -> NaN
+            # codes) into comp - sent, and the optimizer's overflow skip
+            # (_apply_step_body) never touches comm_errors — so gate the
+            # residual update on the same finiteness signal and keep the
+            # previous residuals on overflow steps
+            bad = check_overflow(grads)
+            new_comm = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(bad, o, n),
+                new_comm, state.comm_errors)
         grads = cast_tree(grads, self.grad_accum_dtype)
         grads = self.zero_plan.constrain(grads, "grad")
         if getattr(self, "_overlap_struct", None) is not None:
@@ -566,41 +741,54 @@ class DeepSpeedTPUEngine:
             from .zero.overlap import record_tail_reduce
 
             record_tail_reduce(self._overlap_struct["tail_bytes"])
-        return grads, loss
+        return grads, loss, new_comm
 
     def _micro_step_body(self, state: TrainState, batch, rng,
                          compute_params=None) -> Tuple[TrainState, jnp.ndarray]:
-        grads, loss = self._micro_grads(state, batch, rng,
-                                        compute_params=compute_params)
+        grads, loss, new_comm = self._micro_grads(
+            state, batch, rng, compute_params=compute_params)
         new_acc = jax.tree_util.tree_map(jnp.add, state.grad_acc, grads)
-        state = dataclasses.replace(state, grad_acc=new_acc,
-                                    micro_step=state.micro_step + 1)
+        state = dataclasses.replace(
+            state, grad_acc=new_acc, micro_step=state.micro_step + 1,
+            comm_errors=(new_comm if new_comm is not None
+                         else state.comm_errors))
         return state, loss.astype(jnp.float32)
 
-    def _qgz_grads(self, scaled_loss_fn, compute_params, batch):
+    def _qgz_grads(self, scaled_loss_fn, compute_params, batch,
+                   comm_errors=None):
         """Explicit compressed gradient reduce: compute PER-DATA-SHARD
         partial gradients (vmap over batch chunks — embarrassingly parallel,
         XLA inserts no gradient collective) and reduce them through
         ``comm/collectives``: either qgZ's int8 all-to-all (reference
         all_to_all_quant_reduce, runtime/comm/coalesced_collectives.py:31)
         or the hierarchical two-hop when ``zero_hierarchical_grad_reduce``
-        split the data axis (int8 inter-slice hop iff qgZ is also on)."""
+        split the data axis (int8 inter-slice hop iff qgZ is also on).
+
+        ``comm_errors``: with ``grad_reduce_error_feedback`` the per-bucket
+        residuals under the "reduce" key thread into the flat-path
+        reducers and the updated set returns as the third value (None
+        otherwise) — carried in train state so checkpoint/resume keeps
+        them (the EF lifecycle contract)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS
         from .zero.zeropp import quantized_grad_reduce
 
         W = self.topology.axis_size(DATA_AXIS)
+        ef_slot = (comm_errors or {}).get("reduce") or None
+        ef_keys = sorted(ef_slot) if ef_slot else []
         if isinstance(batch, dict) and batch.get("attention_mask") is not None:
             # mean-of-chunk-masked-means != global masked mean when valid
             # token counts differ across chunks; don't silently change the
             # objective — use the exact fp reduce for masked batches
+            # (residuals ride through unchanged for that step)
             from ..utils.logging import warning_once
 
             warning_once("qgZ: batch carries attention_mask — per-chunk "
                          "masked means would reweight the loss; falling back "
                          "to the fp gradient reduce for this step")
-            return jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
+            return grads, loss, None
 
         def chunk(x):
             if x.shape[0] % W != 0:
@@ -632,26 +820,39 @@ class DeepSpeedTPUEngine:
             from ..comm.collectives import (CompressionSpec,
                                             hierarchical_grad_reduce)
 
-            grads = hierarchical_grad_reduce(
+            spec = (CompressionSpec(format="int8",
+                                    error_feedback=bool(ef_keys))
+                    if self._qgz else None)
+            result = hierarchical_grad_reduce(
                 grads_c, chunk_specs, self.topology.mesh,
                 inner=self._hier_inner,
-                compression=CompressionSpec(format="int8")
-                if self._qgz else None,
+                compression=spec,
                 bucket_bytes=int(
-                    self.config.zero_config.overlap_bucket_mb * 2**20))
-            return grads, jnp.mean(losses)
+                    self.config.zero_config.overlap_bucket_mb * 2**20),
+                errors=([ef_slot[k] for k in ef_keys]
+                        if (ef_keys and spec is not None) else None))
+            if ef_keys and spec is not None:
+                grads, new_errs = result
+                return grads, jnp.mean(losses), {
+                    "reduce": dict(zip(ef_keys, new_errs))}
+            return result, jnp.mean(losses), None
         # target = the accumulation buffer's sharding: data-sharded leaves
         # come back as the SCATTERED partition (one all_to_all, no hop-2
         # gather — reference all_to_all_quant_reduce returns the partition)
         target_specs = jax.tree_util.tree_map_with_path(
             lambda path, g: self.zero_plan.grad_spec(_path_str(path),
                                                      g.shape[1:]), grads_c)
-        grads = quantized_grad_reduce(
+        result = quantized_grad_reduce(
             grads_c, chunk_specs, self.topology.mesh,
             target_specs=target_specs,
             bucket_bytes=int(
-                self.config.zero_config.overlap_bucket_mb * 2**20))
-        return grads, jnp.mean(losses)
+                self.config.zero_config.overlap_bucket_mb * 2**20),
+            errors=([ef_slot[k] for k in ef_keys] if ef_keys else None))
+        if ef_keys:
+            grads, new_errs = result
+            return grads, jnp.mean(losses), {
+                "reduce": dict(zip(ef_keys, new_errs))}
+        return result, jnp.mean(losses), None
 
     def _apply_step_body(self, state: TrainState, grads_src=None) -> TrainState:
         """Boundary update.  ``grads_src``: gradients to apply instead of
@@ -766,8 +967,10 @@ class DeepSpeedTPUEngine:
             batch = jax.tree_util.tree_map(lambda x: x[0], batches)
             # same rng stream as the scan path (split, don't use raw) so a
             # seeded run reproduces across both paths
-            grads, loss = self._micro_grads(state, batch,
-                                            jax.random.split(rng, 1)[0])
+            grads, loss, new_comm = self._micro_grads(
+                state, batch, jax.random.split(rng, 1)[0])
+            if new_comm is not None:
+                state = dataclasses.replace(state, comm_errors=new_comm)
             state = self._apply_step_body(state, grads_src=grads)
             return state, loss.astype(jnp.float32)
         state, loss = self._micro_scan_body(state, batches, rng)
@@ -1361,6 +1564,11 @@ class DeepSpeedTPUEngine:
             "cumulative ESTIMATED seconds of exposed (non-overlapped) "
             "gradient collectives: wire bytes x bus factor over the "
             "nominal per-generation interconnect bandwidth")
+        self._m_comp_residual = reg.gauge(
+            "deepspeed_tpu_comm_compression_residual_bytes",
+            "bytes of compressed-collective error-feedback residual "
+            "state carried in TrainState.comm_errors (per-bucket; "
+            "docs/COMM.md 'Compressed overlap')")
         self._m_steps = reg.counter("deepspeed_tpu_train_steps_total",
                                     "optimizer steps taken")
         self._m_skipped = reg.counter(
@@ -1522,6 +1730,10 @@ class DeepSpeedTPUEngine:
             if self._win_steps > 0:
                 self._m_exposed.inc(
                     report.exposed_seconds_per_step * self._win_steps)
+        # structural (shape-derived, no sync): EF residual state bytes
+        self._m_comp_residual.set(sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(self.state.comm_errors)))
         if self._win_time > 0:
             bs = self.config.train_batch_size or 1
             self._m_samples_ps.set(self._win_steps * bs / self._win_time)

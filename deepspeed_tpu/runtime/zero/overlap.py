@@ -51,20 +51,48 @@ into the span ring; the engine publishes the exposure split
 (``telemetry/overlap.py``) as
 ``deepspeed_tpu_train_overlapped_fraction`` /
 ``_exposed_collective_seconds``.
+
+Compressed overlap (docs/COMM.md "Compressed overlap"): with a
+``CompressionSpec`` on the plan the in-loop exchange moves codes + block
+scales instead of fp32 — stage <= 2 buckets ride the shared two-hop
+compressed all-reduce (or the hierarchical three-hop when the data axis
+is split), stage 3's explicit ``psum_scatter`` becomes the quantized
+reduce-scatter — with ONE error-feedback residual per bucket carried as
+a train-state leaf (``TrainState.comm_errors``), so residuals survive
+donation, checkpoint and preemption-resume bit-identically.
+
+Mechanically the compressed path cannot let the cotangent cross the
+shard_map boundary (a replicated input's transpose is a full-width fp
+``psum`` — exactly the bytes being eliminated), so the hook threads two
+aux channels per bucket through the scan as extra xs:
+
+* ``gslot`` — a zeros input whose COTANGENT carries the reduced bucket
+  gradient out (axis-sharded ``[L, W, S]``: every rank writes the
+  identical reduced value into its own row, so the boundary transpose
+  is communication-free and the engine collapses rows locally);
+* ``eslot`` — the residual input whose cotangent carries the NEW
+  residual (same shape; each rank's row is its own compensation).
+
+The param leaves whose exchange rides the gslot channel are
+``stop_gradient``-ed inside the body, so their boundary cotangent is a
+symbolic zero — no psum is ever emitted for them.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...comm.collectives.bucketer import assign_buckets
+from ...comm.collectives.bucketer import assign_buckets, bucketed_map
+from ...comm.collectives.codec import CompressionSpec
 from ...telemetry.spans import record_event
 from ...utils.logging import logger
 
@@ -114,7 +142,10 @@ class OverlapPlan:
                  leaf_specs: Sequence[P], gather_dims: Sequence[Optional[int]],
                  buckets: Sequence[Sequence[int]],
                  bucket_bytes: Sequence[int],
-                 bucket_step_bytes: Sequence[int]):
+                 bucket_step_bytes: Sequence[int],
+                 compression: Optional[CompressionSpec] = None,
+                 hier_inner: int = 0, n_layers: int = 1,
+                 slice_shapes: Sequence[Tuple[int, ...]] = ()):
         self.mesh = mesh
         self.axis = axis
         self.treedef = treedef
@@ -127,6 +158,131 @@ class OverlapPlan:
         #: n_layers) — what the trace-time events report, so the span
         #: accounting adds up against the structural totals
         self.bucket_step_bytes = tuple(int(b) for b in bucket_step_bytes)
+        #: in-loop codec (None = the PR-12 exact fp exchange, bit-compat)
+        self.compression = compression
+        #: > 0: the stage<=2 in-loop reduce takes the hierarchical
+        #: three-hop shape (intra-slice reduce-scatter, quantized
+        #: inter-slice exchange, intra-slice gather)
+        self.hier_inner = int(hier_inner)
+        self.n_layers = int(n_layers)
+        self.slice_shapes = tuple(tuple(s) for s in slice_shapes)
+        # per-bucket comm-channel layout (compressed mode): the flat
+        # (non-gathered) leaves coalesce — block-ALIGNED, so bucketed ==
+        # unbucketed stays bit-exact — into one payload of _gslot_sizes[k]
+        # elements reduced by ONE two-hop/hier chain; gathered leaves
+        # follow per-leaf.  The bucket's eslot holds the flat payload's
+        # residual at [0, gslot_size) and each gathered leaf's full-slice
+        # residual after it — ONE residual leaf per bucket.
+        self._flat_idx: List[List[int]] = []
+        self._gath_idx: List[List[int]] = []
+        self._offsets: List[dict] = []
+        self._gslot_sizes: List[int] = []
+        self._eslot_sizes: List[int] = []
+        if compression is not None:
+            blk = compression.block
+            for idxs in self.buckets:
+                fi = [i for i in idxs if self.gather_dims[i] is None]
+                gi = [i for i in idxs if self.gather_dims[i] is not None]
+                offs, off = {}, 0
+                for i in fi:
+                    offs[i] = off
+                    n = int(np.prod(self.slice_shapes[i] or (1,)))
+                    off += -(-n // blk) * blk
+                sflat = off
+                for i in gi:
+                    offs[i] = off
+                    off += int(np.prod(self.slice_shapes[i] or (1,)))
+                self._flat_idx.append(fi)
+                self._gath_idx.append(gi)
+                self._offsets.append(offs)
+                self._gslot_sizes.append(sflat)
+                self._eslot_sizes.append(off if compression.error_feedback
+                                         else 0)
+
+    # ------------------------------------------------------- comm channel
+    @property
+    def error_feedback(self) -> bool:
+        return (self.compression is not None
+                and self.compression.error_feedback)
+
+    def eslot_key(self, k: int) -> str:
+        return f"b{k:03d}"  # zero-padded: checkpoint key order == bucket order
+
+    def init_errors(self):
+        """Fresh per-bucket EF residual leaves for ``TrainState.comm_errors``
+        (eager; engine init / loud reset).  Global ``[L, W, S]`` fp32,
+        axis-sharded on W: each rank stores only its own compensation."""
+        W = int(self.mesh.shape[self.axis])
+        sh = NamedSharding(self.mesh, P(None, self.axis))
+        return {
+            self.eslot_key(k): jax.device_put(
+                jnp.zeros((self.n_layers, W, self._eslot_sizes[k]),
+                          jnp.float32), sh)
+            for k in range(len(self.buckets))}
+
+    def grad_slots(self):
+        """In-trace zero gslots (the reduced-gradient cotangent channel);
+        rebuilt every step — only the RESIDUALS are state."""
+        W = int(self.mesh.shape[self.axis])
+        sh = NamedSharding(self.mesh, P(None, self.axis))
+        return tuple(
+            jax.lax.with_sharding_constraint(
+                jnp.zeros((self.n_layers, W, self._gslot_sizes[k]),
+                          jnp.float32), sh)
+            for k in range(len(self.buckets)))
+
+    def residual_bytes(self) -> int:
+        """Total bytes of EF residual state held in train state (the
+        ``deepspeed_tpu_comm_compression_residual_bytes`` gauge)."""
+        W = int(self.mesh.shape[self.axis])
+        return sum(self.n_layers * W * s * 4 for s in self._eslot_sizes)
+
+    def eslot_state(self, comm_errors):
+        """The eslot tree for this step: the carried train-state
+        residuals under error feedback, zero-width placeholders when the
+        codec runs straight-through (the hook signature is uniform)."""
+        if self.error_feedback:
+            return comm_errors["overlap"]
+        W = int(self.mesh.shape[self.axis])
+        return {self.eslot_key(k): jnp.zeros((self.n_layers, W, 0),
+                                             jnp.float32)
+                for k in range(len(self.buckets))}
+
+    def comm_tuples(self, comm) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+        """Split the model-side comm tree ``{"g": seq, "e": dict}`` into
+        the hook's positional (gslots, eslots) tuples, bucket-ordered."""
+        g = tuple(comm["g"])
+        e = tuple(comm["e"][self.eslot_key(k)]
+                  for k in range(len(self.buckets)))
+        return g, e
+
+    def merge_comm_grads(self, layer_grads: Any, gslot_cts: Sequence[Any]
+                         ) -> Any:
+        """Engine-side (in-trace, post-``jax.grad``): replace the
+        stop-gradient-zeroed flat-leaf grads with the reduced values the
+        gslot cotangents carried out.  Every rank's ``[L, W, S]`` row
+        holds the identical reduced payload, so the collapse is a LOCAL
+        squeeze (out_specs claims replication; no collective)."""
+        from ...utils.jax_compat import shard_map
+
+        leaves = list(self.treedef.flatten_up_to(layer_grads))
+        ks = [k for k in range(len(self.buckets))
+              if self._flat_idx[k] and self._gslot_sizes[k]]
+        if not ks:
+            return layer_grads
+        collapse = shard_map(
+            lambda *gs: tuple(g[:, 0] for g in gs), mesh=self.mesh,
+            in_specs=tuple(P(None, self.axis) for _ in ks),
+            out_specs=tuple(P() for _ in ks), check_vma=False,
+            axis_names={self.axis})
+        cols = collapse(*[gslot_cts[k] for k in ks])
+        for k, col in zip(ks, cols):
+            for i in self._flat_idx[k]:
+                off = self._offsets[k][i]
+                n_i = int(np.prod(self.slice_shapes[i] or (1,)))
+                leaves[i] = col[:, off:off + n_i].reshape(
+                    (self.n_layers,) + self.slice_shapes[i])
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
     # ------------------------------------------------------------- model API
     def wrap_block(self, raw_block, has_mask: bool):
@@ -160,9 +316,49 @@ class OverlapPlan:
             out_specs=(bsp, P()),
             check_vma=False, axis_names={self.axis})
 
+        sm_c = None
+        if self.compression is not None:
+            nl, nb = len(self.paths), len(self.buckets)
+
+            def body_c(x, positions, *rest):
+                mask = rest[0] if has_mask else None
+                rest = rest[1:] if has_mask else rest
+                leaves = tuple(rest[:nl])
+                gslots = tuple(rest[nl:nl + nb])
+                eslots = tuple(rest[nl + nb:])
+                # flat-path leaves deliver their gradient via the gslot
+                # cotangent channel; stop_gradient makes their boundary
+                # cotangent a SYMBOLIC zero, so the shard_map transpose
+                # emits no fp psum for them
+                prepped = tuple(
+                    lax.stop_gradient(v) if plan.gather_dims[i] is None
+                    else v for i, v in enumerate(leaves))
+                out_leaves = _overlap_hook_comm(prepped, gslots, eslots,
+                                                plan)
+                out_leaves = tuple(checkpoint_name(v, OVERLAP_TAG)
+                                   for v in out_leaves)
+                layer = jax.tree_util.tree_unflatten(plan.treedef,
+                                                     out_leaves)
+                return raw_block(x, positions, mask, layer)
+
+            body_c = jax.checkpoint(body_c, policy=_overlap_remat_policy())
+            comm_specs = tuple(P(self.axis) for _ in range(2 * nb))
+            sm_c = shard_map(
+                body_c, mesh=self.mesh,
+                in_specs=(bsp, bsp) + mask_specs + self.leaf_specs
+                + comm_specs,
+                out_specs=(bsp, P()),
+                check_vma=False, axis_names={self.axis})
+
         world = int(self.mesh.shape[self.axis])
 
-        def wrapped(x, positions, mask, layer_tree):
+        def wrapped(x, positions, mask, layer_tree, comm=None):
+            if comm is not None and x.shape[0] % world != 0:
+                raise ValueError(
+                    f"compressed overlap: batch {x.shape[0]} does not "
+                    f"divide the data axis ({world}) — training batches "
+                    "divide by construction; the eval path must not pass "
+                    "comm state")
             if x.shape[0] % world != 0:
                 # e.g. an eval_batch whose batch does not divide the
                 # data axis: the wrap cannot shard it — run the plain
@@ -180,6 +376,9 @@ class OverlapPlan:
                     f"(plan {self.treedef} vs model {treedef}); rebuild the "
                     "engine after changing the model")
             args = (x, positions) + ((mask,) if has_mask else ()) + tuple(leaves)
+            if comm is not None and sm_c is not None:
+                gslots, eslots = self.comm_tuples(comm)
+                return sm_c(*(args + gslots + eslots))
             return sm(*args)
 
         return wrapped
@@ -232,12 +431,153 @@ class OverlapPlan:
             # one point per bucket per traced program, carrying the
             # bytes the bucket reduces — the overlap accountant reads
             # these against the compute spans
-            record_event("grad_bucket_reduce", cat="comm",
-                         bytes=self.bucket_step_bytes[k], bucket=k,
-                         leaves=len(idxs), overlapped=True)
+            _record_bucket_reduce(self.bucket_step_bytes[k], k, len(idxs))
             for i, v in zip(idxs, group):
                 out[i] = v
         return tuple(out)
+
+    def _bwd_compressed(self, cts: Tuple[Any, ...],
+                        eslots: Tuple[Any, ...]):
+        """Compressed in-loop exchange (inside the transposed body, per
+        backward scan trip): per bucket, the flat leaves coalesce into
+        ONE block-aligned payload reduced by the shared compressed
+        two-hop (or hierarchical three-hop) — codes + scales on the
+        wire — and each gathered (stage-3) leaf's ``psum_scatter``
+        becomes a quantized reduce-scatter.  Error feedback compensates
+        from the bucket's eslot row and the NEW residual leaves through
+        the eslot cotangent; the reduced flat payload leaves through the
+        gslot cotangent (see module docstring).
+
+        Returns ``(leaf_cts, gslot_cts, eslot_cts)``."""
+        from ...comm.collectives import compressed as _cc
+
+        spec = self.compression
+        ef = spec.error_feedback
+        # reduce_scatter branches on spec.error_feedback itself, so the
+        # bucket spec is used as-is in both modes
+        rs_spec = spec
+        out: List[Any] = list(cts)
+        gslot_cts: List[Any] = []
+        eslot_cts: List[Any] = []
+        for k, idxs in enumerate(self.buckets):
+            group = jax.lax.optimization_barrier(
+                tuple(out[i] for i in idxs))
+            vals = dict(zip(idxs, group))
+            e_all = eslots[k][0] if ef else None  # local [S_e] row
+            reduced = {}
+            e_parts_g = []
+            for i in self._gath_idx[k]:
+                v = vals[i]
+                d = self.gather_dims[i]
+                if ef:
+                    off = self._offsets[k][i]
+                    n_i = int(np.prod(self.slice_shapes[i] or (1,)))
+                    err = e_all[off:off + n_i].reshape(v.shape)
+                    red, ne = _cc.reduce_scatter(
+                        v, op="sum", axis=self.axis, spec=rs_spec,
+                        scatter_dim=d, error=err)
+                    e_parts_g.append(ne.reshape(-1))
+                else:
+                    red = _cc.reduce_scatter(v, op="sum", axis=self.axis,
+                                             spec=rs_spec, scatter_dim=d)
+                reduced[i] = red.astype(v.dtype)
+            fi = self._flat_idx[k]
+            new_e_flat = None
+            if fi:
+                sflat = self._gslot_sizes[k]
+                err = e_all[:sflat] if ef else None
+                R, new_e_flat = _compressed_bucket_reduce(
+                    [vals[i] for i in fi], err, spec, self.axis,
+                    self.hier_inner)
+                gslot_cts.append(R[None])
+                for i in fi:
+                    # dies at the body's stop_gradient (symbolic zero at
+                    # the boundary); the real value rode the gslot
+                    reduced[i] = jnp.zeros_like(vals[i])
+            else:
+                gslot_cts.append(jnp.zeros((1, 0), jnp.float32))
+            if ef:
+                parts = ([new_e_flat] if new_e_flat is not None else []) \
+                    + e_parts_g
+                flat_e = (jnp.concatenate(parts) if len(parts) > 1
+                          else parts[0])
+                eslot_cts.append(flat_e[None].astype(jnp.float32))
+            else:
+                eslot_cts.append(jnp.zeros_like(eslots[k]))
+            new_group = jax.lax.optimization_barrier(
+                tuple(reduced[i] for i in idxs))
+            _record_bucket_reduce(self.bucket_step_bytes[k], k, len(idxs),
+                                  compressed=True, format=spec.format)
+            for i, v in zip(idxs, new_group):
+                out[i] = v
+        return tuple(out), tuple(gslot_cts), tuple(eslot_cts)
+
+
+def _compressed_bucket_reduce(leaves: Sequence[Any], error: Optional[Any],
+                              spec: CompressionSpec, axis: str,
+                              hier_inner: int):
+    """The compressed IN-LOOP bucket reducer: coalesce the bucket's flat
+    leaves through ``bucketer.bucketed_map`` — the ONE coalesce pipeline
+    every bucketed reducer shares (lint: ``grad-overlap``) — into one
+    block-aligned fp32 payload, then run ONE compressed all-reduce chain
+    over it: the shared two-hop (all_to_all + all_gather, codes on the
+    wire both hops) or, with ``hier_inner``, the hierarchical three-hop.
+
+    Returns ``(reduced_flat_payload, new_error_or_None)``."""
+    from ...comm.collectives import compressed as _cc
+    from ...comm.collectives.hierarchical import hier_all_reduce
+
+    ef = spec.error_feedback and error is not None
+    run_spec = spec if ef else dataclasses.replace(spec,
+                                                   error_feedback=False)
+    holder = {}
+
+    def reduce_flat(flat, _k):
+        if hier_inner:
+            r = hier_all_reduce(flat, op="sum", axis=axis, inner=hier_inner,
+                                spec=run_spec,
+                                error=error if ef else None)
+            red, holder["e"] = r if ef else (r, None)
+        elif ef:
+            # hop2_ef=False: the hop-2 owner reinjection is slot-layout
+            # dependent; only the layout-stable hop-1 residual keeps
+            # bucketed == unbucketed bit-exact (see compressed.all_reduce)
+            red, holder["e"] = _cc.all_reduce(
+                flat, op="sum", axis=axis, spec=run_spec, error=error,
+                out_dtype=jnp.float32, hop2_ef=False)
+        else:
+            red = _cc.all_reduce(flat, op="sum", axis=axis, spec=run_spec,
+                                 out_dtype=jnp.float32)
+        holder["R"] = red
+        return red
+
+    bucketed_map(leaves, 1 << 62, reduce_flat, out_dtype=jnp.float32,
+                 align=spec.block)
+    return holder["R"], holder.get("e")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _overlap_hook_comm(leaves: Tuple[Any, ...], gslots: Tuple[Any, ...],
+                       eslots: Tuple[Any, ...], plan: OverlapPlan):
+    """The compressed-overlap hook: forward identical to the exact hook
+    (stage-3 gathers stay fp — gradient compression only); the backward
+    routes every layer-bucket through the codec and hijacks the
+    gslot/eslot input cotangents as the gradient/residual out-channels
+    (they are scan xs, so the per-trip values stack into the
+    ``[L, W, S]`` train-state layout)."""
+    return plan._fwd(leaves)
+
+
+def _overlap_hook_comm_fwd(leaves, gslots, eslots, plan):
+    return plan._fwd(leaves), (eslots,)
+
+
+def _overlap_hook_comm_bwd(plan, res, cts):
+    (eslots,) = res
+    return plan._bwd_compressed(cts, eslots)
+
+
+_overlap_hook_comm.defvjp(_overlap_hook_comm_fwd, _overlap_hook_comm_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
@@ -264,6 +604,19 @@ def record_tail_reduce(nbytes: int) -> None:
                  overlapped=False)
 
 
+def _record_bucket_reduce(nbytes: int, bucket: int, leaves: int,
+                          compressed: bool = False,
+                          format: Optional[str] = None) -> None:
+    """ONE owner site for the ``grad_bucket_reduce`` trace event (the
+    exact and compressed in-loop reducers share it; the span lint pins
+    single ownership)."""
+    attrs = dict(bytes=int(nbytes), bucket=int(bucket), leaves=int(leaves),
+                 overlapped=True)
+    if compressed:
+        attrs.update(compressed=True, format=format)
+    record_event("grad_bucket_reduce", cat="comm", **attrs)
+
+
 def _entry_axes(entry) -> tuple:
     if entry is None:
         return ()
@@ -272,7 +625,9 @@ def _entry_axes(entry) -> tuple:
 
 def build_overlap_plan(zero_plan, abstract_layers: Any, *,
                        bucket_bytes: int, axis: str, stage: int,
-                       grad_dtype) -> Optional[OverlapPlan]:
+                       grad_dtype,
+                       compression: Optional[CompressionSpec] = None,
+                       hier_inner: int = 0) -> Optional[OverlapPlan]:
     """Derive the wrap's static plan from the stacked layer tree.
 
     ``abstract_layers``: ``state.params["layers"]`` (stacked, leading
@@ -280,6 +635,8 @@ def build_overlap_plan(zero_plan, abstract_layers: Any, *,
     mesh axis the wrap manages manually.  At ``stage`` 3 each leaf's
     in-body spec is its live ZeRO shard (gathered explicitly by the
     hook); below 3 the leaves enter replicated over ``axis``.
+    ``compression``/``hier_inner``: the in-loop codec and hierarchy
+    split for the compressed-overlap path (None/0 = exact fp exchange).
     """
     from .strategy import _path_str
 
@@ -288,12 +645,14 @@ def build_overlap_plan(zero_plan, abstract_layers: Any, *,
         return None
     mesh = zero_plan.topology.mesh
     paths, leaf_specs, gather_dims, sizes, step_sizes = [], [], [], [], []
+    slice_shapes = []
     grad_itemsize = np.dtype(grad_dtype).itemsize
     for path, leaf in flat:
         pstr = "layers/" + _path_str(path)
         shape = tuple(leaf.shape)
         paths.append(pstr)
         n_layers = shape[0] or 1
+        slice_shapes.append(shape[1:])
         step_sizes.append(int(np.prod(shape)) * grad_itemsize)
         sizes.append(int(np.prod(shape)) // n_layers * grad_itemsize)
         gdim = None
@@ -319,6 +678,13 @@ def build_overlap_plan(zero_plan, abstract_layers: Any, *,
     logger.info(
         f"overlap plan: {len(flat)} layer leaves -> {len(buckets)} "
         f"bucket(s) (target {bucket_bytes / 2**20:.1f} MB, stage {stage}, "
-        f"gathered={sum(d is not None for d in gather_dims)})")
+        f"gathered={sum(d is not None for d in gather_dims)}"
+        + (f", {compression.format} in-loop wire"
+           + (" + EF" if compression.error_feedback else "")
+           + (f", hier inner={hier_inner}" if hier_inner else "")
+           if compression is not None else "") + ")")
+    n_layers = tuple(flat[0][1].shape)[0] or 1
     return OverlapPlan(mesh, axis, treedef, paths, leaf_specs, gather_dims,
-                       buckets, bucket_sizes, bucket_step)
+                       buckets, bucket_sizes, bucket_step,
+                       compression=compression, hier_inner=hier_inner,
+                       n_layers=n_layers, slice_shapes=slice_shapes)

@@ -171,7 +171,8 @@ def _scatter_dim(target_spec: Optional[P], chunk_spec: P, axis: str) -> int:
 def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
                           axis: str = DATA_AXIS,
                           target_specs: Any = None,
-                          bucket_bytes: int = 0) -> Any:
+                          bucket_bytes: int = 0,
+                          errors: Optional[Any] = None) -> Any:
     """Reduce vmap-chunked gradients (leading dim = data-axis chunks) with
     int8 on the wire.  ``chunk_specs``: per-leaf PartitionSpec of the
     chunked grads (leading entry = the data axis).
@@ -185,7 +186,15 @@ def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     flat buckets (``bucket_bytes`` — ``zero_optimization.overlap_bucket_mb``;
     0 = per-leaf): one collective chain per bucket instead of per leaf, so
     small leaves stop paying a full two-hop each and the per-bucket chains
-    overlap (bucket k's exchange under bucket k+1's quantize)."""
+    overlap (bucket k's exchange under bucket k+1's quantize).
+
+    ``errors``: per-BUCKET error-feedback residuals for the flat (two-hop)
+    path — global ``[W, S_k]`` fp32 arrays, axis-sharded, carried across
+    steps in ``engine.state.comm_errors`` so checkpoint/resume keeps them
+    (the EF lifecycle contract, docs/COMM.md).  Returns
+    ``(grads, new_errors)`` then.  Scattered-path leaves are single-hop
+    and stay EF-free by construction.  ``errors=None``: the legacy exact
+    payload layout and single-value return, bit-identical to HEAD."""
     from ...comm.collectives.bucketer import bucketed_map
 
     world = mesh.shape[axis]
@@ -195,8 +204,13 @@ def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
     grads_flat = treedef.flatten_up_to(grads_chunked)
     sdims = [_scatter_dim(t, c, axis)
              for t, c in zip(flat_target, flat_chunk)]
+    ef = errors is not None
+    errors = list(errors) if ef else []
+    n_leaves = len(flat_chunk)
+    ef_wire = CompressionSpec(format=_WIRE.format, block=_WIRE.block,
+                              error_feedback=True)
 
-    def body(flat_tree):
+    def body(flat_tree, errs):
         out: list = [None] * len(flat_tree)
         flat_path = []
         for i, (g, sd) in enumerate(zip(flat_tree, sdims)):
@@ -206,18 +220,36 @@ def quantized_grad_reduce(grads_chunked: Any, chunk_specs: Any, mesh,
                 out[i] = _a2a_quant_reduce_scattered(g[0], axis, world, sd)
             else:
                 flat_path.append(i)
+        new_errs = []
+
+        def reduce_bucket(flat, k):
+            if not ef:
+                return _a2a_quant_reduce_flat(flat, axis, world)
+            from ...comm.collectives import compressed as _cc
+
+            red, ne = _cc.all_reduce(flat, op="mean", axis=axis,
+                                     spec=ef_wire, error=errs[k][0],
+                                     out_dtype=jnp.float32)
+            new_errs.append(ne[None])
+            return red
+
         reduced = bucketed_map(
             [flat_tree[i][0] for i in flat_path], bucket_bytes,
-            lambda flat, _k: _a2a_quant_reduce_flat(flat, axis, world),
-            out_dtype=jnp.float32)
+            reduce_bucket, out_dtype=jnp.float32,
+            align=(_WIRE.block if ef else 0))
         for i, o in zip(flat_path, reduced):
             out[i] = o
-        return tuple(out)
+        return tuple(out) + tuple(new_errs)
 
     out_specs = tuple(
         (t if sd >= 0 else P(*tuple(c)[1:]))
-        for c, t, sd in zip(flat_chunk, flat_target, sdims))
-    fn = shard_map(body, mesh=mesh, in_specs=(tuple(flat_chunk),),
+        for c, t, sd in zip(flat_chunk, flat_target, sdims)) \
+        + tuple(P(axis) for _ in errors)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(tuple(flat_chunk), tuple(P(axis) for _ in errors)),
                    out_specs=out_specs, check_vma=False)
-    out_flat = fn(tuple(grads_flat))
-    return jax.tree_util.tree_unflatten(treedef, out_flat)
+    out_flat = fn(tuple(grads_flat), tuple(errors))
+    grads = jax.tree_util.tree_unflatten(treedef, out_flat[:n_leaves])
+    if not ef:
+        return grads
+    return grads, list(out_flat[n_leaves:])

@@ -80,6 +80,11 @@ class OverlapReport:
     exposed_seconds_per_step: float
     bandwidth_bytes_per_s: float
     buckets: int
+    #: in-loop codec of the compressed-overlap path ("int8"/"fp8"),
+    #: None for the exact fp exchange (docs/COMM.md "Compressed overlap")
+    compression: Optional[str] = None
+    #: bytes of per-bucket error-feedback residual state in train state
+    residual_bytes: int = 0
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -110,7 +115,9 @@ def structural_report(struct: Optional[Dict[str, int]], *, world: int,
         exposed_bytes=exposed,
         exposed_seconds_per_step=exposed_s,
         bandwidth_bytes_per_s=bw,
-        buckets=int(struct.get("buckets", 0)))
+        buckets=int(struct.get("buckets", 0)),
+        compression=struct.get("compression"),
+        residual_bytes=int(struct.get("residual_bytes", 0) or 0))
 
 
 def report_from_spans(recorder=None, *, world: int, device_kind: str = "cpu",

@@ -67,6 +67,11 @@ def test_compression_spec_parse_and_validation():
     assert CompressionSpec.parse(spec) is spec
     assert CompressionSpec.parse(
         {"format": "int8", "block": 64}).block == 64
+    # the backward-compression flag flows through every config surface
+    # that parses spec dicts (ep_a2a_compression / ring_compression /
+    # overlap_compression)
+    bw = CompressionSpec.parse({"format": "int8", "compress_backward": True})
+    assert bw.compress_backward and not CompressionSpec("int8").compress_backward
     with pytest.raises(ValueError, match="format"):
         CompressionSpec("int4")
     with pytest.raises(TypeError):
@@ -354,3 +359,156 @@ def test_engine_hier_quantized_convergence_parity(devices8):
                   "zero_hierarchy_inner": 2,
                   "zero_quantized_gradients": True})
     assert np.allclose(base, hier_q, rtol=5e-3), (base, hier_q)
+
+def test_backward_compression_and_residual_slots(devices8):
+    """PR-15 differentiated-verb extension: ``compress_backward``
+    quantizes the TRANSPOSED exchange (the fwd-only gap closed for MoE
+    a2a / ring rotations), and the ``*_ef`` variants give that backward
+    exchange its own error-feedback residual slot — the new residual
+    exits as the error input's cotangent (the train-state channel
+    contract)."""
+    mesh = _data_mesh(devices8)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(8, 8, 256).astype(np.float32))
+
+    # default spec: backward is the EXACT transposed exchange
+    def grad_of(spec):
+        def body(x):
+            def loss(v):
+                y = compressed.all_to_all(v, DATA_AXIS, spec, 0, 0, False)
+                return jnp.sum(jnp.sin(y))
+
+            return jax.grad(loss)(x)
+
+        f = shard_map(body, check_vma=False, mesh=mesh,
+                      in_specs=P(None, DATA_AXIS, None),
+                      out_specs=P(None, DATA_AXIS, None))
+        return np.asarray(f(x))
+
+    g_exact = grad_of(CompressionSpec("int8"))
+    g_comp = grad_of(CompressionSpec("int8", compress_backward=True))
+    # compressed backward is close to (codec tolerance) but not the
+    # bit-exact straight-through backward
+    np.testing.assert_allclose(g_comp, g_exact, atol=0.05)
+    assert (g_comp != g_exact).any(), \
+        "compress_backward changed nothing — the bwd stayed exact"
+
+    # residual slot: grad w.r.t. the error input IS the new residual =
+    # compensated cotangent minus what the quantized bwd exchange sent
+    def body_ef(x, err):
+        def loss(v, e):
+            y = compressed.all_to_all_ef(v, e, DATA_AXIS,
+                                         CompressionSpec("int8"), 0, 0,
+                                         False)
+            return jnp.sum(jnp.sin(y))
+
+        return jax.grad(loss, argnums=(0, 1))(x, err)
+
+    f = shard_map(body_ef, check_vma=False, mesh=mesh,
+                  in_specs=(P(None, DATA_AXIS, None),
+                            P(None, DATA_AXIS, None)),
+                  out_specs=(P(None, DATA_AXIS, None),
+                             P(None, DATA_AXIS, None)))
+    err0 = jnp.zeros_like(x)
+    _, new_err = f(x, err0)
+    assert np.abs(np.asarray(new_err)).max() > 0, \
+        "EF residual never populated"
+    # and the residual really compensates: a second round with the carried
+    # residual reconstructs the exact cotangent better than round one
+    def body_ct(x, err):
+        def loss(v, e):
+            y = compressed.all_to_all_ef(v, e, DATA_AXIS,
+                                         CompressionSpec("int8"), 0, 0,
+                                         False)
+            return jnp.sum(jnp.sin(y))
+
+        return jax.grad(loss, argnums=(0,))(x, err)[0]
+
+    fc = shard_map(body_ct, check_vma=False, mesh=mesh,
+                   in_specs=(P(None, DATA_AXIS, None),
+                             P(None, DATA_AXIS, None)),
+                   out_specs=P(None, DATA_AXIS, None))
+    ct1 = np.asarray(fc(x, err0))
+    ct2 = np.asarray(fc(x, new_err))
+    # the two rounds differ exactly by the reinjected residual's effect
+    assert (ct1 != ct2).any()
+
+
+def test_ppermute_backward_compression(devices8):
+    mesh = _data_mesh(devices8)
+    perm = tuple((i, (i + 1) % 8) for i in range(8))
+    rng = np.random.RandomState(9)
+    x = jnp.asarray(rng.randn(8, 256).astype(np.float32))
+
+    def grad_of(spec):
+        def body(x):
+            def loss(v):
+                return jnp.sum(jnp.sin(
+                    compressed.ppermute(v, perm, DATA_AXIS, spec)))
+
+            return jax.grad(loss)(x)
+
+        f = shard_map(body, check_vma=False, mesh=mesh,
+                      in_specs=P(DATA_AXIS, None),
+                      out_specs=P(DATA_AXIS, None))
+        return np.asarray(f(x))
+
+    g_exact = grad_of(CompressionSpec("int8"))
+    g_comp = grad_of(CompressionSpec("int8", compress_backward=True))
+    np.testing.assert_allclose(g_comp, g_exact, atol=0.05)
+    assert (g_comp != g_exact).any()
+
+
+def test_reduce_scatter_error_feedback(devices8):
+    """The EF reduce-scatter (the stage-3 compressed-overlap primitive):
+    single-hop, residual = full local payload error, layout-stable."""
+    mesh = _data_mesh(devices8)
+    spec = CompressionSpec("int8", error_feedback=True)
+
+    def body(x, e):
+        out, ne = compressed.reduce_scatter(x, "sum", DATA_AXIS, spec,
+                                            scatter_dim=0, error=e[0])
+        return out, ne[None]
+
+    f = shard_map(body, check_vma=False, mesh=mesh,
+                  in_specs=(P(None, None), P(DATA_AXIS, None, None)),
+                  out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None, None)))
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(8, 256).astype(np.float32))
+    e0 = jnp.zeros((8,) + x.shape, jnp.float32)
+    out, ne = f(x, e0)
+    np.testing.assert_allclose(np.asarray(out), 8 * np.asarray(x), atol=0.3)
+    assert np.abs(np.asarray(ne)).max() > 0
+    # residual semantics: payload - qdq(payload) per rank
+    q = codec.qdq(x, spec)
+    np.testing.assert_allclose(np.asarray(ne)[0],
+                               np.asarray(x - q), atol=1e-6)
+
+
+def test_hier_all_reduce_error_feedback(devices8):
+    """hier EF: the residual covers the ONE lossy point (this rank's
+    hop-2 quantization of its slot) and reinjection converges the
+    repeated reduce of a constant payload toward the exact mean."""
+    mesh = _data_mesh(devices8)
+    spec = CompressionSpec("int8", error_feedback=True)
+
+    def body(x, e):
+        out, ne = hier_all_reduce(
+            x, op="mean", axis=DATA_AXIS, inner=2, spec=spec, error=e[0])
+        return out[None], ne[None]
+
+    f = shard_map(body, check_vma=False, mesh=mesh,
+                  in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+                  out_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)))
+    rng = np.random.RandomState(13)
+    x = jnp.asarray(rng.randn(8, 512).astype(np.float32))
+    exact = np.asarray(x).mean(axis=0)
+    err = jnp.zeros_like(x)
+    history = []
+    for _ in range(3):
+        out, err = f(x, err)
+        history.append(np.abs(np.asarray(out)[0] - exact).mean())
+    # mean error with EF must not grow; the compensated rounds stay at
+    # or below the first round's quantization error
+    assert history[-1] <= history[0] * 1.5, history
+    assert np.abs(np.asarray(err)).max() > 0

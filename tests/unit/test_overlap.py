@@ -163,11 +163,21 @@ def test_overlap_plan_stage3_gather_dims(devices8):
 
 
 def test_overlap_disabled_reasons(devices8):
-    # qgZ owns the grad exchange -> no wrap (bucketed reducers instead)
+    # qgZ + overlap now COMPOSES (compressed overlap, docs/COMM.md):
+    # the wrap takes the exchange with int8 + EF in-loop...
     e = _engine({"stage": 1, "overlap_grad_reduce": True,
                  "zero_quantized_gradients": True})
-    assert e._overlap_plan is None
-    assert e._overlap_struct["overlapped_bytes"] == 0
+    assert e._overlap_plan is not None
+    assert e._overlap_plan.compression is not None
+    assert e._overlap_plan.error_feedback
+    assert "overlap" in e.state.comm_errors
+    # ...unless overlap_compression=False forces the exact wrap, which
+    # stands down under qgZ exactly as before (the reducers own it)
+    e0 = _engine({"stage": 1, "overlap_grad_reduce": True,
+                  "zero_quantized_gradients": True,
+                  "overlap_compression": False})
+    assert e0._overlap_plan is None
+    assert e0._overlap_struct["overlapped_bytes"] == 0
     # non-transformer models have no hook point
     from deepspeed_tpu.analysis.contracts import _mlp_spec
 
@@ -227,12 +237,24 @@ def test_grad_overlap_lint_rule(tmp_path):
     out = lint.scan_file(str(bad), rel)
     assert any(v.rule == "grad-overlap" and "monolithic" in v.message
                for v in out), out
+    # the compressed in-loop reducer has the same contract: a rewrite
+    # that quantizes + reduces leaf-by-leaf without the shared bucketer
+    # (a monolithic quantized reduce reappearing) fails BY NAME
+    rel_ov = os.path.join("deepspeed_tpu", "runtime", "zero", "overlap.py")
+    bad_ov = tmp_path / "overlap.py"
+    bad_ov.write_text(
+        "def _compressed_bucket_reduce(leaves, error, spec, axis, inner):\n"
+        "    return [quantized_all_reduce(l, spec) for l in leaves], None\n")
+    out_ov = lint.scan_file(str(bad_ov), rel_ov)
+    assert any(v.rule == "grad-overlap" and "quantized" in v.message
+               for v in out_ov), out_ov
     # the real tree is clean (also enforced package-wide by tier-1's
     # dstpu_lint run; this pins the rule itself)
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    real = lint.scan_file(os.path.join(root, rel), rel)
-    assert not [v for v in real if v.rule == "grad-overlap"]
+    for r in (rel, rel_ov):
+        real = lint.scan_file(os.path.join(root, r), r)
+        assert not [v for v in real if v.rule == "grad-overlap"]
 
 
 # -------------------------------------------------- engine oracles (slow)
@@ -266,11 +288,14 @@ def test_overlap_bit_exact_zero3_and_prefetch(devices8):
 
 @pytest.mark.slow
 def test_overlap_bit_exact_with_int8_qgz(devices8):
-    """With qgZ the explicit bucketed reducers own the exchange and the
-    wrap stands down — the overlap flag must not change a single bit."""
+    """With qgZ + overlap_compression=False the explicit bucketed
+    reducers own the exchange and the wrap stands down — the overlap
+    flag must not change a single bit on that arm.  The DEFAULT compose
+    (compressed overlap) is covered by test_compressed_overlap_*."""
     z = {"stage": 1, "zero_quantized_gradients": True}
     l_off = _losses(_engine(dict(z)))
-    l_on = _losses(_engine(dict(z, overlap_grad_reduce=True)))
+    l_on = _losses(_engine(dict(z, overlap_grad_reduce=True,
+                                overlap_compression=False)))
     assert l_on == l_off
 
 
@@ -431,3 +456,203 @@ def test_bucketed_all_reduce_one_residual_per_bucket(devices8):
     # per-bucket residual structure is stable: feeding the residuals
     # back round-trips (shape contract of the EF API)
     assert errors[0].shape[0] == 8
+
+
+# ------------------------------------------- compressed overlap (slow)
+@pytest.mark.slow
+def test_compressed_overlap_parity_and_bucketing_zero1(devices8):
+    """THE PR-15 tentpole contract at stage 1: qgZ + overlap composes —
+    the in-loop exchange is int8 + EF, deterministic, bucketed ==
+    unbucketed BIT-EXACT (block-aligned coalescing + layout-stable
+    hop-1 residuals), and loss parity vs the fp32-overlap arm is codec-
+    sized (the PR-11 tolerance)."""
+    z = {"stage": 1, "overlap_grad_reduce": True,
+         "zero_quantized_gradients": True}
+    l_c = _losses(_engine(dict(z)))
+    l_c2 = _losses(_engine(dict(z)))
+    assert l_c == l_c2, "compressed overlap is not deterministic"
+    l_u = _losses(_engine(dict(z, overlap_bucket_mb=0)))
+    assert l_c == l_u, "bucketing changed the compressed math"
+    l_fp = _losses(_engine({"stage": 1, "overlap_grad_reduce": True}))
+    assert l_c[0] == l_fp[0], "forward must be bit-identical"
+    par = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(l_fp, l_c))
+    assert par < 0.05, (l_fp, l_c)
+
+
+@pytest.mark.slow
+def test_compressed_overlap_stage3_and_hier(devices8):
+    """Stage 3 (overlap_compression knob): the in-loop psum_scatters
+    become quantized reduce-scatters, per-leaf regardless of bucketing
+    (bit-exact), at codec-sized parity.  Hierarchical: the in-loop
+    reduce takes the three-hop shape and stays parity-close."""
+    z3 = {"stage": 3, "overlap_grad_reduce": True,
+          "zero3_param_prefetch": True, "overlap_compression": "int8"}
+    e3 = _engine(dict(z3))
+    assert e3._overlap_plan.compression is not None
+    assert sum(d is not None for d in e3._overlap_plan.gather_dims) >= 7
+    l3 = _losses(e3)
+    assert l3 == _losses(_engine(dict(z3, overlap_bucket_mb=0)))
+    l3fp = _losses(_engine({"stage": 3, "overlap_grad_reduce": True,
+                            "zero3_param_prefetch": True}))
+    par = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(l3fp, l3))
+    assert par < 0.05, (l3fp, l3)
+
+    zh = {"stage": 1, "overlap_grad_reduce": True,
+          "zero_quantized_gradients": True,
+          "zero_hierarchical_grad_reduce": True, "zero_hierarchy_inner": 2}
+    eh = _engine(dict(zh))
+    assert eh._overlap_plan.hier_inner == 2
+    lh = _losses(eh)
+    l_c = _losses(_engine({"stage": 1, "overlap_grad_reduce": True,
+                           "zero_quantized_gradients": True}))
+    par_h = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(l_c, lh))
+    assert par_h < 0.05, (l_c, lh)
+
+
+@pytest.mark.slow
+def test_compressed_overlap_in_loop_s8(devices8):
+    """The wire claim in compiled HLO: with compression on, the layer
+    loops carry s8-operand collectives and the stage<=2 per-leaf fp
+    psums are GONE from the loops (replaced by the two-hop, whose codes
+    ride all_to_all/all_gather)."""
+    from deepspeed_tpu.analysis.contracts import s8_collective_count
+
+    e = _engine({"stage": 1, "overlap_grad_reduce": True,
+                 "zero_quantized_gradients": True})
+    hlo = _hlo_of(e)
+    assert s8_collective_count(hlo) >= 1
+    on1 = _loop_collectives(hlo)
+    fp1 = _loop_collectives(_hlo_of(_engine(
+        {"stage": 1, "overlap_grad_reduce": True})))
+    # fp arm: >= 9 in-loop psums; compressed arm: the per-leaf psums are
+    # replaced by the bucket's quantized exchange (far fewer all-reduces
+    # in-loop; the remaining ones are the model's own e.g. norm/loss)
+    assert on1["all-reduce"][0] < fp1["all-reduce"][0], (on1, fp1)
+
+
+@pytest.mark.slow
+def test_compressed_overlap_resume_parity(devices8):
+    """The EF-residual lifecycle contract (chaos-drill shape): train,
+    checkpoint mid-run, resume into a FRESH engine — the residuals ride
+    TrainState.comm_errors through the checkpoint, so the post-resume
+    steps are bit-identical to an uninterrupted run."""
+    import tempfile
+
+    import numpy as _np
+
+    z = {"stage": 1, "overlap_grad_reduce": True,
+         "zero_quantized_gradients": True}
+    batches = [{"input_ids": _ids(8, seed=i)} for i in range(4)]
+    e_ctrl = _engine(dict(z))
+    ctrl = [float(e_ctrl.train_batch(b)) for b in batches]
+
+    d = tempfile.mkdtemp()
+    e1 = _engine(dict(z))
+    part1 = [float(e1.train_batch(b)) for b in batches[:2]]
+    r_saved = _np.asarray(jax.device_get(
+        e1.state.comm_errors["overlap"]["b000"]))
+    assert _np.abs(r_saved).max() > 0, "EF residual never populated"
+    e1.save_checkpoint(d, tag="mid")
+    e2 = _engine(dict(z))
+    e2.load_checkpoint(d, tag="mid")
+    r_loaded = _np.asarray(jax.device_get(
+        e2.state.comm_errors["overlap"]["b000"]))
+    assert (r_saved == r_loaded).all(), "residual round-trip not bit-exact"
+    part2 = [float(e2.train_batch(b)) for b in batches[2:]]
+    assert ctrl == part1 + part2, (ctrl, part1 + part2)
+
+
+@pytest.mark.slow
+def test_qgz_post_backward_ef_resume_parity(devices8):
+    """Same lifecycle contract for the POST-backward qgZ path
+    (grad_reduce_error_feedback): residuals live under
+    comm_errors['reduce'] and checkpoint/resume keeps the trajectory
+    bit-identical; the EF arm stays parity-close to plain qgZ."""
+    import tempfile
+
+    z = {"stage": 1, "zero_quantized_gradients": True,
+         "grad_reduce_error_feedback": True}
+    batches = [{"input_ids": _ids(8, seed=i)} for i in range(4)]
+    e_ctrl = _engine(dict(z))
+    ctrl = [float(e_ctrl.train_batch(b)) for b in batches]
+    e_q = _engine({"stage": 1, "zero_quantized_gradients": True})
+    lq = [float(e_q.train_batch(b)) for b in batches]
+    par = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(lq, ctrl))
+    assert par < 0.05, (lq, ctrl)
+
+    d = tempfile.mkdtemp()
+    e1 = _engine(dict(z))
+    part1 = [float(e1.train_batch(b)) for b in batches[:2]]
+    assert "reduce" in e1.state.comm_errors
+    e1.save_checkpoint(d, tag="mid")
+    e2 = _engine(dict(z))
+    e2.load_checkpoint(d, tag="mid")
+    part2 = [float(e2.train_batch(b)) for b in batches[2:]]
+    assert ctrl == part1 + part2, (ctrl, part1 + part2)
+
+
+@pytest.mark.slow
+def test_compressed_overlap_gauges(devices8):
+    """The residual-bytes gauge publishes and the bucket events carry
+    the compressed marker."""
+    from deepspeed_tpu.telemetry.spans import (SpanRecorder,
+                                               set_span_recorder)
+
+    rec = SpanRecorder()
+    set_span_recorder(rec)
+    try:
+        model = llama_model("tiny", max_seq_len=SEQ, vocab_size=VOCAB,
+                            n_layers=2, attn_impl="xla")
+        initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 1, "overlap_grad_reduce": True,
+                        "zero_quantized_gradients": True},
+                    "steps_per_print": 1,
+                    "telemetry": {"enabled": True}},
+            topology=deepspeed_tpu.get_topology())
+        engine.train_batch({"input_ids": _ids(8)})
+        assert engine._m_comp_residual.value() > 0
+        rep = engine.overlap_report()
+        assert rep.compression == "int8"
+        assert rep.residual_bytes > 0
+        ev = [sp for sp in rec.spans() if sp.name == "grad_bucket_reduce"]
+        assert ev and any(sp.attrs.get("compressed") for sp in ev)
+        engine.close()
+    finally:
+        set_span_recorder(None)
+
+
+@pytest.mark.slow
+def test_compressed_overlap_fp16_overflow_keeps_residuals_finite(devices8):
+    """Review finding: an fp16 overflow step must not poison the carried
+    EF residuals — the optimizer skip never touches comm_errors, so the
+    engine gates the residual update on the same finiteness signal.  The
+    2^20 initial dynamic scale overflows the first backwards;
+    the residuals must stay finite throughout and training must
+    recover once the scaler backs off."""
+    model = llama_model("tiny", max_seq_len=SEQ, vocab_size=VOCAB,
+                        n_layers=2, attn_impl="xla")
+    initialize_topology(MeshConfig(data=8), jax.devices()[:8])
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "fp16": {"enabled": True, "initial_scale_power": 20},
+                "zero_optimization": {"stage": 1,
+                                      "overlap_grad_reduce": True,
+                                      "zero_quantized_gradients": True}},
+        topology=deepspeed_tpu.get_topology())
+    for i in range(10):
+        engine.train_batch({"input_ids": _ids(8, seed=i % 6)})
+        res = np.asarray(jax.device_get(
+            engine.state.comm_errors["overlap"]["b000"]))
+        assert np.isfinite(res).all(), f"residuals poisoned at step {i}"
+    assert int(engine.state.skipped_steps) >= 1, \
+        "test premise broken: no overflow step ever happened"
+    assert int(engine.state.step) >= 1, "training never recovered"

@@ -255,7 +255,9 @@ def test_golden_contracts_hold(contracts_mod, extracted):
                      "paged_verify", "decode_multistep",
                      "train_step_zero1_hier",
                      "moe_dispatch_quantized", "train_step_zero1_overlap",
-                     "train_step_zero3_prefetch"):
+                     "train_step_zero3_prefetch",
+                     "train_step_zero1_overlap_int8",
+                     "train_step_zero3_prefetch_int8"):
         assert required in goldens, f"missing golden for {required}"
     errors = contracts_mod.diff_all(goldens, extracted)
     assert not errors, "\n".join(errors)
@@ -273,6 +275,19 @@ def test_compressed_collective_contracts_pin_wire_shape(contracts_mod,
     assert hier["all-gather"] >= 2, hier
     moe = extracted["moe_dispatch_quantized"]["contract"]["collectives"]
     assert moe["all-to-all"] >= 1, moe
+    # the compressed-overlap programs (this PR) pin s8 ON THE WIRE inside
+    # the loop: int8 codes ride combined collective ops, and the
+    # residual state is a real donated train-state leaf
+    ov1 = extracted["train_step_zero1_overlap_int8"]["contract"]
+    assert ov1["s8_collectives"] >= 1, ov1
+    assert ov1["collectives"]["all-to-all"] >= 1, ov1  # the two-hop hop 1
+    assert ov1["comm_residual_bytes"] > 0, ov1
+    ov3 = extracted["train_step_zero3_prefetch_int8"]["contract"]
+    assert ov3["s8_collectives"] >= 1, ov3
+    # the fp psum_scatters are GONE: the quantized reduce-scatter is an
+    # all_to_all of codes + scales
+    assert ov3["collectives"]["reduce-scatter"] == 0, ov3
+    assert ov3["collectives"]["all-to-all"] >= 1, ov3
 
 
 def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
@@ -294,6 +309,8 @@ def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
                                      "train_step_zero1_hier",
                                      "train_step_zero1_overlap",
                                      "train_step_zero3_prefetch",
+                                     "train_step_zero1_overlap_int8",
+                                     "train_step_zero3_prefetch_int8",
                                      "decode_multistep"])
 def test_update_goldens_idempotent(contracts_mod, extracted, tmp_path,
                                    program):

@@ -102,6 +102,25 @@ RUNGS = {
                            "DSTPU_BENCH_STAGE": "3",
                            "DSTPU_BENCH_PREFETCH": "1",
                            "DSTPU_BENCH_OVERLAP": "1"},
+    # compressed overlap (docs/COMM.md "Compressed overlap"): int8 codes
+    # + per-bucket EF residuals riding the in-loop exchange — compare
+    # against the fp 160m-zero{1,3}-overlap rungs; the wire claim is
+    # proven by bench.py --ab-overlap, these measure the wall on chip
+    "160m-zero1-overlap-int8": {"DSTPU_BENCH_SIZE": "160m",
+                                "DSTPU_BENCH_SEQ": "1024",
+                                "DSTPU_BENCH_BS": "16",
+                                "DSTPU_BENCH_STEPS": "20",
+                                "DSTPU_BENCH_STAGE": "1",
+                                "DSTPU_BENCH_OVERLAP": "1",
+                                "DSTPU_BENCH_OVERLAP_COMPRESSION": "int8"},
+    "160m-zero3-overlap-int8": {"DSTPU_BENCH_SIZE": "160m",
+                                "DSTPU_BENCH_SEQ": "1024",
+                                "DSTPU_BENCH_BS": "16",
+                                "DSTPU_BENCH_STEPS": "20",
+                                "DSTPU_BENCH_STAGE": "3",
+                                "DSTPU_BENCH_PREFETCH": "1",
+                                "DSTPU_BENCH_OVERLAP": "1",
+                                "DSTPU_BENCH_OVERLAP_COMPRESSION": "int8"},
     # optimizer offload boundary cost on hardware
     "160m-offload": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
                      "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "10",
