@@ -207,7 +207,23 @@ def build_model_and_config(size: str, seq: int, micro_bs: int, env=None,
     # same harness): mixtral routes tokens through the dropless MoE path;
     # flops_per_token counts only the active (top-k) experts
     family = env.get("DSTPU_BENCH_MODEL", "llama")
-    if family == "mixtral":
+    # pipeline rungs (docs/PIPELINE.md): DSTPU_BENCH_PIPE=P runs the
+    # 1F1B pipe scan over P stages; DSTPU_BENCH_PIPE_HOP compresses the
+    # activation hops (int8/fp8, EF on by default)
+    pipe = int(env.get("DSTPU_BENCH_PIPE", "0") or 0)
+    if pipe > 1:
+        if family != "llama":
+            raise ValueError(
+                f"DSTPU_BENCH_PIPE={pipe} supports only the llama family "
+                f"(got DSTPU_BENCH_MODEL={family!r})")
+        from deepspeed_tpu.models.llama import llama_config
+        from deepspeed_tpu.runtime.pipe.engine import pipelined_causal_lm
+
+        num_micro = int(env.get("DSTPU_BENCH_PIPE_MICRO", "4") or 4)
+        model = pipelined_causal_lm(llama_config(size, max_seq_len=seq,
+                                                 **over),
+                                    num_microbatches=num_micro)
+    elif family == "mixtral":
         from deepspeed_tpu.models.mixtral import mixtral_model
 
         # dropless: the grouped-matmul MoE path — the capacity-factor
@@ -262,8 +278,14 @@ def build_model_and_config(size: str, seq: int, micro_bs: int, env=None,
         "gradient_clipping": 1.0,
         "data_types": {"grad_accum_dtype": acc},
     }
+    if pipe > 1:
+        # pipe stages claim their axis; data absorbs the remaining chips
+        config["mesh"] = {"pipe": pipe, "data": -1}
+        if env.get("DSTPU_BENCH_PIPE_HOP"):
+            config["pipeline"] = {
+                "hop_compression": env["DSTPU_BENCH_PIPE_HOP"]}
     return model, config, {"family": family, "stage": stage,
-                           "zero_cfg": zero_cfg}
+                           "zero_cfg": zero_cfg, "pipe": pipe}
 
 
 def _run(size: str, seq: int, micro_bs: int, steps: int,
@@ -318,7 +340,9 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     dev = jax.devices()[0]
     mfu = model_flops / dt / (n_chips * _peak_for(dev))
 
-    tag = f"zero{stage}" + ("-offload" if "offload_optimizer" in zero_cfg else "")
+    tag = f"zero{stage}" \
+        + (f"-pipe{_meta['pipe']}" if _meta.get("pipe") else "") \
+        + ("-offload" if "offload_optimizer" in zero_cfg else "")
     result = {
         "metric": f"{family}-{size} bf16 {tag} tokens/sec/chip "
                   f"(seq={seq}, bs={micro_bs}, mfu={mfu:.3f})",
@@ -352,6 +376,13 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
         result["overlapped_fraction"] = round(rep.overlapped_fraction, 4)
         result["exposed_collective_seconds_per_step_est"] = round(
             rep.exposed_seconds_per_step, 6)
+    # schedule-shape provenance for pipe rungs: the bubble is structural
+    # ((P-1)/(M+P-1)), so a wall regression with an unchanged bubble is
+    # not a schedule regression
+    struct = getattr(engine, "_pipe_struct", None)
+    if struct:
+        result["pipe_bubble_fraction"] = round(struct["bubble_fraction"], 4)
+        result["pipe_stages"] = struct["stages"]
     # provenance: which program contracts (tests/contracts/*.json) this
     # result ran under — a perf claim is only comparable to another run
     # with the same contract-set hash (same collectives, same donation)
@@ -670,6 +701,170 @@ def _ab_overlap() -> None:
     print(json.dumps(out))
 
 
+def _ab_pipe() -> None:
+    """Deterministic CPU *training* tier for pipeline parallelism
+    (docs/PIPELINE.md): fixed tiny llama on the 8-virtual-device
+    harness, pinned seeds, median-of-k walls, ``comparable: true``.
+
+    Arms, at EQUAL global batch (8 rows/step):
+      * ``control`` — single-stage (pipe=1) with the pipe schedule
+        FORCED, data=2: the same scan/ppermute program shape with
+        identity hops, so any pipe-vs-control gap is the schedule's
+        math, not a different program;
+      * ``pipe2``   — 2 stages x 2 data, full-precision hops;
+      * ``int8hop`` — 2 stages x 2 data, int8 activation hops with
+        error feedback (``pipeline.hop_compression``) PLUS the
+        bubble-overlapped int8 in-scan grad reduce (stage 1 +
+        ``overlap_grad_reduce`` + ``overlap_compression``).
+
+    Machine-checked claims in the JSON:
+      * determinism — the control arm re-run from scratch reproduces
+        its loss curve bit-for-bit;
+      * ``pipe_bit_exact`` — pipe2 vs control losses are BIT-EXACT (the
+        1F1B schedule is a reassociation-free reshuffle of the same
+        microbatch math; arms share initial params by value because
+        jitted init is sharding-dependent under non-partitionable
+        threefry);
+      * ``hop_wire_reduction`` — logical/wire bytes of the compressed
+        ppermute rows from the comms logger during the int8 arm,
+        gated >= 2x;
+      * ``loss_parity_max_rel`` — int8hop vs pipe2 codec gap, < 0.05;
+      * ``bubble_fraction`` — the published (P-1)/(M+P-1) schedule
+        bubble, traceable to the ``train_step_pipe2`` golden via
+        ``contract_set_hash``.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as comm
+    from deepspeed_tpu.models.llama import llama_config
+    from deepspeed_tpu.parallel.mesh import (MeshConfig, initialize_topology,
+                                             reset_topology)
+    from deepspeed_tpu.runtime.pipe.engine import pipelined_causal_lm
+
+    steps = _int_env("DSTPU_BENCH_AB_STEPS", 6)
+    repeats = _int_env("DSTPU_BENCH_AB_REPEATS", 3)
+    seq, vocab, micro_bs, num_micro = 32, 64, 4, 2
+    cl = comm.configure_comms_logger(enabled=True)
+    ref_params = {}
+
+    def run(mesh_cfg, n_dev, extra_cfg, force_schedule=False):
+        reset_topology()
+        cl.reset()
+        topo = initialize_topology(mesh_cfg, jax.devices()[:n_dev])
+        cfg = llama_config("tiny", max_seq_len=seq, vocab_size=vocab,
+                           n_layers=2, attn_impl="xla")
+        model = pipelined_causal_lm(cfg, num_microbatches=num_micro,
+                                    force_schedule=force_schedule)
+        config = {"train_micro_batch_size_per_gpu": micro_bs,
+                  "gradient_accumulation_steps": 1,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+        config.update(extra_cfg)
+        engine, *_ = deepspeed_tpu.initialize(model=model, config=config,
+                                              topology=topo)
+        # equal-global-batch arms must share initial params BY VALUE:
+        # jitted init with out_shardings draws DIFFERENT randoms per
+        # mesh under the non-partitionable threefry
+        if not ref_params:
+            ref_params["p"] = jax.device_get(engine.state.params)
+        else:
+            shared = jax.tree_util.tree_map(
+                lambda r, p: jax.device_put(r, p.sharding),
+                ref_params["p"], engine.state.params)
+            engine.state = dataclasses.replace(engine.state, params=shared)
+        dp = engine.topology.dp_world_size
+        rng = np.random.RandomState(0)  # pinned: every arm sees one stream
+        batches = [{"input_ids": jnp.asarray(
+            rng.randint(0, vocab, (1, micro_bs * dp, seq)).astype(np.int32))}
+            for _ in range(steps)]
+        losses = [float(engine.train_batch(b)) for b in batches]
+        # hop bytes are TRACE-time: the compressed-subset columns of the
+        # ppermute rows are exactly the int8 activation hops (plain fp
+        # hops go through lax.ppermute and never log)
+        hop_rows = cl.comms_dict.get("ppermute", {})
+        hop_logical = sum(r[3] for r in hop_rows.values())
+        hop_wire = sum(r[4] for r in hop_rows.values())
+        walls = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for b in batches:
+                loss = engine.train_batch(b)
+            jax.block_until_ready(loss)
+            walls.append(time.perf_counter() - t0)
+        return {"losses": losses, "hop_logical": hop_logical,
+                "hop_wire": hop_wire,
+                "wall_median_s": sorted(walls)[len(walls) // 2],
+                "pipe_struct": getattr(engine, "_pipe_struct", None)}
+
+    ctl = run(MeshConfig(data=2), 2, {"mesh": {"data": 2}},
+              force_schedule=True)
+    ctl2 = run(MeshConfig(data=2), 2, {"mesh": {"data": 2}},
+               force_schedule=True)
+    assert ctl["losses"] == ctl2["losses"], "CPU tier is not deterministic"
+    pipe = run(MeshConfig(pipe=2, data=2), 4, {"mesh": {"pipe": 2, "data": 2}})
+    bit_exact = ctl["losses"] == pipe["losses"]
+    assert bit_exact, (
+        "pipe=2 diverged from the single-stage control at equal global "
+        "batch — the 1F1B schedule changed the math\n"
+        f"ctl:  {ctl['losses']}\npipe: {pipe['losses']}")
+    # block=64 matches the tiny model's hidden dim: the default 128-wide
+    # blocks would PAD each 64-element hop row to 128 codes and cap the
+    # measurable reduction at 1.94x on this toy — a harness artifact, not
+    # a codec property (real hidden dims are multiples of 128)
+    q = run(MeshConfig(pipe=2, data=2), 4,
+            {"mesh": {"pipe": 2, "data": 2},
+             "pipeline": {"hop_compression": {"format": "int8",
+                                              "block": 64}},
+             "zero_optimization": {"stage": 1, "overlap_grad_reduce": True,
+                                   "overlap_compression": "int8",
+                                   "overlap_bucket_mb": 1}})
+    cl.configure(enabled=False)
+    parity = max(abs(a - b) / max(abs(a), 1e-9)
+                 for a, b in zip(pipe["losses"], q["losses"]))
+    assert parity < 0.05, (
+        f"int8-hop loss gap {parity} vs the fp pipe arm exceeds the codec "
+        "tolerance")
+    hop_reduction = (q["hop_logical"] / q["hop_wire"]
+                     if q["hop_wire"] else 0.0)
+    assert hop_reduction >= 2.0, (
+        f"int8 activation hops moved only {hop_reduction:.2f}x fewer "
+        "wire bytes (< 2x): the compressed ppermute fell back to fp")
+    struct = q["pipe_struct"] or {}
+    from deepspeed_tpu.analysis.contracts import contract_set_hash
+
+    print(json.dumps({
+        "metric": "ab-pipe: 2-stage 1F1B pipeline vs single-stage control "
+                  "at equal global batch, int8 activation hops + "
+                  f"bubble-overlapped int8 grad reduce (tiny llama, "
+                  f"seq={seq}, steps={steps})",
+        "value": round(hop_reduction, 3),
+        "unit": "x wire-bytes reduction (int8 activation hops)",
+        "comparable": True,  # deterministic pinned-seed CPU tier
+        "backend": jax.default_backend(),
+        "pipe_bit_exact": bit_exact,
+        "loss_parity_max_rel": round(parity, 7),
+        "loss_parity_ok": parity < 0.05,
+        "hop_wire_reduction": round(hop_reduction, 3),
+        "hop_bytes_logical": q["hop_logical"],
+        "hop_bytes_wire": q["hop_wire"],
+        "bubble_fraction": struct.get("bubble_fraction"),
+        "stages": struct.get("stages"),
+        "num_micro": struct.get("num_micro"),
+        "final_loss_control": ctl["losses"][-1],
+        "final_loss_pipe2": pipe["losses"][-1],
+        "final_loss_int8hop": q["losses"][-1],
+        "wall_median_s": {"control": round(ctl["wall_median_s"], 4),
+                          "pipe2": round(pipe["wall_median_s"], 4),
+                          "int8hop": round(q["wall_median_s"], 4)},
+        "contract": "train_step_pipe2",
+        "contract_set_hash": contract_set_hash(
+            os.path.dirname(os.path.abspath(__file__))),
+    }))
+
+
 def _release_device_memory() -> None:
     """Free every live device array before retrying a smaller rung.
 
@@ -856,6 +1051,15 @@ if __name__ == "__main__":
                 flags + " --xla_force_host_platform_device_count=8").strip()
         _pin_cpu()
         _ab_overlap()
+    elif "--ab-pipe" in sys.argv:
+        # deterministic CPU tier: 8 virtual devices (2-stage x 2-data
+        # pipe mesh + the single-stage control), pinned platform
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        _pin_cpu()
+        _ab_pipe()
     elif "--ab-compression" in sys.argv:
         # the deterministic CPU training tier needs the 8-virtual-device
         # harness (hierarchy split of the data axis) — pin BEFORE jax loads

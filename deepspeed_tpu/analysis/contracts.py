@@ -464,6 +464,62 @@ def _train_overlap_program(stage: int, prefetch: bool = False,
             "replay": lambda: _replay_train(engine, batch)}
 
 
+def _train_pipe_program() -> Dict[str, Any]:
+    """Pipeline-parallel train step (runtime/pipe/engine.py): 2 stages x
+    2 data on 4 of the 8 virtual CPU devices, int8-compressed activation
+    hops with error feedback, and the bubble-overlapped int8 grad reduce
+    (stage 1 + overlap_grad_reduce + overlap_compression).  Pins the
+    collective-permute count (the hop ring — a lost ppermute means the
+    schedule degenerated), the s8-on-wire count (hops + in-scan bucket
+    reduces; a silent fp32 fall-back is a named diff), the donated
+    leaves (the pipe EF slot rides TrainState.comm_errors and must stay
+    donated), the computed (P-1)/(M+P-1) bubble fraction, and a 3-step
+    replay at 0 recompiles."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from ..models.llama import llama_config
+    from ..parallel.mesh import initialize_topology
+    from ..runtime.config import MeshConfig
+    from ..runtime.pipe.engine import pipelined_causal_lm
+    from ..telemetry.memory import tree_bytes
+
+    topo = initialize_topology(MeshConfig(pipe=2, data=2),
+                               jax.devices()[:4])
+    cfg = llama_config("tiny", max_seq_len=16, vocab_size=64, n_layers=2,
+                       attn_impl="xla")
+    model = pipelined_causal_lm(cfg, num_microbatches=2)
+    engine, *_ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "pipeline": {"hop_compression": "int8"},
+        "zero_optimization": {"stage": 1, "overlap_grad_reduce": True,
+                              "overlap_compression": "int8",
+                              "overlap_bucket_mb": 1},
+    }, topology=topo)
+    dp = engine.topology.dp_world_size
+    ids = np.random.RandomState(0).randint(
+        0, 64, (1, 2 * dp, 16)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids)}
+    args = (engine.state, batch, jax.random.PRNGKey(0))
+    dev_b, host_b = tree_bytes(engine.state)
+    extras = {"state_bytes_device": int(dev_b),
+              "state_bytes_host": int(host_b),
+              "pipe_bubble_fraction": round(
+                  float(engine._pipe_struct["bubble_fraction"]), 6),
+              "comm_residual_bytes": sum(
+                  int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                  for l in jax.tree_util.tree_leaves(
+                      engine.state.comm_errors))}
+    return {"fn": engine._train_batch, "args": args,
+            "mesh": engine.topology.mesh, "extras": extras,
+            "want_s8": True,
+            "replay": lambda: _replay_train(engine, batch)}
+
+
 #: name -> (builder, description).  The builder returns the dict
 #: consumed by :func:`extract_program`; descriptions land in the golden
 #: JSON so a diff reader knows what program regressed.
@@ -515,6 +571,13 @@ PROGRAM_BUILDERS: Dict[str, Tuple[Callable[[], Dict[str, Any]], str]] = {
         "overlap_compression=int8 (per-layer QUANTIZED reduce-scatters "
         "in the backward loop with per-bucket EF residuals; fp param "
         "gathers untouched)"),
+    "train_step_pipe2": (
+        _train_pipe_program,
+        "pipeline-parallel train step: 2 stages x 2 data, int8 activation "
+        "hops with error feedback through the differentiated ppermute, "
+        "bubble-overlapped int8 layer-bucket grad reduce inside the pipe "
+        "scan; pins permute count, s8-on-wire count, donated EF slot, "
+        "(P-1)/(M+P-1) bubble fraction, replay recompiles == 0"),
     "moe_dispatch_quantized": (
         _moe_dispatch_program,
         "expert-parallel dropless MoE dispatch with int8-quantized "
@@ -609,7 +672,8 @@ def diff_contract(name: str, golden: Dict[str, Any],
                     "(every caller recompiles)")
     for field in ("state_bytes_device", "state_bytes_host", "param_bytes",
                   "kv_pool_bytes", "overlap_buckets", "overlapped_fraction",
-                  "s8_collectives", "comm_residual_bytes"):
+                  "s8_collectives", "comm_residual_bytes",
+                  "pipe_bubble_fraction"):
         if field in g or field in n:
             a, b = g.get(field), n.get(field)
             if a != b:

@@ -89,8 +89,10 @@ ALLOW_RE = re.compile(
 HOT_ROOTS: Dict[str, Set[str]] = {
     os.path.join("deepspeed_tpu", "runtime", "engine.py"):
         {"train_batch", "forward", "backward", "step", "eval_batch"},
+    # the pipe tick body runs T = M + P - 1 times inside the step scan —
+    # a host sync there serializes EVERY tick, not just the step boundary
     os.path.join("deepspeed_tpu", "runtime", "pipe", "engine.py"):
-        {"train_batch"},
+        {"train_batch", "_pipe_body"},
     os.path.join("deepspeed_tpu", "inference", "engine.py"):
         {"generate", "forward"},
     os.path.join("deepspeed_tpu", "inference", "v2", "engine_v2.py"):
@@ -130,6 +132,7 @@ SHARDING_FILES = (
     os.path.join("deepspeed_tpu", "comm", "collectives", "compressed.py"),
     os.path.join("deepspeed_tpu", "comm", "collectives", "hierarchical.py"),
     os.path.join("deepspeed_tpu", "runtime", "zero", "overlap.py"),
+    os.path.join("deepspeed_tpu", "runtime", "pipe", "overlap.py"),
     os.path.join("deepspeed_tpu", "utils", "groups.py"),
 )
 
@@ -450,6 +453,13 @@ _GRAD_OVERLAP_CONTRACTS: Dict[str, Tuple[str, Set[str], str]] = {
         "through the shared bucketer (comm/collectives/bucketer.py) — a "
         "monolithic per-leaf quantized reduce reappeared inside the "
         "overlap hook"),
+    os.path.join("deepspeed_tpu", "runtime", "pipe", "overlap.py"): (
+        "reduce_stage_grads",
+        {"bucketed_map", "assign_buckets", "coalesce_flat"},
+        "the pipe in-scan stage-grad reducer no longer routes leaves "
+        "through the shared bucketer (comm/collectives/bucketer.py) — "
+        "the bubble-overlapped pipeline grad reduce regressed to one "
+        "monolithic fp post-backward all-reduce"),
 }
 
 

@@ -111,6 +111,16 @@ class TransformerConfig:
     #: reduce inside the backward loop (and, under zero3_prefetch,
     #: whose fwd forces the param gathers at the scan-body top)
     overlap_plan: Optional[Any] = None
+    #: pipe activation-hop codec (engine-set per trace, like overlap_plan):
+    #: a CompressionSpec routing the per-tick ``ppermute`` (and its
+    #: backward-wave transpose) through the quantized collective verbs
+    #: (comm/collectives/compressed.py); None = exact fp hop
+    pipe_hop_spec: Optional[Any] = None
+    #: bubble-overlapped pipe grad reduce (engine-set per trace): a
+    #: runtime/pipe/overlap.PipeOverlapPlan hooking each tick's stage
+    #: apply so the per-stage layer-bucket grad reduces ride inside the
+    #: pipe scan (drain-tick bubbles are free comm time)
+    pipe_overlap_plan: Optional[Any] = None
     # PR-MoE residual experts (reference moe/layer.py use_residual): a dense
     # MLP runs beside the MoE and a learned 2-way coefficient mixes them
     moe_use_residual: bool = False

@@ -245,6 +245,32 @@ class MeshConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class PipelineConfig(ConfigModel):
+    """``pipeline`` block: knobs for the scan-based pipe schedule
+    (runtime/pipe/, docs/PIPELINE.md).
+
+    ``hop_compression`` puts the per-tick activation ``ppermute`` (and
+    its backward-wave transpose) on a quantized wire — "int8"/"fp8", a
+    dict ({"format", "block", "error_feedback", "compress_backward"}),
+    or None/False for the exact fp hop.  Error feedback on the backward
+    hop defaults ON (residuals live in ``TrainState.comm_errors["pipe"]``
+    and follow the checkpoint/donation lifecycle contract); pass
+    ``{"error_feedback": false}`` explicitly to run straight-through.
+    """
+
+    hop_compression: Any = None
+
+    def validate(self) -> None:
+        if self.hop_compression not in (None, False):
+            from ..comm.collectives.codec import CompressionSpec
+
+            try:
+                CompressionSpec.parse(self.hop_compression)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"pipeline.hop_compression: {e}") from e
+
+
+@dataclasses.dataclass
 class ActivationCheckpointingConfig(ConfigModel):
     partition_activations: bool = False
     cpu_checkpointing: bool = False
@@ -548,6 +574,7 @@ class DeepSpeedConfig:
     optimizer: OptimizerConfig
     scheduler: SchedulerConfig
     mesh: MeshConfig
+    pipeline: PipelineConfig
     activation_checkpointing: ActivationCheckpointingConfig
     flops_profiler: FlopsProfilerConfig
     comms_logger: CommsLoggerConfig
@@ -601,6 +628,7 @@ class DeepSpeedConfig:
         self.optimizer = OptimizerConfig.from_dict(g("optimizer"))
         self.scheduler = SchedulerConfig.from_dict(g("scheduler"))
         self.mesh = MeshConfig.from_dict(g("mesh"))
+        self.pipeline = PipelineConfig.from_dict(g("pipeline"))
         self.activation_checkpointing = ActivationCheckpointingConfig.from_dict(
             g("activation_checkpointing"))
         self.flops_profiler = FlopsProfilerConfig.from_dict(g("flops_profiler"))

@@ -97,6 +97,7 @@ class DeepSpeedTPUEngine:
         self.zero_plan = ZeroShardingPlan(self.topology, config.zero_config,
                                           self.model.partition_rules())
         self._configure_zeropp(config)
+        self._configure_pipeline(config)
         self.compute_dtype = config.compute_dtype
         self.grad_accum_dtype = {
             "fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16,
@@ -361,6 +362,64 @@ class DeepSpeedTPUEngine:
             self._overlap_spec = CompressionSpec(format="int8",
                                                  error_feedback=True)
 
+    def _pipe_schedule_active(self) -> bool:
+        """True when the model runs the scan-based pipe schedule
+        (runtime/pipe/engine.py) on this engine: a pipe ModelSpec on a
+        pipe>1 mesh, or one pinned to the schedule at pipe=1
+        (``force_schedule`` — the --ab-pipe control arm)."""
+        return (getattr(self.model, "num_microbatches", None) is not None
+                and (self.topology.pipe_parallel_size > 1
+                     or getattr(self.model, "pipe_force_schedule", False)))
+
+    def _configure_pipeline(self, config: DeepSpeedConfig) -> None:
+        """Pipe perf wiring (docs/PIPELINE.md): resolve the
+        ``pipeline.hop_compression`` codec for the per-tick activation
+        ``ppermute`` (EF + compress_backward default ON — the explicit
+        dict key or a prebuilt spec is the opt-out) and the structural
+        schedule numbers (``bubble_fraction`` = (P-1)/(M+P-1)) the
+        telemetry layer publishes."""
+        self._pipe_hop_spec = None
+        self._pipe_struct = None
+        sched = self._pipe_schedule_active()
+        raw = config.pipeline.hop_compression
+        if raw not in (None, False):
+            if not sched:
+                logger.warning(
+                    "pipeline.hop_compression is set but no pipe scan "
+                    "schedule is active (pipe="
+                    f"{self.topology.pipe_parallel_size}, model="
+                    f"{type(self.model).__name__}); ignoring")
+            else:
+                from ..comm.collectives.codec import CompressionSpec
+
+                spec = CompressionSpec.parse(raw)
+                explicit = isinstance(raw, CompressionSpec)
+                if not explicit and not (isinstance(raw, dict)
+                                         and "error_feedback" in raw):
+                    spec = dataclasses.replace(spec, error_feedback=True)
+                if not explicit and not (isinstance(raw, dict)
+                                         and "compress_backward" in raw):
+                    # both waves ride the codec: the backward-wave
+                    # transpose moves the same activation bytes
+                    spec = dataclasses.replace(spec, compress_backward=True)
+                self._pipe_hop_spec = spec
+                log_dist(f"pipe hop compression: {spec.format} activation "
+                         "hops"
+                         + (" + EF" if spec.error_feedback else ""))
+        if sched:
+            pp = self.topology.pipe_parallel_size
+            M = int(self.model.num_microbatches)
+            spec = self._pipe_hop_spec
+            self._pipe_struct = {
+                "stages": pp,
+                "num_micro": M,
+                "bubble_fraction": (pp - 1) / (M + pp - 1),
+                "hop_compression": (spec.format if spec is not None
+                                    else None),
+                "hop_error_feedback": bool(spec is not None
+                                           and spec.error_feedback),
+            }
+
     def _overlap_unsupported_reason(self) -> Optional[str]:
         """Why the overlap wrap cannot apply on this engine (None = ok).
 
@@ -375,8 +434,38 @@ class DeepSpeedTPUEngine:
         if not (isinstance(params, dict) and "layers" in params
                 and mc is not None and hasattr(mc, "overlap_plan")):
             return "needs a models/* transformer (stacked layer tree)"
-        if self.topology.pipe_parallel_size != 1:
-            return "pipeline parallelism is not supported"
+        pipe_sched = self._pipe_schedule_active()
+        if self.topology.pipe_parallel_size != 1 and not pipe_sched:
+            return ("pipe: pipeline parallelism without the pipe scan "
+                    "schedule (runtime/pipe) has no in-scan reduce point")
+        if pipe_sched:
+            # the pipe variant (runtime/pipe/overlap.py): per-tick
+            # stage-grad reduces ride inside the pipe scan.  Supported:
+            # ZeRO <= 1 pure pipe x data with a dense models/* core.
+            from ..parallel.mesh import MODEL_AXIS
+            zc = self.config.zero_config
+            if zc.stage >= 2:
+                return (f"pipe: ZeRO stage {zc.stage} shards gradients "
+                        "over data, but the in-scan pipe reduce delivers "
+                        "full replicated layer grads (supported: stage <= 1)")
+            if self._qgz or self._hier_inner:
+                return ("pipe: the qgZ/hierarchical explicit reducers do "
+                        "not compose with the in-scan pipe reduce")
+            if getattr(mc, "moe_experts", 0):
+                return ("pipe: MoE expert axes do not compose with the "
+                        "in-scan pipe reduce")
+            others = [(a, self.topology.axis_size(a))
+                      for a in (REPL_AXIS, EXPERT_AXIS, SEQ_AXIS)]
+            if any(s != 1 for _a, s in others):
+                return ("pipe: the in-scan reduce needs pipe x data only "
+                        f"batch parallelism (got {dict(others)})")
+            if (self.topology.axis_size(MODEL_AXIS) > 1
+                    or self.topology.axis_size(SEQ_AXIS) > 1):
+                return ("pipe: TP/SP runs the pipe body partial-manual; "
+                        "the in-scan reduce needs the fully manual body")
+            if self.topology.axis_size(DATA_AXIS) <= 1:
+                return "data axis is 1: there is no grad exchange to overlap"
+            return None
         others = [(a, self.topology.axis_size(a))
                   for a in (REPL_AXIS, EXPERT_AXIS, SEQ_AXIS)]
         if any(s != 1 for _a, s in others):
@@ -416,6 +505,7 @@ class DeepSpeedTPUEngine:
         ``_exposed_collective_seconds``)."""
         self._overlap_plan = None
         self._overlap_struct = None
+        self._pipe_plan = None
         zc = self.config.zero_config
         wanted = bool(zc.overlap_grad_reduce
                       or (getattr(self, "_zero3_prefetch", False)
@@ -432,7 +522,28 @@ class DeepSpeedTPUEngine:
                 "enable overlap_grad_reduce to compose")
         if wanted and reason is not None:
             logger.warning(f"compute/collective overlap disabled: {reason}")
-        if wanted and reason is None:
+        if wanted and reason is None and self._pipe_schedule_active():
+            # pipe variant (runtime/pipe/overlap.py): per-tick stage-grad
+            # reduces inside the pipe scan; composes with
+            # overlap_compression (the bucketed exchange moves codes).
+            # EF stays with the HOP residual slot — the straight-through
+            # bucket reduce keeps one owner per comm_errors key.
+            from .pipe.overlap import build_pipe_overlap_plan
+
+            comp = self._overlap_spec
+            if comp is not None and comp.error_feedback:
+                comp = dataclasses.replace(comp, error_feedback=False)
+            self._pipe_plan = build_pipe_overlap_plan(
+                self.topology, jax.eval_shape(lambda: params["layers"]),
+                bucket_bytes=int(zc.overlap_bucket_mb * 2**20),
+                num_micro=int(self.model.num_microbatches),
+                grad_dtype=self.grad_accum_dtype,
+                compression=comp)
+            if self._pipe_plan is not None:
+                from ..compile.backend import validate_latency_hiding_flags
+
+                validate_latency_hiding_flags()
+        elif wanted and reason is None:
             from ..parallel.mesh import DATA_AXIS
             from .zero.overlap import build_overlap_plan
 
@@ -460,8 +571,9 @@ class DeepSpeedTPUEngine:
         ) * itemsize
         total_bytes = sum(
             l.size for l in jax.tree_util.tree_leaves(params)) * itemsize
-        covered = layer_bytes if self._overlap_plan is not None else 0
-        plan = self._overlap_plan
+        plan = self._overlap_plan if self._overlap_plan is not None \
+            else self._pipe_plan
+        covered = layer_bytes if plan is not None else 0
         comp = plan.compression if plan is not None else None
         self._overlap_struct = {
             "total_bytes": int(total_bytes),
@@ -470,7 +582,8 @@ class DeepSpeedTPUEngine:
             "buckets": (len(plan.buckets) if plan is not None else 0),
             "compression": (comp.format if comp is not None else None),
             "residual_bytes": (plan.residual_bytes()
-                               if comp is not None else 0),
+                               if comp is not None
+                               and hasattr(plan, "residual_bytes") else 0),
         }
 
     def _init_comm_errors(self) -> None:
@@ -486,11 +599,57 @@ class DeepSpeedTPUEngine:
         plan = getattr(self, "_overlap_plan", None)
         if plan is not None and plan.error_feedback:
             errors["overlap"] = plan.init_errors()
+        hop_spec = getattr(self, "_pipe_hop_spec", None)
+        if hop_spec is not None and hop_spec.error_feedback:
+            pipe_errors = self._init_pipe_hop_errors()
+            if pipe_errors is not None:
+                errors["pipe"] = pipe_errors
         reduce_errors = self._init_reduce_errors()
         if reduce_errors:
             errors["reduce"] = reduce_errors
         if errors:
             self.state = dataclasses.replace(self.state, comm_errors=errors)
+
+    def _init_pipe_hop_errors(self):
+        """EF residual slot for the compressed pipe activation hop
+        (``comm_errors["pipe"]``): global ``[pp, Dw, T, b, S, H]`` fp32
+        split over pipe x data — per tick, each device's own hop
+        residual.  Shapes come from the config (``b`` = per-device
+        micro batch / num_microbatches, ``S`` = max_seq_len): training
+        batches must arrive at exactly that shape for EF to engage
+        (docs/PIPELINE.md); on mismatch the hop runs straight-through
+        for the step with a one-time warning."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS, PIPE_AXIS
+
+        mc = getattr(self.model, "config", None)
+        M = getattr(self.model, "num_microbatches", None)
+        mbs = self.config.train_micro_batch_size_per_gpu
+        if mc is None or M is None:
+            return None
+        if not mbs or mbs % int(M) != 0:
+            logger.warning(
+                "pipe: hop error feedback disabled — "
+                f"train_micro_batch_size_per_gpu ({mbs}) must divide into "
+                f"num_microbatches ({M}) to size the per-tick residual "
+                "slot; the hop runs straight-through")
+            self._pipe_hop_spec = dataclasses.replace(
+                self._pipe_hop_spec, error_feedback=False)
+            if self._pipe_struct is not None:
+                self._pipe_struct["hop_error_feedback"] = False
+            return None
+        pp = self.topology.pipe_parallel_size
+        W = self.topology.axis_size(DATA_AXIS)
+        T = int(M) + pp - 1
+        b = int(mbs) // int(M)
+        S, H = int(mc.max_seq_len), int(mc.hidden_size)
+        # batch-shape gate for _micro_grads: EF only engages when the
+        # traced batch matches the residual layout
+        self._pipe_eslot_batch = (int(mbs) * self.topology.dp_world_size, S)
+        sh = NamedSharding(self.topology.mesh, P(PIPE_AXIS, DATA_AXIS))
+        return jax.device_put(
+            jnp.zeros((pp, W, T, b, S, H), jnp.float32), sh)
 
     def _init_reduce_errors(self):
         """Residual layout for the POST-backward qgZ / hierarchical EF
@@ -637,17 +796,25 @@ class DeepSpeedTPUEngine:
         has_q = mc is not None and hasattr(mc, "qwz")
         has_pf = mc is not None and hasattr(mc, "zero3_prefetch")
         has_ov = mc is not None and hasattr(mc, "overlap_plan")
-        if not (has_q or has_pf or has_ov):
+        has_hop = mc is not None and hasattr(mc, "pipe_hop_spec")
+        has_pp = mc is not None and hasattr(mc, "pipe_overlap_plan")
+        if not (has_q or has_pf or has_ov or has_hop or has_pp):
             return self.model.loss_fn(p, batch, rng)
         old_q = mc.qwz if has_q else None
         old_pf = mc.zero3_prefetch if has_pf else None
         old_ov = mc.overlap_plan if has_ov else None
+        old_hop = mc.pipe_hop_spec if has_hop else None
+        old_pp = mc.pipe_overlap_plan if has_pp else None
         if has_q:
             mc.qwz = self._qwz
         if has_pf:
             mc.zero3_prefetch = getattr(self, "_zero3_prefetch", False)
         if has_ov:
             mc.overlap_plan = getattr(self, "_overlap_plan", None)
+        if has_hop:
+            mc.pipe_hop_spec = getattr(self, "_pipe_hop_spec", None)
+        if has_pp:
+            mc.pipe_overlap_plan = getattr(self, "_pipe_plan", None)
         try:
             return self.model.loss_fn(p, batch, rng)
         finally:
@@ -657,6 +824,10 @@ class DeepSpeedTPUEngine:
                 mc.zero3_prefetch = old_pf
             if has_ov:
                 mc.overlap_plan = old_ov
+            if has_hop:
+                mc.pipe_hop_spec = old_hop
+            if has_pp:
+                mc.pipe_overlap_plan = old_pp
 
     def _fetch_params(self, master_params):
         """Host-offloaded masters (offload_param): stream them into device
@@ -697,7 +868,43 @@ class DeepSpeedTPUEngine:
 
         new_comm = None
         plan = getattr(self, "_overlap_plan", None)
-        if plan is not None and plan.compression is not None:
+        pipe_plan = getattr(self, "_pipe_plan", None)
+        hop_spec = getattr(self, "_pipe_hop_spec", None)
+        pipe_ef = hop_spec is not None and hop_spec.error_feedback \
+            and "pipe" in (state.comm_errors or {})
+        if pipe_ef:
+            ids = batch["input_ids"] if isinstance(batch, dict) else batch
+            if tuple(ids.shape[:2]) != getattr(self, "_pipe_eslot_batch",
+                                               tuple(ids.shape[:2])):
+                from ..utils.logging import warning_once
+
+                warning_once(
+                    f"pipe: batch shape {tuple(ids.shape[:2])} does not "
+                    "match the hop-EF residual layout "
+                    f"{self._pipe_eslot_batch}; the hop runs "
+                    "straight-through for this step")
+                pipe_ef = False
+        if pipe_plan is not None or pipe_ef:
+            # pipe comm channels (runtime/pipe/overlap.py module
+            # docstring): "g" carries each tick's reduced stage gradient
+            # out as its cotangent; "e" carries the hop-EF residuals
+            # (in: last step's, out-cotangent: this step's)
+            p2 = dict(compute_params)
+            comm_in = {}
+            if pipe_plan is not None:
+                comm_in["g"] = pipe_plan.grad_slots()
+            if pipe_ef:
+                comm_in["e"] = state.comm_errors["pipe"]
+            p2["_pipe_comm"] = comm_in
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(p2)
+            grads = dict(grads)
+            comm_g = grads.pop("_pipe_comm")
+            if pipe_plan is not None:
+                grads["layers"] = pipe_plan.merge_grads(comm_g["g"])
+            if pipe_ef:
+                new_comm = dict(state.comm_errors)
+                new_comm["pipe"] = comm_g["e"]
+        elif plan is not None and plan.compression is not None:
             # compressed overlap (docs/COMM.md "Compressed overlap"): the
             # in-loop hook owns the layer-grad exchange.  The gslot/eslot
             # channels enter as differentiable params-tree leaves; their
@@ -1564,6 +1771,11 @@ class DeepSpeedTPUEngine:
             "cumulative ESTIMATED seconds of exposed (non-overlapped) "
             "gradient collectives: wire bytes x bus factor over the "
             "nominal per-generation interconnect bandwidth")
+        self._m_pipe_bubble = reg.gauge(
+            "deepspeed_tpu_train_pipe_bubble_fraction",
+            "structural share of pipe-schedule ticks that are warm-up/"
+            "drain bubbles, (P-1)/(M+P-1); 0 when no pipe schedule runs "
+            "(docs/PIPELINE.md)")
         self._m_comp_residual = reg.gauge(
             "deepspeed_tpu_comm_compression_residual_bytes",
             "bytes of compressed-collective error-feedback residual "
@@ -1730,6 +1942,10 @@ class DeepSpeedTPUEngine:
             if self._win_steps > 0:
                 self._m_exposed.inc(
                     report.exposed_seconds_per_step * self._win_steps)
+        # structural (schedule-derived, no sync): pipe bubble share
+        pipe_struct = getattr(self, "_pipe_struct", None)
+        if pipe_struct is not None:
+            self._m_pipe_bubble.set(pipe_struct["bubble_fraction"])
         # structural (shape-derived, no sync): EF residual state bytes
         self._m_comp_residual.set(sum(
             int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize

@@ -138,12 +138,26 @@ class PipelineModule:
                  num_microbatches: int = 4,
                  partition_method: str = "parameters",
                  seed_layers: bool = False,
-                 checkpoint_ticks: bool = True):
+                 checkpoint_ticks: bool = True,
+                 hop_compression: Any = None):
         self.layers = list(layers)
         self.user_loss_fn = loss_fn
         self.num_microbatches = num_microbatches
         self.partition_method = partition_method
         self.checkpoint_ticks = checkpoint_ticks
+        # stage-boundary activations move as int8/fp8 codes + block scales
+        # (comm/collectives/compressed.ppermute) instead of full-width fp;
+        # same knob surface as pipeline.hop_compression on the transformer
+        # pipe path (docs/PIPELINE.md).  EF residual state needs the engine's
+        # comm_errors lifecycle, so the generic module keeps the stateless
+        # verb (backward hop compressed per spec.compress_backward).
+        if hop_compression:
+            from ...comm.collectives.codec import CompressionSpec
+            self.hop_spec = (hop_compression
+                             if isinstance(hop_compression, CompressionSpec)
+                             else CompressionSpec.parse(hop_compression))
+        else:
+            self.hop_spec = None
         topo = get_topology()
         self.num_stages = num_stages or topo.pipe_parallel_size
         if topo.pipe_parallel_size not in (1, self.num_stages):
@@ -446,8 +460,11 @@ class PipelineModule:
                     jnp.zeros(last_struct.shape, last_struct.dtype))
 
         branches = [functools.partial(branch, g) for g in range(pp)]
-        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        perm = tuple((i, (i + 1) % pp) for i in range(pp))
         T = M + pp - 1
+        hop_spec = self.hop_spec
+        if hop_spec is not None:
+            from ...comm.collectives import compressed as _cc
 
         def tick(carry, t):
             buf, loss_acc = carry
@@ -457,10 +474,19 @@ class PipelineModule:
             valid = jnp.logical_and(stage == pp - 1,
                                     jnp.logical_and(mb_out >= 0, mb_out < M))
             y = ys_mb[jnp.clip(mb_out, 0, M - 1)]
+            # RANK-1 [1] accumulator: grad partial-eval saves known-side
+            # scalars as residuals, and the check_vma=False shard_map
+            # transpose stacks residuals over a leading device dim —
+            # rank-0 residuals fail its spec check (broke every pipe
+            # backward before PR 16; see runtime/pipe/engine.py)
             loss_t = jax.lax.cond(
-                valid, lambda: self.user_loss_fn(out, y).astype(jnp.float32),
-                lambda: jnp.asarray(0.0, jnp.float32))
-            buf = jax.lax.ppermute(ring, PIPE_AXIS, perm)
+                valid,
+                lambda: self.user_loss_fn(out, y).astype(jnp.float32).reshape(1),
+                lambda: jnp.zeros((1,), jnp.float32))
+            if hop_spec is not None:
+                buf = _cc.ppermute(ring, perm, PIPE_AXIS, hop_spec)
+            else:
+                buf = jax.lax.ppermute(ring, PIPE_AXIS, perm)
             return (buf, loss_acc + loss_t), None
 
         buf0 = jnp.zeros(ring_shape, ring_dtype)
@@ -472,11 +498,11 @@ class PipelineModule:
         tick_fn = (jax.checkpoint(tick, prevent_cse=False)
                    if self.checkpoint_ticks else tick)
         (_, loss), _ = jax.lax.scan(
-            tick_fn, (buf0, jnp.asarray(0.0, jnp.float32)), jnp.arange(T))
+            tick_fn, (buf0, jnp.zeros((1,), jnp.float32)), jnp.arange(T))
         loss = jax.lax.psum(loss, PIPE_AXIS) / M
         for ax in BATCH_AXES:
             loss = jax.lax.pmean(loss, ax)
-        return loss
+        return loss[0]
 
     def loss_fn(self, params, batch, rng=None):
         del rng

@@ -1,0 +1,200 @@
+"""Bubble-overlapped gradient reduce for the scan-based pipe schedule.
+
+The pipe schedule's backward wave produces ONE stage-gradient
+contribution per tick (micro-batch), and the warm-up/drain ticks are
+bubbles — (P-1)/(M+P-1) of the schedule where a stage's compute sits
+idle.  Today the data-axis gradient exchange for pipelined training is
+the monolithic post-backward psum GSPMD places at the shard_map
+boundary: every byte of it is exposed, serialized after the whole
+backward scan.
+
+This module moves the exchange INSIDE the scan, the pipe analogue of
+``runtime/zero/overlap.py``: a ``custom_vjp`` hook around each tick's
+stage apply (installed by ``_pipe_body``) reduces that tick's per-stage
+layer cotangents over the data axis right where they materialize — the
+latency-hiding scheduler can slide each tick's reduce under the next
+tick's backward compute, and the drain-tick reduces (exact zeros from
+the bubble's masked loss) are pure free comm time.  With a
+``CompressionSpec`` the per-tick exchange rides the shared compressed
+two-hop all-reduce — int8/fp8 codes + block scales on the wire.
+
+Channel discipline (the gslot pattern, zero/overlap.py module
+docstring): the reduced flat payload cannot cross the shard_map
+boundary as a layer-leaf cotangent — a replicated input's transpose is
+a full-width fp ``psum``, exactly the bytes being hidden — so the body
+``stop_gradient``s the layer leaves (symbolic-zero boundary cotangent,
+no psum emitted) and the hook returns each tick's reduced payload as
+the cotangent of a zeros scan-xs input (``_pipe_comm["g"]``, global
+``[pp, Dw, T, F]`` split over pipe x data).  Every data rank's row
+holds the identical post-reduce value, so the engine-side merge is a
+LOCAL sum over ticks + split — no collective.
+
+Trade-off (docs/PIPELINE.md): per-tick reduction exchanges each
+micro-batch's contribution instead of the accumulated sum — M x the
+monolithic bytes, bought back by compression (int8 is 4x smaller) and
+by riding otherwise-dead bubble latency.  The backward scan cannot do
+better in-loop: a stage's ACCUMULATED gradient is only complete at the
+final backward tick.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...comm.collectives.bucketer import (assign_buckets, bucketed_map,
+                                          coalesce_flat, split_flat)
+from ...comm.collectives.codec import CompressionSpec
+from ...parallel.mesh import DATA_AXIS, PIPE_AXIS
+from ...utils.logging import logger
+from ..zero.overlap import _record_bucket_reduce
+
+
+class PipeOverlapPlan:
+    """Static (trace-time) description of the in-scan pipe grad reduce.
+
+    Built once per engine from the abstract stacked layer tree; passed
+    to the model per trace (``TransformerConfig.pipe_overlap_plan``,
+    the same engine-set-per-trace pattern as ``overlap_plan``).
+    Hashable by identity — it only ever rides closures."""
+
+    def __init__(self, mesh, treedef, local_shapes: Sequence[Tuple[int, ...]],
+                 buckets: Sequence[Sequence[int]],
+                 bucket_bytes: Sequence[int], num_ticks: int,
+                 compression: Optional[CompressionSpec] = None):
+        self.mesh = mesh
+        self.axis = DATA_AXIS
+        self.treedef = treedef
+        #: per-stage (LOCAL, [L/pp, ...]) leaf shapes in flatten order
+        self.local_shapes = tuple(tuple(s) for s in local_shapes)
+        self.buckets = tuple(tuple(b) for b in buckets)
+        self.bucket_bytes = tuple(int(b) for b in bucket_bytes)
+        self.num_ticks = int(num_ticks)
+        #: per-tick exchange codec (None = exact fp psum per bucket)
+        self.compression = compression
+        self.align = compression.block if compression is not None else 0
+        # the flat [F] payload layout: coalesce_flat of the per-stage
+        # leaves in flatten order, leaf-padded to the codec block so the
+        # bucketed exchange stays bit-exact vs unbucketed
+        self.layout: List[Tuple[int, Tuple[int, ...]]] = []
+        off = 0
+        for shape in self.local_shapes:
+            n = int(np.prod(shape or (1,)))
+            self.layout.append((off, tuple(shape)))
+            pad = (-n) % self.align if self.align > 0 else 0
+            off += n + pad
+        self.flat_size = off
+
+    # ------------------------------------------------------- comm channel
+    def grad_slots(self):
+        """The in-trace zeros gslot (the reduced-gradient cotangent
+        channel): global ``[pp, Dw, T, F]`` fp32 split over pipe x data;
+        rebuilt every step — the gslot carries no state."""
+        pp = int(self.mesh.shape[PIPE_AXIS])
+        W = int(self.mesh.shape[self.axis])
+        sh = NamedSharding(self.mesh, P(PIPE_AXIS, self.axis))
+        return jax.lax.with_sharding_constraint(
+            jnp.zeros((pp, W, self.num_ticks, self.flat_size), jnp.float32),
+            sh)
+
+    def reduce_stage_grads(self, dlayers: Any):
+        """Inside the hook's bwd (per backward scan trip): reduce this
+        tick's per-stage layer cotangents over the data axis — one
+        coalesced exchange per layer bucket via the shared
+        coalesce -> reduce -> split pipeline (``bucketer.bucketed_map``,
+        lint: ``grad-overlap``) — and re-coalesce the reduced leaves
+        into the flat ``[F]`` gslot payload."""
+        from ...comm.collectives import compressed as _cc
+
+        spec = self.compression
+
+        def reduce_flat(flat, k):
+            if spec is not None:
+                red = _cc.all_reduce(flat, op="sum", axis=self.axis,
+                                     spec=spec, out_dtype=jnp.float32)
+            else:
+                red = jax.lax.psum(flat, self.axis)
+            _record_bucket_reduce(
+                self.bucket_bytes[k] * self.num_ticks, k,
+                len(self.buckets[k]), compressed=spec is not None,
+                format=spec.format if spec is not None else None)
+            return red
+
+        leaves = self.treedef.flatten_up_to(dlayers)
+        reduced = bucketed_map(leaves, 0, reduce_flat,
+                               out_dtype=jnp.float32, buckets=self.buckets,
+                               align=self.align)
+        flat, layout = coalesce_flat(reduced, align=self.align)
+        assert [o for o, _ in layout] == [o for o, _ in self.layout], \
+            "pipe overlap: per-tick payload layout drifted from the plan"
+        return flat
+
+    def merge_grads(self, gslot_ct: Any) -> Any:
+        """Engine-side (in-trace, post-``jax.grad``): turn the gslot
+        cotangent (``[pp, Dw, T, F]``, every data rank's row identical
+        post-reduce) into the stacked layer-grad tree.  LOCAL per
+        device: sum the tick payloads, split into per-stage leaves —
+        out_specs claim pipe partitioning + data replication, so no
+        collective is emitted."""
+        from ...utils.jax_compat import shard_map
+
+        plan = self
+
+        def collapse(g):
+            flat = g[0, 0].sum(0)  # [F]: ticks accumulate locally
+            return tuple(split_flat(flat, plan.layout,
+                                    [jnp.float32] * len(plan.layout)))
+
+        out_specs = tuple(
+            P(*((PIPE_AXIS,) + (None,) * (len(shape) - 1)))
+            for shape in self.local_shapes)
+        sm = shard_map(
+            collapse, mesh=self.mesh,
+            in_specs=(P(PIPE_AXIS, self.axis, None, None),),
+            out_specs=out_specs, check_vma=False,
+            axis_names={PIPE_AXIS, self.axis})
+        leaves = sm(gslot_ct)
+        return jax.tree_util.tree_unflatten(self.treedef, list(leaves))
+
+
+def build_pipe_overlap_plan(topology, abstract_layers: Any, *,
+                            bucket_bytes: int, num_micro: int,
+                            grad_dtype=jnp.float32,
+                            compression: Optional[CompressionSpec] = None
+                            ) -> Optional[PipeOverlapPlan]:
+    """Derive the in-scan reduce plan from the stacked layer tree.
+
+    ``abstract_layers``: ``state.params["layers"]`` (stacked, leading
+    dim = n_layers, sharded over pipe) — shapes/dtypes only.  Buckets
+    are assigned over the per-stage (local) leaf slices, the unit the
+    per-tick reduce actually moves."""
+    flat, treedef = jax.tree_util.tree_flatten(abstract_layers)
+    if not flat:
+        return None
+    pp = topology.pipe_parallel_size
+    T = num_micro + pp - 1
+    grad_itemsize = np.dtype(grad_dtype).itemsize
+    local_shapes, sizes = [], []
+    for leaf in flat:
+        shape = tuple(leaf.shape)
+        if not shape or shape[0] % pp != 0:
+            logger.warning(
+                "pipe overlap disabled: layer leaf shape "
+                f"{shape} does not stack evenly over pipe={pp}")
+            return None
+        local_shapes.append((shape[0] // pp,) + shape[1:])
+        sizes.append(int(np.prod(local_shapes[-1])) * grad_itemsize)
+    buckets = assign_buckets(sizes, bucket_bytes)
+    bucket_sizes = [sum(sizes[i] for i in b) for b in buckets]
+    logger.info(
+        f"pipe overlap plan: {len(flat)} layer leaves -> {len(buckets)} "
+        f"bucket(s)/tick over {T} tick(s) "
+        f"(target {bucket_bytes / 2**20:.1f} MB"
+        + (f", {compression.format} in-loop wire"
+           if compression is not None else ", fp in-loop wire") + ")")
+    return PipeOverlapPlan(topology.mesh, treedef, local_shapes, buckets,
+                           bucket_sizes, T, compression=compression)

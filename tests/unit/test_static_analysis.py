@@ -104,6 +104,36 @@ def test_hazard_reachability_through_helpers(tmp_path):
     assert "_publish" in violations[0].message
 
 
+def test_hazard_pipe_tick_body_is_hot(tmp_path):
+    """Pipe gates: a host sync seeded inside the pipe tick body
+    (_pipe_body runs T = M + P - 1 times per step) fails by name, and
+    the pipe overlap reducer must keep routing leaves through the
+    shared bucketer — losing it is the monolithic-fp-all-reduce
+    regression, named after the pipeline."""
+    hl = _hazard_lint()
+    root = _write_tree(tmp_path, {
+        "deepspeed_tpu/runtime/pipe/engine.py":
+            "def _pipe_body(params, ids, labels, stage_arr, pipe_comm):\n"
+            "    s = float(stage_arr)\n"
+            "    return s\n"})
+    violations = hl.check(root)
+    assert [v.rule for v in violations] == ["host-sync"]
+    assert "_pipe_body" in violations[0].message
+
+    root2 = _write_tree(tmp_path / "mono", {
+        "deepspeed_tpu/runtime/pipe/overlap.py":
+            "def reduce_stage_grads(self, dlayers):\n"
+            "    return psum_tree(dlayers)\n"})
+    violations = hl.check(root2)
+    assert [v.rule for v in violations] == ["grad-overlap"]
+    assert "monolithic fp post-backward all-reduce" in violations[0].message
+    root3 = _write_tree(tmp_path / "ok", {
+        "deepspeed_tpu/runtime/pipe/overlap.py":
+            "def reduce_stage_grads(self, dlayers):\n"
+            "    return coalesce_flat(bucketed_map(dlayers))\n"})
+    assert hl.check(root3) == []
+
+
 def test_hazard_rules_fire_and_allowlist_suppresses(tmp_path):
     hl = _hazard_lint()
     root = _write_tree(tmp_path, {
@@ -257,7 +287,8 @@ def test_golden_contracts_hold(contracts_mod, extracted):
                      "moe_dispatch_quantized", "train_step_zero1_overlap",
                      "train_step_zero3_prefetch",
                      "train_step_zero1_overlap_int8",
-                     "train_step_zero3_prefetch_int8"):
+                     "train_step_zero3_prefetch_int8",
+                     "train_step_pipe2"):
         assert required in goldens, f"missing golden for {required}"
     errors = contracts_mod.diff_all(goldens, extracted)
     assert not errors, "\n".join(errors)
@@ -290,6 +321,34 @@ def test_compressed_collective_contracts_pin_wire_shape(contracts_mod,
     assert ov3["collectives"]["all-to-all"] >= 1, ov3
 
 
+def test_pipe_contract_pins_hops_and_bubble(contracts_mod, extracted):
+    """The pipe program pins the hop ring and the schedule shape: int8
+    codes ride the collective-permutes (a silent fp32 hop fall-back
+    changes s8_collectives), the EF residual slot is real state bytes,
+    and the computed (P-1)/(M+P-1) bubble fraction diffs by name when
+    the schedule degenerates."""
+    c = extracted["train_step_pipe2"]["contract"]
+    assert c["collectives"]["collective-permute"] >= 1, c
+    assert c["s8_collectives"] >= 1, c
+    assert c["comm_residual_bytes"] > 0, c
+    assert abs(c["pipe_bubble_fraction"] - 1.0 / 3.0) < 1e-5, c
+    replay = c.get("replay")
+    assert replay is not None and replay["steps"] == 3
+    if replay["compiles_after_warmup"] is not None:
+        assert replay["compiles_after_warmup"] == 0, replay
+
+    import copy
+
+    golden = copy.deepcopy(extracted["train_step_pipe2"])
+    golden["contract"]["pipe_bubble_fraction"] = 0.5
+    golden["contract"]["collectives"]["collective-permute"] -= 1
+    errs = contracts_mod.diff_contract(
+        "train_step_pipe2", golden, extracted["train_step_pipe2"])
+    joined = "\n".join(errs)
+    assert "pipe_bubble_fraction" in joined, joined
+    assert "collective-permute" in joined, joined
+
+
 def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
     """Tampering the stage-3 golden (as if the step grew two all-gathers)
     produces the named, actionable failure from the ISSUE."""
@@ -311,6 +370,7 @@ def test_seeded_collective_mutation_is_named(contracts_mod, extracted):
                                      "train_step_zero3_prefetch",
                                      "train_step_zero1_overlap_int8",
                                      "train_step_zero3_prefetch_int8",
+                                     "train_step_pipe2",
                                      "decode_multistep"])
 def test_update_goldens_idempotent(contracts_mod, extracted, tmp_path,
                                    program):

@@ -121,6 +121,25 @@ RUNGS = {
                                 "DSTPU_BENCH_PREFETCH": "1",
                                 "DSTPU_BENCH_OVERLAP": "1",
                                 "DSTPU_BENCH_OVERLAP_COMPRESSION": "int8"},
+    # pipeline-parallel training (runtime/pipe/engine.py): the 2-stage
+    # 1F1B pipe scan over the same 160m trunk — compare against flagship
+    # (pipe claims 2 chips; data absorbs the rest).  Bit-exactness, EF
+    # parity and the hop wire claim are proven by bench.py --ab-pipe on
+    # the CPU tier; these rungs measure the wall on chip, and each
+    # record carries pipe_bubble_fraction so a wall delta with an
+    # unchanged bubble is not a schedule regression
+    "160m-pipe2": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
+                   "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
+                   "DSTPU_BENCH_PIPE": "2"},
+    # + int8 activation hops (EF on) and the bubble-overlapped int8
+    # in-scan grad reduce — the full compressed-pipe configuration
+    "160m-pipe2-int8hop": {"DSTPU_BENCH_SIZE": "160m",
+                           "DSTPU_BENCH_SEQ": "1024",
+                           "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "20",
+                           "DSTPU_BENCH_PIPE": "2",
+                           "DSTPU_BENCH_PIPE_HOP": "int8",
+                           "DSTPU_BENCH_OVERLAP": "1",
+                           "DSTPU_BENCH_OVERLAP_COMPRESSION": "int8"},
     # optimizer offload boundary cost on hardware
     "160m-offload": {"DSTPU_BENCH_SIZE": "160m", "DSTPU_BENCH_SEQ": "1024",
                      "DSTPU_BENCH_BS": "16", "DSTPU_BENCH_STEPS": "10",
