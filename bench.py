@@ -315,13 +315,27 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     # them pollute the timed window (round-2's 0.236 "MFU" was this —
     # steady state measured 0.384 with a proper warmup, docs/PERF_NOTES.md)
     warmup = int(os.environ.get("DSTPU_BENCH_WARMUP", "5"))
+    # run-level goodput of this bench process (buckets sum to the
+    # ledger's lifetime): warmup/compile is badput, the timed window is
+    # productive — created HERE so its lifetime covers both phases
+    gp = None
+    try:
+        from deepspeed_tpu.telemetry.goodput import GoodputLedger
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+        gp = GoodputLedger(registry=MetricsRegistry())
+    except Exception:
+        pass
     loss = None
+    t_warm0 = time.perf_counter()
     for _ in range(warmup):
         loss = engine.train_batch(batch())
     # real host roundtrip: see the tail comment — block_until_ready alone
     # can return early through the tunnel
     if loss is not None:
         float(loss)
+
+    warmup_dt = time.perf_counter() - t_warm0
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -333,6 +347,20 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     final_loss = float(loss)
     dt = time.perf_counter() - t0
     assert np.isfinite(final_loss)
+
+    # measured step-time attribution (telemetry/timeline.py): one extra
+    # profiled step OUTSIDE the timed window — the decomposition says
+    # where the wall went (CPU runs stamp measured: false honestly)
+    timeline_rec = None
+    try:
+        from deepspeed_tpu.telemetry.timeline import capture_thunk
+
+        _, timeline_rec = capture_thunk(
+            lambda: float(engine.train_batch(batch())),
+            step=engine.global_steps,
+            pipe_struct=getattr(engine, "_pipe_struct", None))
+    except Exception as e:  # attribution must never sink a bench run
+        print(f"bench: timeline capture failed ({e}); omitting", file=sys.stderr)
 
     tokens = steps * micro_bs * dp * seq
     tok_per_sec_chip = tokens / dt / n_chips
@@ -376,6 +404,28 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
         result["overlapped_fraction"] = round(rep.overlapped_fraction, 4)
         result["exposed_collective_seconds_per_step_est"] = round(
             rep.exposed_seconds_per_step, 6)
+    # measured decomposition of one profiled step (estimated-vs-measured
+    # semantics: docs/OBSERVABILITY.md "Step-time attribution & goodput")
+    if timeline_rec is not None:
+        result["timeline"] = {
+            "measured": timeline_rec["measured"],
+            "wall_seconds": round(timeline_rec["wall_seconds"], 6),
+            "categories": {k: round(v, 6)
+                           for k, v in timeline_rec["categories"].items()},
+            "exposed_collective_seconds":
+                timeline_rec["exposed_collective_seconds"],
+            "overlapped_collective_seconds":
+                timeline_rec["overlapped_collective_seconds"],
+        }
+    if gp is not None:
+        try:
+            gp.observe_phase("compile", warmup_dt)
+            for _ in range(steps):
+                gp.observe_step(dt / steps)
+            result["goodput"] = gp.summary()
+        except Exception as e:
+            print(f"bench: goodput ledger failed ({e}); omitting",
+                  file=sys.stderr)
     # schedule-shape provenance for pipe rungs: the bubble is structural
     # ((P-1)/(M+P-1)), so a wall regression with an unchanged bubble is
     # not a schedule regression
@@ -596,10 +646,29 @@ def _ab_overlap() -> None:
             jax.block_until_ready(loss)
             walls.append(time.perf_counter() - t0)
         rep = engine.overlap_report()
+        # measured exposed-collective seconds (profiled extra step,
+        # outside the timed window) next to the modeled byte-model
+        # number; None when the backend yields no device trace (CPU)
+        measured_exposed, tl_measured = None, False
+        try:
+            from deepspeed_tpu.telemetry.timeline import capture_thunk
+
+            _, tl_rec = capture_thunk(
+                lambda: float(engine.train_batch(batches[0])))
+            if tl_rec is not None and tl_rec["measured"]:
+                tl_measured = True
+                measured_exposed = round(
+                    tl_rec["exposed_collective_seconds"], 6)
+        except Exception:
+            pass  # attribution must never sink the A/B
         return {"losses": losses,
                 "wall_median_s": sorted(walls)[len(walls) // 2],
                 "overlapped_fraction": (round(rep.overlapped_fraction, 4)
                                         if rep else 0.0),
+                "exposed_seconds_per_step_est": (
+                    round(rep.exposed_seconds_per_step, 6) if rep else None),
+                "exposed_seconds_per_step_measured": measured_exposed,
+                "timeline_measured": tl_measured,
                 "buckets": rep.buckets if rep else 0,
                 "compression": rep.compression if rep else None,
                 "residual_bytes": rep.residual_bytes if rep else 0,
@@ -672,6 +741,16 @@ def _ab_overlap() -> None:
             "final_loss_int8": q["losses"][-1],
             "overlapped_fraction": on["overlapped_fraction"],
             "overlapped_fraction_int8": q["overlapped_fraction"],
+            # modeled (byte-model) vs measured (device-trace) exposure:
+            # est comes from the overlap report, measured from one
+            # profiled step (null on CPU — measured: false)
+            "exposed_seconds_per_step_est": {
+                "on": on["exposed_seconds_per_step_est"],
+                "int8": q["exposed_seconds_per_step_est"]},
+            "exposed_seconds_per_step_measured": {
+                "on": on["exposed_seconds_per_step_measured"],
+                "int8": q["exposed_seconds_per_step_measured"]},
+            "timeline_measured": on["timeline_measured"],
             "buckets": on["buckets"],
             "wire_reduction_int8": round(wire_reduction, 3),
             "residual_bytes_int8": q["residual_bytes"],
@@ -794,10 +873,32 @@ def _ab_pipe() -> None:
                 loss = engine.train_batch(b)
             jax.block_until_ready(loss)
             walls.append(time.perf_counter() - t0)
+        # measured bubble/exposure from one profiled step (outside the
+        # timed window) next to the structural (P-1)/(M+P-1) claim;
+        # None when the backend yields no device trace (CPU)
+        struct = getattr(engine, "_pipe_struct", None)
+        measured_exposed, measured_bubble, tl_measured = None, None, False
+        try:
+            from deepspeed_tpu.telemetry.timeline import capture_thunk
+
+            _, tl_rec = capture_thunk(
+                lambda: float(engine.train_batch(batches[0])),
+                pipe_struct=struct)
+            if tl_rec is not None and tl_rec["measured"]:
+                tl_measured = True
+                measured_exposed = round(
+                    tl_rec["exposed_collective_seconds"], 6)
+                measured_bubble = round(
+                    tl_rec["categories"].get("pipe_bubble", 0.0), 6)
+        except Exception:
+            pass  # attribution must never sink the A/B
         return {"losses": losses, "hop_logical": hop_logical,
                 "hop_wire": hop_wire,
                 "wall_median_s": sorted(walls)[len(walls) // 2],
-                "pipe_struct": getattr(engine, "_pipe_struct", None)}
+                "exposed_seconds_per_step_measured": measured_exposed,
+                "pipe_bubble_seconds_measured": measured_bubble,
+                "timeline_measured": tl_measured,
+                "pipe_struct": struct}
 
     ctl = run(MeshConfig(data=2), 2, {"mesh": {"data": 2}},
               force_schedule=True)
@@ -851,6 +952,17 @@ def _ab_pipe() -> None:
         "hop_bytes_logical": q["hop_logical"],
         "hop_bytes_wire": q["hop_wire"],
         "bubble_fraction": struct.get("bubble_fraction"),
+        # measured (device-trace) columns next to the modeled ones:
+        # null on CPU, where the profiler yields no device timeline
+        "pipe_bubble_seconds_measured": {
+            "control": ctl["pipe_bubble_seconds_measured"],
+            "pipe2": pipe["pipe_bubble_seconds_measured"],
+            "int8hop": q["pipe_bubble_seconds_measured"]},
+        "exposed_seconds_per_step_measured": {
+            "control": ctl["exposed_seconds_per_step_measured"],
+            "pipe2": pipe["exposed_seconds_per_step_measured"],
+            "int8hop": q["exposed_seconds_per_step_measured"]},
+        "timeline_measured": q["timeline_measured"],
         "stages": struct.get("stages"),
         "num_micro": struct.get("num_micro"),
         "final_loss_control": ctl["losses"][-1],

@@ -26,6 +26,21 @@ the matching rules for the trace namespace:
 5. Single owner: each literal span/event name is recorded from exactly
    one call site (multi-site phases thread the name through a helper).
 
+And it cross-checks the metric CATALOG (``docs/OBSERVABILITY.md``)
+against the code, so the two cannot drift apart:
+
+6. Every registered ``deepspeed_tpu_*`` name must appear in
+   docs/OBSERVABILITY.md (an undocumented metric is invisible to anyone
+   reading the catalog).
+7. Every metric named in a catalog TABLE row (lines starting with
+   ``|``; backticked full names, plus combined-row ``_suffix`` tokens
+   that expand against the row's base name, e.g. ``_misses_total``)
+   must be registered somewhere in code — no dead catalog rows
+   promising metrics that no longer exist.
+
+Both catalog checks are skipped when ``docs/OBSERVABILITY.md`` does not
+exist under the scanned root (fixture trees in tests).
+
 This module is deliberately SELF-CONTAINED (stdlib only, no package
 imports): the drivers — ``tools/check_metric_names.py`` (back-compat
 shim) and ``tools/dstpu_lint.py`` (the unified lint driver) — load it
@@ -138,6 +153,43 @@ def collect(root: str) -> Dict[str, List[Site]]:
     return _walk(root, _scan_file, _EXCLUDE_FILES)
 
 
+_DOC_CATALOG = os.path.join("docs", "OBSERVABILITY.md")
+_DOC_TOKEN_RE = re.compile(r"`([A-Za-z0-9_.*-]+)`")
+_DOC_SUFFIX_RE = re.compile(r"^_[a-z][a-z0-9_]*$")
+
+
+def collect_catalog(root: str) -> Dict[str, int]:
+    """Metric names the docs/OBSERVABILITY.md catalog TABLES promise:
+    backticked full ``deepspeed_tpu_*`` names in ``|`` rows, plus
+    combined-row ``_suffix`` tokens expanded against the row's base
+    name by replacing its trailing underscore segments
+    (``deepspeed_tpu_x_hits_total`` + ``_misses_total`` ->
+    ``deepspeed_tpu_x_misses_total``).  Returns ``{name: lineno}`` (the
+    first row naming each), ``{}`` when the doc is absent."""
+    path = os.path.join(root, _DOC_CATALOG)
+    if not os.path.exists(path):
+        return {}
+    promised: Dict[str, int] = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            base = None
+            for tok in _DOC_TOKEN_RE.findall(line):
+                if tok.startswith("deepspeed_tpu_"):
+                    if "*" in tok or "." in tok or "-" in tok:
+                        continue  # family glob / knob path, not a name
+                    promised.setdefault(tok, lineno)
+                    if base is None:
+                        base = tok
+                elif base is not None and _DOC_SUFFIX_RE.match(tok):
+                    segs = tok[1:].split("_")
+                    head = base.split("_")[:-len(segs)]
+                    if head:
+                        promised.setdefault("_".join(head + segs), lineno)
+    return promised
+
+
 def collect_spans(root: str) -> Dict[str, List[Site]]:
     return _walk(root, _scan_spans, _SPAN_EXCLUDE_FILES)
 
@@ -171,6 +223,27 @@ def check(root: str) -> List[str]:
                 f"span {name!r} recorded at {len(sites)} call sites "
                 f"({where}): each span name belongs to exactly one owner "
                 "(thread the name through a helper for shared phases)")
+    doc_path = os.path.join(root, _DOC_CATALOG)
+    if os.path.exists(doc_path):
+        with open(doc_path) as f:
+            doc_text = f.read()
+        promised = collect_catalog(root)
+        for name, sites in sorted(found.items()):
+            # combined catalog rows document a name via suffix expansion
+            # (`_misses_total`) without spelling it out — the expanded
+            # promise counts as documented
+            if name not in doc_text and name not in promised:
+                where = ", ".join(f"{f}:{ln}" for f, ln, _t in sites)
+                errors.append(
+                    f"{name!r} ({where}): registered in code but absent "
+                    f"from the {_DOC_CATALOG} metric catalog — document "
+                    "it (or remove the registration)")
+        for name, lineno in sorted(promised.items()):
+            if name not in found:
+                errors.append(
+                    f"{_DOC_CATALOG}:{lineno}: catalog row promises "
+                    f"{name!r} but nothing in the code registers it "
+                    "(dead catalog row — delete it or restore the metric)")
     return errors
 
 

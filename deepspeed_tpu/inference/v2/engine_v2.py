@@ -100,6 +100,14 @@ class RaggedInferenceConfig(ConfigModel):
     #: telemetry.recompile_sentinel.steady_after
     recompile_sentinel: bool = True
     sentinel_steady_after: int = 3
+    #: step-time attribution (telemetry/timeline.py): every N engine
+    #: steps, capture one profiler trace and publish the measured
+    #: decomposition (0 = only on explicit `force_timeline_capture()`).
+    #: The serving engine takes no `telemetry` block, so — like the
+    #: sentinel above — the knob lives here
+    timeline_every_n_steps: int = 0
+    #: where per-capture merged Chrome traces land ("" = no artifacts)
+    timeline_artifact_dir: str = ""
     #: memory ledger (telemetry/memory.py): attach the weight copy + KV
     #: page pool to the process ledger and watch prefill/decode phase
     #: watermarks.  The serving engine takes no `telemetry` block, so —
@@ -421,6 +429,14 @@ class InferenceEngineV2:
         self._sentinel = (RecompileSentinel(
             loop="serve", steady_after=self.config.sentinel_steady_after)
             if self.config.recompile_sentinel else None)
+        # step-time attribution: periodic (timeline_every_n_steps) or
+        # on-demand (force_timeline_capture); only the captured step
+        # pays the profiler cost
+        from ...telemetry.timeline import StepTimeline
+
+        self._timeline = StepTimeline(
+            every_n_steps=self.config.timeline_every_n_steps,
+            artifact_dir=self.config.timeline_artifact_dir)
         self._wire_memory_ledger()
 
     def _wire_memory_ledger(self) -> None:
@@ -1513,7 +1529,14 @@ class InferenceEngineV2:
         self._step_parts = set()
         self._prefetched = False
         try:
-            out = self._step_impl()
+            if self._timeline.should_capture(self._decode_steps):
+                # periodic step-time attribution: only this step pays
+                # the profiler start/stop + parse (capture context is
+                # exception-safe; a failed step still propagates)
+                with self._timeline.capture(self._decode_steps):
+                    out = self._step_impl()
+            else:
+                out = self._step_impl()
             # idle / prefill-only steps still restore-prefetch for the
             # queue head (the decode-overlap call site won if it ran)
             self._prefetch_restores()
@@ -1524,6 +1547,16 @@ class InferenceEngineV2:
             self._sentinel.observe_step(frozenset(self._step_parts),
                                         step=self._decode_steps)
         return out
+
+    def force_timeline_capture(self) -> None:
+        """Arm the step-time attribution capture for the NEXT ``step()``
+        regardless of cadence (bench_serving stamps its JSON from the
+        record this produces)."""
+        self._timeline.force_next()
+
+    def timeline_record(self) -> Optional[Dict[str, Any]]:
+        """Last completed step-time attribution record, or None."""
+        return self._timeline.last_record()
 
     def _step_impl(self) -> Dict[int, Dict[str, Any]]:
         out: Dict[int, Dict[str, Any]] = {}

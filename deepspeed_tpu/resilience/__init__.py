@@ -24,6 +24,7 @@ Four pillars (see ``docs/RESILIENCE.md``):
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 from ..utils.logging import log_dist, logger
@@ -66,10 +67,23 @@ class ResilienceManager:
         cfg = self.config
         if not (cfg.auto_resume and cfg.save_dir):
             return None
-        path, _client = io_retry(
-            lambda: engine.load_checkpoint(cfg.save_dir),
-            retries=cfg.io_retries, base_delay_s=cfg.io_retry_base_s,
-            what=f"auto-resume load from {cfg.save_dir}")
+        # a recovery load is restart badput, not routine checkpoint I/O:
+        # re-route the engine's checkpoint_load phase into the restart
+        # bucket while the resume runs (no-op when no ledger is active)
+        try:
+            from ..telemetry.goodput import get_goodput_ledger
+
+            gp = get_goodput_ledger()
+            restart = (gp.override("restart") if gp is not None
+                       else contextlib.nullcontext())
+        # dstpu-lint: allow[swallow] accounting must never block a resume
+        except Exception:
+            restart = contextlib.nullcontext()
+        with restart:
+            path, _client = io_retry(
+                lambda: engine.load_checkpoint(cfg.save_dir),
+                retries=cfg.io_retries, base_delay_s=cfg.io_retry_base_s,
+                what=f"auto-resume load from {cfg.save_dir}")
         if path is None:
             log_dist(f"resilience: no checkpoint in {cfg.save_dir}; "
                      "fresh start")

@@ -377,6 +377,39 @@ class MemoryLedgerConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class TimelineConfig(ConfigModel):
+    """``timeline`` sub-block of ``telemetry``: measured step-time
+    attribution (telemetry/timeline.py).  Every ``every_n_steps`` the
+    engine captures a ``jax.profiler`` trace of ONE step and publishes
+    the ``deepspeed_tpu_timeline_*`` decomposition (0 = no periodic
+    captures; one-shot captures via ``engine.capture_timeline()`` /
+    bench stamps still work).  ``artifact_dir`` receives one merged
+    host-span + device-op Chrome-trace file per capture ("" = no
+    artifact files)."""
+
+    enabled: bool = True
+    every_n_steps: int = 0
+    artifact_dir: str = ""
+
+    def validate(self) -> None:
+        if self.every_n_steps < 0:
+            raise ValueError(
+                "telemetry.timeline.every_n_steps must be >= 0")
+
+
+@dataclasses.dataclass
+class GoodputConfig(ConfigModel):
+    """``goodput`` sub-block of ``telemetry``: the run-level goodput /
+    badput ledger (telemetry/goodput.py).  ``run_file`` is the
+    cross-attempt union ledger for preempted runs; when left "" on a
+    resilient engine it defaults into the resilience ``save_dir`` so a
+    relaunched attempt attributes recomputed steps to restart badput."""
+
+    enabled: bool = True
+    run_file: str = ""
+
+
+@dataclasses.dataclass
 class TelemetryConfig(ConfigModel):
     """``telemetry`` block: the unified metrics registry + export paths
     (see deepspeed_tpu/telemetry/ and docs/OBSERVABILITY.md).
@@ -409,6 +442,10 @@ class TelemetryConfig(ConfigModel):
         default_factory=RecompileSentinelConfig)
     memory: MemoryLedgerConfig = dataclasses.field(
         default_factory=MemoryLedgerConfig)
+    timeline: TimelineConfig = dataclasses.field(
+        default_factory=TimelineConfig)
+    goodput: GoodputConfig = dataclasses.field(
+        default_factory=GoodputConfig)
 
     def validate(self) -> None:
         if self.export_interval < 1:

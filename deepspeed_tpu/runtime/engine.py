@@ -24,6 +24,7 @@ one program) and is what benchmarks should use.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Iterator, Optional, Tuple
@@ -52,6 +53,9 @@ from .precision import (LossScaleState, cast_tree, check_overflow,
                         clip_by_global_norm, global_grad_norm,
                         update_loss_scale)
 from .zero.strategy import ZeroShardingPlan
+
+#: warn-once latch for the deprecated (pre-rename) exposed-seconds alias
+_EXPOSED_ALIAS_WARNED = False
 
 
 @jax.tree_util.register_dataclass
@@ -248,6 +252,15 @@ class DeepSpeedTPUEngine:
         if config.resilience.enabled:
             from ..resilience import ResilienceManager
 
+            gp = (self.telemetry.goodput if self.telemetry is not None
+                  else None)
+            if (gp is not None and not config.telemetry.goodput.run_file
+                    and config.resilience.save_dir):
+                # union-of-attempts ledger rides the checkpoint dir by
+                # default: every attempt of a resilient run finds the
+                # same file, so productive steps survive preemptions
+                gp.attach_run_file(os.path.join(
+                    config.resilience.save_dir, "goodput_run.json"))
             self.resilience = ResilienceManager(config.resilience)
             self.resilience.maybe_auto_resume(self)
         log_dist(f"DeepSpeedTPUEngine initialized: zero_stage={config.zero_config.stage} "
@@ -1547,6 +1560,35 @@ class DeepSpeedTPUEngine:
         jax.block_until_ready(self.state.step)  # flush in-flight steps
         jax.profiler.stop_trace()
 
+    def _timeline_sync(self) -> None:
+        """Device fence for timeline captures: the capture window must
+        close only after the traced step's device work has retired, or
+        the decomposition under-counts compute and over-counts host gap."""
+        jax.block_until_ready(self.state.step)
+
+    def capture_timeline(self, batch=None,
+                         data_iter: Optional[Iterator] = None):
+        """Force a step-time attribution capture around ONE train_batch
+        and return ``(loss, record)`` — the bench/report entry point (no
+        cadence configuration needed).  ``record`` is None when telemetry
+        or the timeline is disabled."""
+        tl = self.telemetry.timeline if self.telemetry is not None else None
+        if tl is None:
+            return self.train_batch(batch=batch, data_iter=data_iter), None
+        tl.force_next()
+        loss = self.train_batch(batch=batch, data_iter=data_iter)
+        return loss, tl.last_record()
+
+    def timeline_record(self):
+        """Last completed step-time attribution record, or None."""
+        tl = self.telemetry.timeline if self.telemetry is not None else None
+        return tl.last_record() if tl is not None else None
+
+    def goodput_summary(self):
+        """Current goodput/badput ledger summary, or None."""
+        gp = self.telemetry.goodput if self.telemetry is not None else None
+        return gp.summary() if gp is not None else None
+
     def train_batch(self, batch=None, data_iter: Optional[Iterator] = None):
         """One full optimizer step (the native fused path).
 
@@ -1591,9 +1633,22 @@ class DeepSpeedTPUEngine:
         t0 = time.perf_counter()
         trace = (self.telemetry.step_trace(self.global_steps)
                  if self.telemetry is not None else _no_trace())
+        # periodic step-time attribution: only the captured step pays the
+        # profiler start/stop + parse cost (off the hot path; the capture
+        # context is exception-safe and never re-raises into the step)
+        tl = self.telemetry.timeline if self.telemetry is not None else None
+        capturing = tl is not None and tl.should_capture(self.global_steps)
+        # the captured step pays profiler start/stop + parse: its wall
+        # time is self-inflicted overhead, so it must not feed the stall
+        # watchdog's median (nor rate as a data stall in goodput)
+        self._timeline_captured = capturing
+        cap = (tl.capture(self.global_steps,
+                          pipe_struct=getattr(self, "_pipe_struct", None),
+                          sync=self._timeline_sync)
+               if capturing else _no_trace())
         try:
-            with trace, span("train_batch", cat="train",
-                             step=self.global_steps):
+            with cap, trace, span("train_batch", cat="train",
+                                  step=self.global_steps):
                 with self.topology.mesh:
                     self.state, loss = self._train_batch(self.state, batch,
                                                          self._next_rng())
@@ -1713,8 +1768,16 @@ class DeepSpeedTPUEngine:
                 return self._model_loss(p, batch, None)
 
             self._eval_fn = jax.jit(_eval)
-        with self.topology.mesh:
-            return self._eval_fn(self.state.params, batch)
+        t0 = time.perf_counter()
+        with span("eval_batch", cat="eval"):
+            with self.topology.mesh:
+                out = self._eval_fn(self.state.params, batch)
+        gp = self.telemetry.goodput if self.telemetry is not None else None
+        if gp is not None:
+            # eval wall time is badput in the goodput ledger (dispatch
+            # time only on an async backend — honest lower bound)
+            gp.observe_phase("eval", time.perf_counter() - t0)
+        return out
 
     # ------------------------------------------------------------- data path
     def deepspeed_io(self, dataset, batch_size: Optional[int] = None,
@@ -1767,10 +1830,20 @@ class DeepSpeedTPUEngine:
             "inside the backward loop (overlap-scheduled) vs the "
             "post-backward tail (telemetry/overlap.py)")
         self._m_exposed = reg.counter(
-            "deepspeed_tpu_train_exposed_collective_seconds",
+            "deepspeed_tpu_train_exposed_collective_seconds_estimated",
             "cumulative ESTIMATED seconds of exposed (non-overlapped) "
             "gradient collectives: wire bytes x bus factor over the "
-            "nominal per-generation interconnect bandwidth")
+            "nominal per-generation interconnect bandwidth (a model — "
+            "the MEASURED counterpart is "
+            "deepspeed_tpu_timeline_exposed_collective_seconds)")
+        # deprecated alias: the pre-rename series keeps moving so
+        # existing dashboards don't flatline; a warn-once fires at the
+        # first increment (see _report_telemetry)
+        self._m_exposed_deprecated = reg.counter(
+            "deepspeed_tpu_train_exposed_collective_seconds",
+            "DEPRECATED alias of "
+            "deepspeed_tpu_train_exposed_collective_seconds_estimated "
+            "(renamed to make the byte-model nature explicit)")
         self._m_pipe_bubble = reg.gauge(
             "deepspeed_tpu_train_pipe_bubble_fraction",
             "structural share of pipe-schedule ticks that are warm-up/"
@@ -1912,7 +1985,18 @@ class DeepSpeedTPUEngine:
         self._m_steps.inc()
         if step_dt is not None:
             self._m_phase.observe(step_dt, phase="train_batch")
-            tm.observe_step_time(step_dt, self.global_steps)
+            captured = getattr(self, "_timeline_captured", False)
+            self._timeline_captured = False
+            # a timeline-captured step's wall includes profiler overhead:
+            # keep it out of the watchdog median and never rate it a stall
+            stalled = (False if captured
+                       else tm.observe_step_time(step_dt, self.global_steps))
+            if tm.goodput is not None:
+                # run-level goodput: classify this step's wall (compile
+                # carve-out, stall badput, cross-attempt recompute →
+                # restart); overflow-skip steps stay productive
+                tm.goodput.observe_step(step_dt, step=self.global_steps,
+                                        stalled=stalled)
             self._win_time += step_dt
             self._win_steps += 1
             self._win_tokens += self._batch_tokens(batch)
@@ -1940,8 +2024,17 @@ class DeepSpeedTPUEngine:
         if report is not None:
             self._m_overlap_frac.set(report.overlapped_fraction)
             if self._win_steps > 0:
-                self._m_exposed.inc(
-                    report.exposed_seconds_per_step * self._win_steps)
+                inc = report.exposed_seconds_per_step * self._win_steps
+                self._m_exposed.inc(inc)
+                global _EXPOSED_ALIAS_WARNED
+                if not _EXPOSED_ALIAS_WARNED:
+                    _EXPOSED_ALIAS_WARNED = True
+                    logger.warning(
+                        "deepspeed_tpu_train_exposed_collective_seconds is "
+                        "deprecated: read ..._estimated (same byte-model "
+                        "series) or the MEASURED "
+                        "deepspeed_tpu_timeline_exposed_collective_seconds")
+                self._m_exposed_deprecated.inc(inc)
         # structural (schedule-derived, no sync): pipe bubble share
         pipe_struct = getattr(self, "_pipe_struct", None)
         if pipe_struct is not None:
@@ -2089,17 +2182,25 @@ class DeepSpeedTPUEngine:
                                    client_state=client_state or {},
                                    keep_n=keep_n)
 
-        with span("checkpoint_save", cat="ckpt", tag=tag,
-                  partitioned=partitioned):
-            if rcfg.enabled and rcfg.io_retries:
-                from ..resilience.commit import io_retry
+        t0 = time.perf_counter()
+        try:
+            with span("checkpoint_save", cat="ckpt", tag=tag,
+                      partitioned=partitioned):
+                if rcfg.enabled and rcfg.io_retries:
+                    from ..resilience.commit import io_retry
 
-                # a failed+retried save restages from scratch (the
-                # commit protocol resets tmp.<tag>), so retry is safe
-                return io_retry(_save, retries=rcfg.io_retries,
-                                base_delay_s=rcfg.io_retry_base_s,
-                                what=f"checkpoint save '{tag}'")
-            return _save()
+                    # a failed+retried save restages from scratch (the
+                    # commit protocol resets tmp.<tag>), so retry is safe
+                    return io_retry(_save, retries=rcfg.io_retries,
+                                    base_delay_s=rcfg.io_retry_base_s,
+                                    what=f"checkpoint save '{tag}'")
+                return _save()
+        finally:
+            gp = (self.telemetry.goodput if self.telemetry is not None
+                  else None)
+            if gp is not None:
+                gp.observe_phase("checkpoint_save",
+                                 time.perf_counter() - t0)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None, **kw):
         """Verified load: the tag is resolved through the resilience
@@ -2121,10 +2222,21 @@ class DeepSpeedTPUEngine:
             logger.warning(f"no loadable checkpoint in {load_dir}; "
                            "nothing loaded")
             return None, {}
-        with span("checkpoint_load", cat="ckpt", tag=resolved):
-            if os.path.exists(os.path.join(load_dir, resolved, META_FILE)):
-                return load_partitioned(self, load_dir, tag=resolved)
-            return load_checkpoint(self, load_dir, tag=resolved)
+        t0 = time.perf_counter()
+        try:
+            with span("checkpoint_load", cat="ckpt", tag=resolved):
+                if os.path.exists(os.path.join(load_dir, resolved, META_FILE)):
+                    return load_partitioned(self, load_dir, tag=resolved)
+                return load_checkpoint(self, load_dir, tag=resolved)
+        finally:
+            gp = (self.telemetry.goodput if self.telemetry is not None
+                  else None)
+            if gp is not None:
+                # auto-resume wraps this in override("restart"): a
+                # preemption-recovery load is restart badput, not
+                # routine checkpoint I/O
+                gp.observe_phase("checkpoint_load",
+                                 time.perf_counter() - t0)
 
     # batch-size accessors (reference engine API)
     def train_micro_batch_size_per_gpu(self) -> int:

@@ -24,6 +24,8 @@ from .exporter import (JSONLWriter, PrometheusFileExporter,
                        to_prometheus_text)
 from .flight import (FlightRecorder, dump_on_exception, get_flight_recorder,
                      install_flight_recorder)
+from .goodput import (GoodputLedger, get_goodput_ledger, last_goodput_summary,
+                      set_goodput_ledger)
 from .memory import (MemoryLedger, get_memory_ledger, is_resource_exhausted,
                      oom_hints, record_oom_incident, set_memory_ledger,
                      top_live_buffers)
@@ -34,6 +36,8 @@ from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
 from .spans import (SpanRecorder, begin_span, configure_spans, end_span,
                     get_span_recorder, record_event, set_span_recorder, span,
                     trace_dump)
+from .timeline import (StepTimeline, capture_thunk, categorize_op,
+                       decompose_events, last_timeline_record)
 from .tracing import (PhaseTimer, annotate, profiler_available, step_trace)
 from .watchdog import StallWatchdog
 
@@ -53,6 +57,10 @@ __all__ = [
     "top_live_buffers",
     "RecompileSentinel", "expect_recompile", "compile_counts",
     "PEAK_BF16_FLOPS", "peak_flops_for_kind", "peak_flops_for_device", "mfu",
+    "StepTimeline", "capture_thunk", "categorize_op", "decompose_events",
+    "last_timeline_record",
+    "GoodputLedger", "get_goodput_ledger", "set_goodput_ledger",
+    "last_goodput_summary",
     "StallWatchdog", "Telemetry",
 ]
 
@@ -83,6 +91,8 @@ class Telemetry:
         self.flight: Optional[FlightRecorder] = None
         self.sentinel: Optional[RecompileSentinel] = None
         self.ledger: Optional[MemoryLedger] = None
+        self.timeline: Optional[StepTimeline] = None
+        self.goodput: Optional[GoodputLedger] = None
         self.export_interval = 1
         self.trace_annotations = True
         self._last_export: Optional[int] = None
@@ -128,6 +138,20 @@ class Telemetry:
                                           window=wd.window, name=loop,
                                           registry=self.registry,
                                           on_stall=self._on_stall)
+        tl = getattr(config, "timeline", None)
+        if tl is not None and getattr(tl, "enabled", False):
+            self.timeline = StepTimeline(
+                every_n_steps=getattr(tl, "every_n_steps", 0),
+                artifact_dir=getattr(tl, "artifact_dir", ""),
+                registry=self.registry)
+        gp = getattr(config, "goodput", None)
+        if gp is not None and getattr(gp, "enabled", False):
+            self.goodput = GoodputLedger(
+                registry=self.registry,
+                run_file=getattr(gp, "run_file", ""))
+            # process default: resilience (auto-resume reclassification)
+            # and flight dumps reach the ledger without an engine handle
+            set_goodput_ledger(self.goodput)
 
     def _on_stall(self, name: str, step, ratio: float) -> None:
         """Watchdog incident edge -> flight-recorder dump (black box for
@@ -163,6 +187,13 @@ class Telemetry:
                     and step - self._last_export < self.export_interval):
                 return
         self._last_export = step
+        if self.goodput is not None:
+            try:
+                self.goodput.publish()
+            # dstpu-lint: allow[swallow] accounting must never break an
+            # export boundary; the next publish retries the fold
+            except Exception:
+                pass
         if self.prom_file is None and self.jsonl is None:
             return
         # a broken sink (full disk, torn mount) must never raise out of
@@ -182,6 +213,15 @@ class Telemetry:
                     record_export_failure("jsonl", e, self.registry)
 
     def close(self) -> None:
+        if self.goodput is not None:
+            try:
+                self.goodput.close()  # freeze lifetime, final publish
+            # dstpu-lint: allow[swallow] teardown must release the other
+            # sinks below even when the final publish/persist fails
+            except Exception:
+                pass
+            if get_goodput_ledger() is self.goodput:
+                set_goodput_ledger(None)
         for sink, part in (("prometheus_file", self.prom_file),
                            ("prometheus_http", self.prom_http),
                            ("jsonl", self.jsonl)):

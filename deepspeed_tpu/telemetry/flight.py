@@ -120,6 +120,28 @@ class FlightRecorder:
             # half-blind: a broken ledger drops one record, not the dump
             except Exception:
                 pass
+            try:
+                # last COMPLETED step-time attribution (never a torn
+                # in-progress capture: timeline.py publishes the record
+                # only after its capture context has fully closed, so a
+                # dump taken mid-capture sees the previous one)
+                from .timeline import last_timeline_record
+
+                tl = last_timeline_record()
+                if tl is not None:
+                    line(dict({"kind": "timeline"}, **tl))
+            # dstpu-lint: allow[swallow] same contract as the memory record
+            except Exception:
+                pass
+            try:
+                from .goodput import last_goodput_summary
+
+                gp = last_goodput_summary()
+                if gp is not None:
+                    line(dict({"kind": "goodput"}, **gp))
+            # dstpu-lint: allow[swallow] same contract as the memory record
+            except Exception:
+                pass
             line({"kind": "snapshot", "ts": time.time(),
                   "metrics": snapshot_metrics(self.registry)})
             for rec in (extra_records or []):

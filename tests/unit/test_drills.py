@@ -61,3 +61,27 @@ def test_chaos_drill_demo_inprocess(tmp_path):
     assert summary["ok"] and summary["seed"] == 0
     failed = [c for c in summary["checks"] if not c["ok"]]
     assert not failed, failed
+    # the goodput leg actually ran (kill→resume recompute attributed to
+    # restart badput; union-of-attempts matches the control)
+    names = {c["check"] for c in summary["checks"]}
+    for leg in ("goodput_recompute_attributed_to_restart",
+                "goodput_union_matches_control"):
+        assert leg in names, f"missing drill leg {leg}"
+
+
+@pytest.mark.slow
+def test_goodput_report_demo_inprocess(tmp_path):
+    report = _load_tool("goodput_report")
+    out = str(tmp_path / "goodput")
+    rc = report.main(["--demo", "--out", out, "--steps", "8"])
+    assert rc == 0
+    summary = json.load(open(os.path.join(out, "goodput_report.json")))
+    assert summary["ok"]
+    failed = [c for c in summary["checks"] if not c["ok"]]
+    assert not failed, failed
+    # the hard gates actually ran (not silently skipped)
+    names = {c["check"] for c in summary["checks"]}
+    for gate in ("categories_sum_to_wall", "measured_flag_honest",
+                 "buckets_sum_to_lifetime", "goodput_fraction_above_floor",
+                 "chrome_trace_parses"):
+        assert gate in names, f"missing gate {gate}"

@@ -53,6 +53,50 @@ def test_lint_catches_violations(tmp_path):
     assert "deepspeed_tpu_ok_total'" not in joined
 
 
+def test_catalog_drift_both_directions(tmp_path):
+    """The docs/OBSERVABILITY.md catalog and the code must not drift:
+    an undocumented registration fails BY NAME, and a dead catalog row
+    (documented, unregistered) fails by name too."""
+    lint = _load_lint()
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (pkg / "a.py").write_text(
+        "reg.counter('deepspeed_tpu_documented_total')\n"
+        "reg.counter('deepspeed_tpu_undocumented_total')\n"
+        "reg.counter('deepspeed_tpu_combined_hits_total')\n"
+        "reg.counter('deepspeed_tpu_combined_misses_total')\n")
+    (docs / "OBSERVABILITY.md").write_text(
+        "| name | type |\n|---|---|\n"
+        "| `deepspeed_tpu_documented_total` | counter |\n"
+        "| `deepspeed_tpu_combined_hits_total` / `_misses_total` "
+        "| counter |\n"
+        "| `deepspeed_tpu_ghost_rows_total` | counter |\n")
+    errors = lint.check(str(tmp_path))
+    joined = "\n".join(errors)
+    assert "deepspeed_tpu_undocumented_total" in joined
+    assert "deepspeed_tpu_ghost_rows_total" in joined
+    assert "dead catalog row" in joined
+    # documented names (including the combined-row suffix expansion)
+    # produced no errors
+    assert "deepspeed_tpu_documented_total'" not in joined
+    assert "deepspeed_tpu_combined_hits_total" not in joined
+    assert "deepspeed_tpu_combined_misses_total" not in joined
+
+
+def test_catalog_checks_skipped_without_doc(tmp_path):
+    """Fixture trees without docs/OBSERVABILITY.md (like every other
+    test here) must not be forced to carry a catalog."""
+    lint = _load_lint()
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir()
+    (tmp_path / "tools").mkdir()
+    (pkg / "a.py").write_text("reg.counter('deepspeed_tpu_lonely_total')\n")
+    assert lint.check(str(tmp_path)) == []
+
+
 def test_lint_ignores_unrelated_calls(tmp_path):
     lint = _load_lint()
     pkg = tmp_path / "deepspeed_tpu"

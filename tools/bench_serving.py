@@ -74,6 +74,68 @@ def _stamp_contract_hash(result: dict) -> dict:
     return result
 
 
+def _capture_serving_timeline(eng, prompt, max_new_tokens: int = 2):
+    """Force a step-time attribution capture on ONE short generate
+    (OUTSIDE any timed window) and return the record, or None.  Only the
+    first engine step of the generate is profiled (force_next arms a
+    single capture)."""
+    try:
+        from deepspeed_tpu.inference.v2.engine_v2 import RaggedRequest
+
+        eng.force_timeline_capture()
+        eng.generate_all([RaggedRequest(prompt_ids=list(prompt),
+                                        max_new_tokens=max_new_tokens)])
+        return eng.timeline_record()
+    except Exception:
+        return None  # attribution must never sink a bench
+
+
+def _observability_sections(timeline_rec, goodput_ledger,
+                            warmup_s: float, measured_s: float,
+                            measured_steps: int) -> dict:
+    """``timeline`` + ``goodput`` sections for the bench JSON
+    (docs/OBSERVABILITY.md "Step-time attribution & goodput").  The
+    timeline record stamps ``measured: false`` honestly on CPU; the
+    goodput ledger (created at leg start so its lifetime covers the
+    phases) books warmup/compile as badput and the timed window as
+    productive steps."""
+    sections = {}
+    if timeline_rec is not None:
+        sections["timeline"] = {
+            "measured": timeline_rec["measured"],
+            "wall_seconds": round(timeline_rec["wall_seconds"], 6),
+            "categories": {k: round(v, 6)
+                           for k, v in timeline_rec["categories"].items()},
+            "exposed_collective_seconds":
+                timeline_rec["exposed_collective_seconds"],
+            "overlapped_collective_seconds":
+                timeline_rec["overlapped_collective_seconds"],
+        }
+    if goodput_ledger is not None:
+        try:
+            goodput_ledger.observe_phase("compile", max(0.0, warmup_s))
+            n = max(1, int(measured_steps))
+            for _ in range(n):
+                goodput_ledger.observe_step(measured_s / n)
+            sections["goodput"] = goodput_ledger.summary()
+        # dstpu-lint: allow[swallow] observability sections are a bench
+        # annex; a broken ledger must not sink the benchmark numbers
+        except Exception:
+            pass
+    return sections
+
+
+def _new_goodput_ledger():
+    """Fresh private-registry ledger, or None when telemetry is broken."""
+    try:
+        from deepspeed_tpu.telemetry.goodput import GoodputLedger
+        from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+        return GoodputLedger(registry=MetricsRegistry())
+    except Exception:
+        return None
+
+
 def main() -> None:
     import jax
 
@@ -118,8 +180,10 @@ def main() -> None:
         # sequentially, so the second warm request HITS the warm prefix
         # and compiles the suffix-only prefill program — batching them
         # would admit both before either registered its pages
+        tw0 = time.perf_counter()
         for p in warm:
             eng.generate_all([RaggedRequest(prompt_ids=p, max_new_tokens=2)])
+        warm_dt = time.perf_counter() - tw0
         eng.reset_cache_stats()
         t0 = time.perf_counter()
         got = eng.generate_all([RaggedRequest(prompt_ids=p,
@@ -128,10 +192,13 @@ def main() -> None:
         dt = time.perf_counter() - t0
         toks = [got[u] for u in sorted(got)]
         assert sum(len(t) for t in toks) == nreq * gen
-        return toks, dt, eng.cache_stats()
+        st = eng.cache_stats()  # read BEFORE the capture generate below
+        tl = _capture_serving_timeline(eng, warm[0]) if cache else None
+        return toks, dt, st, warm_dt, tl
 
-    toks_off, dt_off, st_off = run(False)
-    toks_on, dt_on, st_on = run(True)
+    gp = _new_goodput_ledger()  # lifetime covers both legs below
+    toks_off, dt_off, st_off, warm_off, _ = run(False)
+    toks_on, dt_on, st_on, warm_on, tl_rec = run(True)
     identical = toks_off == toks_on
     mismatched = sum(1 for a, b in zip(toks_off, toks_on) if a != b)
 
@@ -169,6 +236,8 @@ def main() -> None:
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }
+    result.update(_observability_sections(
+        tl_rec, gp, warm_off + warm_on, dt_off + dt_on, measured_steps=2))
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason
@@ -227,6 +296,7 @@ def main_speculative() -> None:
         asserted identical ACROSS repeats (the determinism proof), wall
         time reported as the median."""
         toks_ref, stats, times = None, None, []
+        warm_s, tl = 0.0, None
         for _ in range(repeats):
             eng = InferenceEngineV2(model, RaggedInferenceConfig(
                 dtype="fp32" if not on_tpu else "bf16",
@@ -235,6 +305,7 @@ def main_speculative() -> None:
                 max_seqs=slots, enable_prefix_cache=True,
                 speculative=SpeculativeConfig(
                     mode="ngram" if spec else "off", k=k)), params=params)
+            tw0 = time.perf_counter()
             for p in warm:
                 eng.generate_all([RaggedRequest(prompt_ids=p,
                                                 max_new_tokens=4)])
@@ -253,6 +324,7 @@ def main_speculative() -> None:
                 eng.generate_all([RaggedRequest(prompt_ids=warm[1],
                                                 max_new_tokens=4)])
                 eng._proposer = prop
+            warm_s += time.perf_counter() - tw0
             eng.reset_cache_stats()
             t0 = time.perf_counter()
             got = eng.generate_all([RaggedRequest(prompt_ids=p,
@@ -263,14 +335,18 @@ def main_speculative() -> None:
             assert sum(len(t) for t in toks) == nreq * gen
             if toks_ref is None:
                 toks_ref, stats = toks, eng.decode_stats()
+                # stats are read: the capture generate below can no
+                # longer pollute the leg's invocation counts
+                tl = _capture_serving_timeline(eng, warm[0])
             else:
                 assert toks == toks_ref, \
                     "non-deterministic generations across repeats"
             eng.assert_no_leaks()
-        return toks_ref, statistics.median(times), stats
+        return toks_ref, statistics.median(times), stats, warm_s, tl
 
-    toks_off, dt_off, st_off = run(False)
-    toks_on, dt_on, st_on = run(True)
+    gp = _new_goodput_ledger()  # lifetime covers both legs below
+    toks_off, dt_off, st_off, warm_off, _ = run(False)
+    toks_on, dt_on, st_on, warm_on, tl_rec = run(True)
     identical = toks_off == toks_on
     mismatched = sum(1 for a, b in zip(toks_off, toks_on) if a != b)
 
@@ -316,6 +392,9 @@ def main_speculative() -> None:
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }
+    result.update(_observability_sections(
+        tl_rec, gp, warm_off + warm_on,
+        (dt_off + dt_on) * repeats, measured_steps=2 * repeats))
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason
@@ -378,7 +457,7 @@ def main_multistep() -> None:
         excluded from timing, token streams asserted identical ACROSS
         repeats, wall time as the median."""
         toks_ref, stats, times = None, None, []
-        steady_delta = 0.0
+        steady_delta, warm_s, tl = 0.0, 0.0, None
         for _ in range(repeats):
             eng = InferenceEngineV2(model, RaggedInferenceConfig(
                 dtype="fp32" if not on_tpu else "bf16",
@@ -389,9 +468,11 @@ def main_multistep() -> None:
             # warm sequentially at the FULL generation length: the
             # fused leg's shrink chain (K, K/2, ..., 1) compiles on the
             # tail of the warm streams, not in the measured region
+            tw0 = time.perf_counter()
             for p in warm:
                 eng.generate_all([RaggedRequest(prompt_ids=p,
                                                 max_new_tokens=gen)])
+            warm_s += time.perf_counter() - tw0
             eng.reset_cache_stats()
             s0 = steady_recompiles()
             t0 = time.perf_counter()
@@ -405,15 +486,20 @@ def main_multistep() -> None:
             assert sum(len(t) for t in toks) == nreq * gen
             if toks_ref is None:
                 toks_ref, stats = toks, eng.decode_stats()
+                # stats are read: the capture generate below can no
+                # longer pollute the leg's sync counts
+                tl = _capture_serving_timeline(eng, warm[0])
             else:
                 assert toks == toks_ref, \
                     "non-deterministic generations across repeats"
             eng.assert_no_leaks()
             eng.close()
-        return toks_ref, statistics.median(times), stats, steady_delta
+        return toks_ref, statistics.median(times), stats, steady_delta, \
+            warm_s, tl
 
-    toks_off, dt_off, st_off, steady_off = run(1)
-    toks_on, dt_on, st_on, steady_on = run(horizon)
+    gp = _new_goodput_ledger()  # lifetime covers both legs below
+    toks_off, dt_off, st_off, steady_off, warm_off, _ = run(1)
+    toks_on, dt_on, st_on, steady_on, warm_on, tl_rec = run(horizon)
     identical = toks_off == toks_on
     mismatched = sum(1 for a, b in zip(toks_off, toks_on) if a != b)
 
@@ -452,6 +538,9 @@ def main_multistep() -> None:
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }
+    result.update(_observability_sections(
+        tl_rec, gp, warm_off + warm_on,
+        (dt_off + dt_on) * repeats, measured_steps=2 * repeats))
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason
@@ -529,7 +618,7 @@ def main_kv_tier() -> None:
         warm-restore pass) excluded from timing, token streams asserted
         identical ACROSS repeats, wall time as the median."""
         toks_ref, stats, tstats, times = None, None, None, []
-        steady_delta = 0.0
+        steady_delta, warm_s, tl = 0.0, 0.0, None
         for _ in range(repeats):
             eng = InferenceEngineV2(model, RaggedInferenceConfig(
                 dtype="fp32" if not on_tpu else "bf16",
@@ -550,11 +639,13 @@ def main_kv_tier() -> None:
                     got_rounds.append([got[u] for u in sorted(got)])
                 return got_rounds
 
+            tw0 = time.perf_counter()
             all_toks = [play(0)]   # cold fill: compiles + populates host
             # warm pass: fresh suffixes on the now-evicted families
             # compile the restore scatter + suffix-only prefill shapes
             all_toks.append(play(0, sufs=warm_sufs))
             eng.flush_spills()
+            warm_s += time.perf_counter() - tw0
             eng.reset_cache_stats()
             s0 = steady_recompiles()
             t0 = time.perf_counter()
@@ -565,16 +656,21 @@ def main_kv_tier() -> None:
             if toks_ref is None:
                 toks_ref = all_toks
                 stats, tstats = eng.cache_stats(), eng.tier_stats()
+                # stats are read: the capture generate below can no
+                # longer pollute the leg's prefill-token counts
+                tl = _capture_serving_timeline(
+                    eng, families[0] + warm_sufs[0][0])
             else:
                 assert all_toks == toks_ref, \
                     "non-deterministic generations across repeats"
             eng.assert_no_leaks()
             eng.close()
         return toks_ref, statistics.median(times), stats, tstats, \
-            steady_delta
+            steady_delta, warm_s, tl
 
-    toks_off, dt_off, st_off, _, steady_off = run(False)
-    toks_on, dt_on, st_on, ts_on, steady_on = run(True)
+    gp = _new_goodput_ledger()  # lifetime covers both legs below
+    toks_off, dt_off, st_off, _, steady_off, warm_off, _tl = run(False)
+    toks_on, dt_on, st_on, ts_on, steady_on, warm_on, tl_rec = run(True)
     identical = toks_off == toks_on
     flat_off = [t for rnd in toks_off for fam in rnd for t in fam]
     flat_on = [t for rnd in toks_on for fam in rnd for t in fam]
@@ -620,6 +716,10 @@ def main_kv_tier() -> None:
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }
+    result.update(_observability_sections(
+        tl_rec, gp, warm_off + warm_on,
+        (dt_off + dt_on) * repeats,
+        measured_steps=2 * repeats * (rounds - 1)))
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason

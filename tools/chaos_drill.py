@@ -114,6 +114,14 @@ def build_engine(ckpt_dir: str, resilient: bool = True, keep_n: int = 4):
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         "seed": 7,
     }
+    # goodput ledger on for every engine: resilient attempts auto-attach
+    # the union run file into resilience.save_dir (the wiring under
+    # test), the control run keeps a plain per-lifetime ledger; flight
+    # dumps stay inside the drill dir, never the CWD
+    cfg["telemetry"] = {
+        "enabled": True,
+        "flight_recorder": {"path": os.path.join(ckpt_dir, "flight")},
+    }
     if resilient:
         cfg["resilience"] = {"enabled": True, "save_dir": ckpt_dir,
                              "auto_resume": True, "emergency_save": True,
@@ -248,6 +256,31 @@ def run_demo(out: str, steps: int, kill_step: int, preempt_step: int,
                  for i in logged), default=float("inf"))
     _check(checks, "loss_trajectory_continuity",
            logged and drift <= LOSS_RTOL, f"max rel drift {drift:.2e}")
+
+    # goodput leg: union-of-attempts accounting across the kill->resume
+    # cycle (docs/OBSERVABILITY.md "Step-time attribution & goodput").
+    # The killed step's checkpoint was lost, so attempt 2 re-runs it —
+    # that recompute must land in the `restart` badput bucket, and the
+    # productive-step union across all three attempts must still match
+    # the uninterrupted control run exactly.
+    run_rec = {}
+    run_path = os.path.join(ckpt_dir, "goodput_run.json")
+    if os.path.exists(run_path):
+        with open(run_path) as f:
+            run_rec = json.load(f)
+    control_gp = control.goodput_summary() or {}
+    _check(checks, "goodput_run_file_unions_attempts",
+           run_rec.get("attempts") == 3, f"attempts={run_rec.get('attempts')}")
+    _check(checks, "goodput_recompute_attributed_to_restart",
+           run_rec.get("recomputed_steps") == 1
+           and (run_rec.get("buckets") or {}).get("restart", 0) > 0,
+           f"recomputed={run_rec.get('recomputed_steps')} "
+           f"restart_s={(run_rec.get('buckets') or {}).get('restart', 0):.4f}")
+    _check(checks, "goodput_union_matches_control",
+           run_rec.get("productive_steps") == control_gp.get(
+               "productive_steps") == steps,
+           f"union={run_rec.get('productive_steps')} "
+           f"control={control_gp.get('productive_steps')} steps={steps}")
 
     # corruption leg: bit-flip the newest tag; auto-resume must detect
     # it, count it, and fall back to the previous good tag
