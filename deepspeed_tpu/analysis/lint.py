@@ -46,6 +46,15 @@ jobs at runtime but are perfectly visible at review time:
     *processes* — in code that derives PartitionSpecs or flattens
     pytrees, that is cross-host sharding skew waiting to happen.
 
+``slo-exemplar``
+    Exemplar-coverage contract for SLO violation counters: every
+    ``.inc()`` on a ``deepspeed_tpu_serving_slo_*`` counter must be
+    accompanied (same function) by a ``slo_exemplar(...)`` call
+    recording the offending request's trace_id — an SLO count without
+    an exemplar is a number you cannot debug (docs/OBSERVABILITY.md
+    "Request tracing").  Counter increments with no single offending
+    request (e.g. a breaker *recovery*) suppress with a reason.
+
 ``grad-overlap``
     Regression guard for the compute/collective overlap structure
     (runtime/zero/overlap.py, docs/COMM.md "Overlap & scheduling"): the
@@ -78,7 +87,8 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 #: rule ids (the catalog in docs/STATIC_ANALYSIS.md mirrors this)
 RULES = ("host-sync", "wall-clock", "unseeded-random", "swallow",
-         "mutable-default", "pytree-order", "grad-overlap")
+         "mutable-default", "pytree-order", "grad-overlap",
+         "slo-exemplar")
 
 ALLOW_RE = re.compile(
     r"#\s*dstpu-lint:\s*allow\[(?P<rules>[a-z, -]+)\]\s*(?P<reason>.*)")
@@ -484,9 +494,96 @@ def _check_grad_overlap(rel, tree, out: List[Violation]) -> None:
             f"'{fname}' reaches none of {sorted(needed)}: {why}"))
 
 
+#: metric-name prefix whose counters carry the exemplar contract
+_SLO_PREFIX = "deepspeed_tpu_serving_slo_"
+
+
+def _slo_registration_name(call: ast.Call) -> Optional[str]:
+    """Metric name when ``call`` registers an SLO counter
+    (``<registry>.counter("deepspeed_tpu_serving_slo_*", ...)``)."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "counter" and call.args):
+        return None
+    first = call.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+            and first.value.startswith(_SLO_PREFIX):
+        return first.value
+    return None
+
+
+def _check_slo_exemplar(rel, tree, out: List[Violation]) -> None:
+    # pass 1 (file-wide): which names hold SLO counters?
+    #   x = reg.counter("…slo_…")  /  self._m_x = reg.counter("…slo_…")
+    # and which FUNCTIONS return one (accessor idiom: shed_counter()).
+    tracked: Dict[str, str] = {}      # bare/attr name -> metric name
+    accessors: Dict[str, str] = {}    # function name -> metric name
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if not (isinstance(value, ast.Call)):
+                continue
+            metric = _slo_registration_name(value)
+            if metric is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    tracked[t.id] = metric
+                elif isinstance(t, ast.Attribute):
+                    tracked[t.attr] = metric
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Return) \
+                        and isinstance(stmt.value, ast.Call):
+                    metric = _slo_registration_name(stmt.value)
+                    if metric is not None:
+                        accessors[node.name] = metric
+    if not tracked and not accessors:
+        return
+
+    def _inc_metric(call: ast.Call) -> Optional[str]:
+        """Metric name when ``call`` is ``<slo counter>.inc(...)``."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "inc"):
+            return None
+        v = f.value
+        if isinstance(v, ast.Name):
+            return tracked.get(v.id)
+        if isinstance(v, ast.Attribute):
+            return tracked.get(v.attr)
+        if isinstance(v, ast.Call):  # shed_counter().inc(...)
+            g = v.func
+            if isinstance(g, ast.Name):
+                return accessors.get(g.id)
+            if isinstance(g, ast.Attribute):
+                return accessors.get(g.attr)
+        return None
+
+    # pass 2: every function incrementing an SLO counter must also call
+    # slo_exemplar (the trace_id may legitimately be None at runtime —
+    # the contract is that the CALL SITE forwards one when it exists)
+    for _name, fn in sorted(_defs_and_calls(tree).items()):
+        for f in fn:
+            has_exemplar = "slo_exemplar" in _called_names(f)
+            if has_exemplar:
+                continue
+            for node in ast.walk(f):
+                if isinstance(node, ast.Call):
+                    metric = _inc_metric(node)
+                    if metric is not None:
+                        out.append(Violation(
+                            "slo-exemplar", rel, node.lineno,
+                            f"{metric}.inc() in '{f.name}' without a "
+                            "slo_exemplar(...) call recording the "
+                            "offending trace_id — an SLO violation count "
+                            "with no exemplar cannot be traced back to a "
+                            "request (docs/OBSERVABILITY.md)"))
+
+
 _CHECKS = (_check_host_sync, _check_wall_clock, _check_unseeded_random,
            _check_swallow, _check_mutable_default, _check_pytree_order,
-           _check_grad_overlap)
+           _check_grad_overlap, _check_slo_exemplar)
 
 
 # ----------------------------------------------------------------- driver

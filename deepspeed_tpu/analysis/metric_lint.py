@@ -67,6 +67,17 @@ _EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "registry.py")}
 #: span sites that define the span machinery itself, not a span
 _SPAN_EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "spans.py")}
 
+#: metric FAMILIES owned by a single module: beyond the per-name
+#: single-owner rule, every member of these prefixes must be registered
+#: in the named file — a second module minting into the family would
+#: fork its accounting (the reqtrace ledger is the sole authority for
+#: request-lifecycle metrics; see docs/OBSERVABILITY.md "Request
+#: tracing")
+_FAMILY_OWNERS = {
+    "deepspeed_tpu_serving_reqtrace_":
+        os.path.join("deepspeed_tpu", "telemetry", "reqtrace.py"),
+}
+
 Site = Tuple[str, int, str]  # (relpath, lineno, metric_type)
 
 
@@ -212,6 +223,14 @@ def check(root: str) -> List[str]:
             errors.append(
                 f"{name!r} registered at {len(sites)} call sites ({where}): "
                 "each metric belongs to exactly one owner")
+        for prefix, owner in _FAMILY_OWNERS.items():
+            if name.startswith(prefix):
+                strays = [f"{f}:{ln}" for f, ln, _t in sites if f != owner]
+                if strays:
+                    errors.append(
+                        f"{name!r} registered outside the family owner "
+                        f"({', '.join(strays)}): every '{prefix}*' metric "
+                        f"is registered only in {owner}")
     for name, sites in sorted(collect_spans(root).items()):
         where = ", ".join(f"{f}:{ln}" for f, ln, _t in sites)
         if not SPAN_NAME_RE.match(name) or name.startswith("deepspeed_tpu_"):

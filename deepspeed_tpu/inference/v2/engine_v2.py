@@ -34,6 +34,7 @@ from ...telemetry.compile_sentinel import RecompileSentinel
 from ...telemetry.compile_sentinel import \
     expect_recompile as sentinel_expect_recompile
 from ...telemetry.flight import dump_on_exception
+from ...telemetry.reqtrace import get_reqtrace_ledger, slo_exemplar
 from ...telemetry.spans import begin_span, end_span, record_event, span
 from ...telemetry.tracing import PhaseTimer
 from ...utils.logging import logger
@@ -177,6 +178,11 @@ class RaggedRequest:
     #: with ``finish_reason="deadline"`` instead of letting it wait (or
     #: decode) forever
     deadline_s: Optional[float] = None
+    #: fleet trace id minted by ``FleetRouter.submit`` (None when the
+    #: engine is driven standalone): rides the request span, every
+    #: lifecycle trace event, and the KV-migration wire so one request
+    #: is ONE connected trace across replicas
+    trace_id: Optional[str] = None
 
 
 def _horizon_pages_needed(length: int, budget: int, page_size: int) -> int:
@@ -634,6 +640,14 @@ class InferenceEngineV2:
         return PhaseTimer(name, sink=lambda _n, dt: hist.observe(dt), **attrs)
 
     # -- request lifecycle bookkeeping ---------------------------------------
+    def _reqtrace(self, seq: SequenceState):
+        """The fleet ledger entry for ``seq`` (None when the engine runs
+        standalone — every reqtrace hook below is then a no-op)."""
+        if seq is None or seq.trace_id is None:
+            return None
+        led = get_reqtrace_ledger()
+        return None if led is None else led.get(seq.trace_id)
+
     def _note_tokens(self, seq: SequenceState, n: int = 1,
                      t: Optional[float] = None) -> None:
         """Account ``n`` newly emitted tokens against the request: the
@@ -651,19 +665,31 @@ class InferenceEngineV2:
             m["t_first"] = now
             ttft = now - m["t0"]
             self._m_ttft_h.observe(ttft)
+            tr = self._reqtrace(seq)
+            if tr is not None:
+                # ledger TTFT is set-once from FIRST submission (a
+                # re-dispatched request keeps its original clock); the
+                # histogram above keeps per-(re)enqueue semantics
+                tr.note_first_token(now)
+                tr.transition("decode",
+                              getattr(self, "trace_owner", "engine"), now)
             if 0 < self.config.slo_ttft_s < ttft:
                 self._m_ttft_viol.inc()
+                slo_exemplar("deepspeed_tpu_serving_slo_ttft_violations_total",
+                             seq.trace_id, uid=seq.uid,
+                             ttft_s=round(ttft, 6))
                 self._slo_violation("ttft", ttft, self.config.slo_ttft_s,
-                                    seq.uid)
+                                    seq.uid, seq.trace_id)
         m["t_last"] = now
         m["n"] += n
 
     def _slo_violation(self, kind: str, value: float, limit: float,
-                       uid: int) -> None:
+                       uid: int, trace_id: Optional[str] = None) -> None:
         """One call site for the ``slo_violation`` event (TTFT and TPOT
         both thread through here — the name lint wants a single owner)."""
         record_event("slo_violation", cat="serve", kind=kind,
-                     value=round(value, 6), limit=limit, uid=uid)
+                     value=round(value, 6), limit=limit, uid=uid,
+                     **({} if trace_id is None else {"trace_id": trace_id}))
 
     def _finish_request(self, seq: SequenceState) -> None:
         """Close the request span and observe TPOT (mean inter-token
@@ -676,10 +702,17 @@ class InferenceEngineV2:
             self._m_tpot_h.observe(tpot)
             if 0 < self.config.slo_tpot_s < tpot:
                 self._m_tpot_viol.inc()
+                slo_exemplar("deepspeed_tpu_serving_slo_tpot_violations_total",
+                             seq.trace_id, uid=seq.uid,
+                             tpot_s=round(tpot, 6))
                 self._slo_violation("tpot", tpot, self.config.slo_tpot_s,
-                                    seq.uid)
+                                    seq.uid, seq.trace_id)
         end_span(m["span"], generated=m["n"],
                  total_s=round(time.perf_counter() - m["t0"], 6))
+        if seq.trace_id is not None:
+            led = get_reqtrace_ledger()
+            if led is not None:
+                led.finish(seq.trace_id, seq.finish_reason or "complete")
 
     def _pool_occupancy(self) -> Dict[str, int]:
         """Current KV page-pool occupancy, attached to every admission/
@@ -745,7 +778,8 @@ class InferenceEngineV2:
 
             hint = retry_after_hint(len(self._queue))
             if record_shed:
-                _record_shed(request.priority, "engine_queue_full", hint)
+                _record_shed(request.priority, "engine_queue_full", hint,
+                             uid=request.uid, trace_id=request.trace_id)
             raise RejectedError("engine_queue_full", retry_after_s=hint,
                                 priority=request.priority)
         now = time.perf_counter()
@@ -757,13 +791,17 @@ class InferenceEngineV2:
             deadline=(now + max(0.0, float(request.deadline_s))
                       if request.deadline_s is not None else 0.0),
             enqueue_order=next(self._enqueue_counter),
-            queued_at=now))
+            queued_at=now, trace_id=request.trace_id))
         self._req_meta[uid] = {
             "t0": now, "t_first": None, "t_last": None,
             "n": 0,
             "span": begin_span("request", cat="serve", uid=uid,
                                prompt_tokens=n, priority=request.priority,
-                               max_new_tokens=request.max_new_tokens)}
+                               max_new_tokens=request.max_new_tokens,
+                               **({} if request.trace_id is None
+                                  else {"trace_id": request.trace_id,
+                                        "replica": getattr(
+                                            self, "trace_owner", "engine")}))}
         self._m_requests.inc()
         self._m_queue.set(len(self._queue))
         return uid
@@ -825,11 +863,26 @@ class InferenceEngineV2:
             model_sig=(self.cfg.n_layers, self.cfg.kv_heads,
                        self.cfg.head_dim),
             kv_quant=bool(self.config.kv_quant), dtype=self.config.dtype)
+        tr = self._reqtrace(seq)
+        if tr is not None:
+            # the handoff starts here: the ledger phase flips to
+            # kv_transfer, and the bundle carries the trace context —
+            # trace id, clock-free ledger snapshot, per-hop stamp list
+            # (the wire codec appends wall stamps as the bytes move)
+            tr.transition("kv_transfer",
+                          getattr(self, "trace_owner", "engine"))
+            bundle.trace = {"trace_id": seq.trace_id,
+                            "snapshot": tr.wire_snapshot(), "hops": []}
+        elif seq.trace_id is not None:
+            bundle.trace = {"trace_id": seq.trace_id, "snapshot": None,
+                            "hops": []}
+        record_event("kv_export", cat="serve", uid=uid,
+                     pages=len(seq.pages), tokens=len(seq.tokens),
+                     **({} if seq.trace_id is None
+                        else {"trace_id": seq.trace_id}))
         # the gather runs op-by-op outside the step programs: announce
         # its compiles so no sentinel flags them as steady-state
         sentinel_expect_recompile("kv_export")
-        record_event("kv_export", cat="serve", uid=uid,
-                     pages=len(seq.pages), tokens=len(seq.tokens))
         return bundle
 
     def _check_bundle(self, b: KVPageBundle) -> None:
@@ -905,6 +958,9 @@ class InferenceEngineV2:
             for j in fresh:
                 if j < len(keys):
                     self.allocator.register(pages[j], keys[j])
+        trace_id = None
+        if bundle.trace is not None:
+            trace_id = bundle.trace.get("trace_id")
         seq = SequenceState(
             uid=bundle.uid, tokens=list(bundle.tokens),
             prompt_len=bundle.prompt_len,
@@ -914,12 +970,26 @@ class InferenceEngineV2:
             decode_entry=bundle.decode_entry, page_keys=keys,
             registered_upto=len(keys),
             priority=bundle.priority, deadline=bundle.deadline,
-            enqueue_order=next(self._enqueue_counter))
+            enqueue_order=next(self._enqueue_counter), trace_id=trace_id)
         seq.admit_order = next(self._admit_counter)
         self._slots[slot] = seq
         self._page_table[slot, :] = self.block.trash_page
         self._page_table[slot, :len(pages)] = pages
         now = time.perf_counter()
+        if trace_id is not None:
+            led = get_reqtrace_ledger()
+            if led is not None:
+                tr = led.get(trace_id)
+                if tr is None and bundle.trace.get("snapshot") is not None:
+                    # cross-process import: re-anchor the sender's
+                    # ledger here, wire transit folded into kv_transfer
+                    tr = led.adopt(bundle.trace["snapshot"],
+                                   transit_s=float(bundle.trace.get(
+                                       "transit_s", 0.0)))
+                if tr is not None:
+                    tr.transition("decode",
+                                  getattr(self, "trace_owner", "engine"),
+                                  now)
         # TTFT belongs to the exporting engine (it sampled the first
         # token); local TPOT accounting restarts at the handoff
         self._req_meta[bundle.uid] = {
@@ -927,9 +997,15 @@ class InferenceEngineV2:
             "t_last": now, "n": bundle.generated,
             "span": begin_span("request_migrated", cat="serve",
                                uid=bundle.uid, tokens=len(bundle.tokens),
-                               adopted_pages=sum(reused))}
+                               adopted_pages=sum(reused),
+                               **({} if trace_id is None
+                                  else {"trace_id": trace_id,
+                                        "replica": getattr(
+                                            self, "trace_owner",
+                                            "engine")}))}
         record_event("kv_import", cat="serve", uid=bundle.uid, slot=slot,
                      pages=n, adopted=sum(reused),
+                     **({} if trace_id is None else {"trace_id": trace_id}),
                      **self._pool_occupancy())
         self._publish_pool_gauges()
         return True
@@ -1225,9 +1301,17 @@ class InferenceEngineV2:
         seq.queued_at = time.perf_counter()
         self._queue.insert(0, seq)
         self._m_preemptions.inc()
+        tr = self._reqtrace(seq)
+        if tr is not None:
+            # back to queue_wait; the re-run prefill chunks will ledger
+            # as recompute (work the eviction bought, not first prefill)
+            tr.note_preempt(getattr(self, "trace_owner", "engine"),
+                            seq.queued_at)
         occ = self._pool_occupancy()
         record_event("preempt", cat="serve", uid=seq.uid,
-                     prefix_tokens=seq.length, **occ)
+                     prefix_tokens=seq.length,
+                     **({} if seq.trace_id is None
+                        else {"trace_id": seq.trace_id}), **occ)
         # preemptions are rare and always a capacity question — log the
         # occupancy that forced this one so "why was this request
         # preempted" is answerable without a trace dump
@@ -1354,9 +1438,18 @@ class InferenceEngineV2:
             seq.admit_order = next(self._admit_counter)
             self._page_table[i, :] = self.block.trash_page
             self._page_table[i, :len(seq.pages)] = seq.pages
+            tr = self._reqtrace(seq)
+            if tr is not None:
+                # queue_wait closes here; "prefill" self-classifies as
+                # recompute after a preemption or re-dispatch
+                tr.transition("prefill",
+                              getattr(self, "trace_owner", "engine"))
             record_event("admit", cat="serve", uid=seq.uid, slot=i,
                          cache_hit_pages=m, new_pages=len(fresh),
-                         full_hit=full_hit, **self._pool_occupancy())
+                         full_hit=full_hit,
+                         **({} if seq.trace_id is None
+                            else {"trace_id": seq.trace_id}),
+                         **self._pool_occupancy())
             admitted.append(seq)
             self._slots[i] = seq
         self._publish_pool_gauges()
@@ -1430,8 +1523,12 @@ class InferenceEngineV2:
         record — the stream ends loudly, it does not hang."""
         seq.finish_reason = "deadline"
         self._m_deadline.inc()
+        slo_exemplar("deepspeed_tpu_serving_slo_deadline_exceeded_total",
+                     seq.trace_id, uid=seq.uid, generated=seq.generated)
         record_event("deadline_expired", cat="serve", uid=seq.uid,
-                     generated=seq.generated, priority=seq.priority)
+                     generated=seq.generated, priority=seq.priority,
+                     **({} if seq.trace_id is None
+                        else {"trace_id": seq.trace_id}))
         if seq.slot >= 0:
             self._retire(seq)  # single owner of the slotted teardown
         else:

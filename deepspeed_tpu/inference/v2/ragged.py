@@ -619,6 +619,12 @@ class KVPageBundle:
     #: it survives a clock-domain change across processes.
     priority: int = PRIORITY_NORMAL
     deadline: float = 0.0
+    #: fleet trace context (docs/OBSERVABILITY.md "Request tracing"):
+    #: ``{"trace_id", "snapshot", "hops"}`` — the router-minted trace id,
+    #: the sender's clock-free ledger snapshot, and per-hop wall stamps.
+    #: None on legacy bundles and engine-standalone exports; the wire
+    #: format carries it as an optional header block (tolerant parse).
+    trace: Optional[Dict[str, Any]] = None
 
     @property
     def n_pages(self) -> int:
@@ -679,6 +685,10 @@ class SequenceState:
     #: why the sequence finished: "length" (max_new_tokens), "eos",
     #: "max_seq_len", "deadline"; "" while running
     finish_reason: str = ""
+    #: router-minted fleet trace id (None when the engine is used
+    #: standalone): the cross-replica correlation key — uids are
+    #: per-engine and collide across a fleet
+    trace_id: Optional[str] = None
 
     @property
     def length(self) -> int:

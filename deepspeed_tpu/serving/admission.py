@@ -33,6 +33,7 @@ from typing import Any, Optional, Sequence
 
 from ..inference.v2.ragged import RejectedError
 from ..telemetry import get_registry
+from ..telemetry.reqtrace import slo_exemplar
 from ..telemetry.spans import record_event
 from ..utils.logging import logger
 
@@ -55,13 +56,18 @@ def retry_after_hint(queued: int, est_pages: int = 0) -> float:
 
 
 def record_shed(priority: int, reason: str, retry_after_s: float,
-                uid: Optional[int] = None) -> None:
-    """Account one shed decision (counter + trace event) — shared by the
-    fleet controller below and the engine-level bounded queue."""
+                uid: Optional[int] = None,
+                trace_id: Optional[str] = None) -> None:
+    """Account one shed decision (counter + trace event + trace
+    exemplar) — shared by the fleet controller below and the
+    engine-level bounded queue."""
     shed_counter().inc(priority=str(int(priority)))
+    slo_exemplar("deepspeed_tpu_serving_slo_shed_total", trace_id,
+                 reason=reason, priority=int(priority))
     record_event("shed", cat="serve", priority=int(priority),
                  reason=reason, retry_after_s=retry_after_s,
-                 **({} if uid is None else {"uid": uid}))
+                 **({} if uid is None else {"uid": uid}),
+                 **({} if trace_id is None else {"trace_id": trace_id}))
 
 
 def estimate_pages(prompt_tokens: int, max_new_tokens: int,
@@ -128,17 +134,20 @@ class AdmissionController:
         queued = self.fleet_queue_depth(candidates)
         if cfg.max_queue_depth and queued >= cfg.max_queue_depth:
             self._shed(prio, "queue_full", queued, est,
-                       uid=getattr(request, "uid", None))
+                       uid=getattr(request, "uid", None),
+                       trace_id=getattr(request, "trace_id", None))
         if cfg.shed_occupancy and \
                 self.best_occupancy(candidates, est) > cfg.shed_occupancy:
             self._shed(prio, "pool_pressure", queued, est,
-                       uid=getattr(request, "uid", None))
+                       uid=getattr(request, "uid", None),
+                       trace_id=getattr(request, "trace_id", None))
         return est
 
     def _shed(self, priority: int, reason: str, queued: int, est: int,
-              uid: Optional[int] = None) -> None:
+              uid: Optional[int] = None,
+              trace_id: Optional[str] = None) -> None:
         hint = retry_after_hint(queued, est)
-        record_shed(priority, reason, hint, uid=uid)
+        record_shed(priority, reason, hint, uid=uid, trace_id=trace_id)
         logger.warning(
             f"admission: shed priority-{priority} request ({reason}: "
             f"{queued} queued fleet-wide, ~{est} KV pages needed); "

@@ -48,6 +48,15 @@ class CorruptBundleError(ValueError):
     the correct reaction is to retry or re-export, never to import."""
 
 
+def _trace_crc(trace: Dict[str, Any]) -> int:
+    """CRC32 over the canonical JSON of the trace block — its OWN
+    checksum, separate from the page CRCs: a torn trace block must be
+    refused by name, not silently imported as a null trace (which would
+    be indistinguishable from a legacy bundle)."""
+    blob = json.dumps(trace, sort_keys=True, separators=(",", ":"))
+    return zlib.crc32(blob.encode()) & 0xFFFFFFFF
+
+
 def migrate_sequence(src_engine: Any, dst_engine: Any, uid: int) -> int:
     """Move one decode-ready sequence from ``src_engine`` to
     ``dst_engine``.  Returns the number of KV pages moved (truthy) on
@@ -137,6 +146,19 @@ def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
                    for n in leaves],
         "page_crcs": page_crcs(bundle.arrays, leaves),
     }
+    if bundle.trace is not None:
+        # optional trace-context block (fleet request tracing): the
+        # router-minted trace_id, a clock-free ledger snapshot, and the
+        # per-hop send stamps.  OPTIONAL by construction — absent on
+        # legacy bundles, and its absence never fails an import.
+        trace = dict(bundle.trace)
+        # dstpu-lint: allow[wall-clock] per-hop wire timestamp; transit
+        # is measured sender-wall vs receiver-wall (same contract as
+        # sent_unix above — monotonic clocks don't cross machines)
+        hop = {"sent_unix": time.time()}
+        trace["hops"] = list(trace.get("hops") or []) + [hop]
+        header["trace"] = trace
+        header["trace_crc"] = _trace_crc(trace)
     buf = io.BytesIO()
     hdr = json.dumps(header).encode()
     buf.write(_MAGIC)
@@ -202,6 +224,24 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
             f"corrupt bundle: CRC32 mismatch on page(s) {bad} of "
             f"{len(got)} (bit flip or torn write in transport) — "
             "refused; source still holds the sequence")
+    trace = None
+    if "trace" in header:
+        trace = header["trace"]
+        want_crc = header.get("trace_crc")
+        if (not isinstance(trace, dict) or want_crc is None
+                or _trace_crc(trace) != int(want_crc)):
+            raise CorruptBundleError(
+                "corrupt bundle: trace block failed its CRC32 (torn or "
+                "bit-flipped trace context) — refused; a legacy bundle "
+                "would OMIT the block, not carry a broken one")
+        hops = trace.get("hops") or []
+        if hops and hops[-1].get("sent_unix") is not None:
+            # dstpu-lint: allow[wall-clock] receive stamp paired with the
+            # sender's wall-clock hop stamp (cross-host transit measure)
+            now_unix = time.time()
+            hops[-1]["recv_unix"] = now_unix
+            trace["transit_s"] = max(
+                0.0, now_unix - float(hops[-1]["sent_unix"]))
     left = header.get("deadline_left_s")
     if left is not None and header.get("sent_unix") is not None:
         # dstpu-lint: allow[wall-clock] transit vs the sender's wall-clock
@@ -225,7 +265,8 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
         kv_quant=header["kv_quant"], dtype=header["dtype"],
         priority=int(header.get("priority", 1)),
         deadline=(time.perf_counter() + float(left)
-                  if left is not None else 0.0))
+                  if left is not None else 0.0),
+        trace=trace)
 
 
 __all__ = ["migrate_sequence", "bundle_to_bytes", "bundle_from_bytes",

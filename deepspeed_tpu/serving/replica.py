@@ -64,6 +64,10 @@ class EngineReplica:
         self.name = name
         self.engine = engine
         self.role = role
+        # the engine stamps this name as the owner of its reqtrace
+        # ledger intervals and span attrs: N co-located replicas share
+        # one process, so per-replica attribution must ride the engine
+        engine.trace_owner = name
         #: signal/maintenance-notice injection point (PR 5): the router
         #: polls ``preempted`` each pump and retires the replica
         #: gracefully.  No process-level signal handlers here — N

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ..inference.v2.engine_v2 import RaggedRequest
 from ..inference.v2.ragged import PrefixCache, RejectedError
 from ..telemetry import get_registry
+from ..telemetry.reqtrace import get_reqtrace_ledger, slo_exemplar
 from ..telemetry.spans import record_event
 from ..utils.logging import logger
 from .admission import AdmissionController, record_shed, retry_after_hint
@@ -52,6 +53,14 @@ from .replica import (BREAKER_OPEN, ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL,
 
 #: breaker_state gauge encoding (docs/OBSERVABILITY.md)
 _BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+#: per-process router instance counter: the trace-id namespace.  Request
+#: uids are PER-ENGINE (two replicas both have a uid 0), so cross-replica
+#: correlation keys on the router-minted ``trace_id`` instead — and two
+#: routers in one process (drills build several fleets) must not collide
+#: either.  Counter-based, never uuid/time: drills replay bit-identically
+#: under ``--seed``.
+_ROUTER_SEQ = itertools.count()
 
 
 # -- pure routing policy (unit-testable without engines) ---------------------
@@ -101,9 +110,11 @@ class _RequestRecord:
     """Router-side view of one request across replica hops."""
 
     __slots__ = ("request", "replica", "emitted", "done", "failed",
-                 "redispatches", "finish_reason", "deadline_abs")
+                 "redispatches", "finish_reason", "deadline_abs",
+                 "trace_id", "submitted_at")
 
-    def __init__(self, request: RaggedRequest):
+    def __init__(self, request: RaggedRequest,
+                 trace_id: Optional[str] = None):
         self.request = request
         self.replica: Optional[str] = None  # current owner
         self.emitted: List[int] = []        # tokens streamed so far
@@ -111,9 +122,15 @@ class _RequestRecord:
         self.failed = False
         self.redispatches = 0
         self.finish_reason = ""             # set when done
+        #: the fleet-unique correlation key (router-minted)
+        self.trace_id = trace_id
+        #: FIRST-submission stamp: re-dispatch hops re-enqueue with a
+        #: fresh engine clock, but end-to-end accounting (the reqtrace
+        #: ledger) measures from here
+        self.submitted_at = time.perf_counter()
         #: absolute expiry on this process's perf_counter clock; hops
         #: (re-dispatch) carry the REMAINING budget, not a fresh one
-        self.deadline_abs = (time.perf_counter() + request.deadline_s
+        self.deadline_abs = (self.submitted_at + request.deadline_s
                              if request.deadline_s is not None else None)
 
     def deadline_left(self) -> Optional[float]:
@@ -147,6 +164,13 @@ class FleetRouter:
         self._page_size = ps.pop()
         self._requests: Dict[int, _RequestRecord] = {}
         self._uid = itertools.count()
+        #: fleet request tracing: this router's trace-id namespace plus
+        #: the (shared, process-default) lifecycle ledger — co-located
+        #: replicas write into the same ledger, so one request is ONE
+        #: trace across its prefill/decode/re-dispatch hops
+        self._trace_prefix = f"r{next(_ROUTER_SEQ)}"
+        self._trace_seq = itertools.count()
+        self.reqtrace = get_reqtrace_ledger(create=True)
         self.admission = AdmissionController(self.config)
         self._init_metrics()
         self._publish()
@@ -272,14 +296,31 @@ class FleetRouter:
         (``inference.v2 max_queue_depth``) they are refused only when
         EVERY accepting engine's queue is full — backpressure of last
         resort, counted as one shed."""
+        # the trace id is minted BEFORE admission so a shed carries the
+        # exemplar of the request it refused; the ledger entry of a shed
+        # request finishes immediately (reason="shed") — its whole
+        # lifetime was one queue_wait interval at the front door
+        trace_id = f"{self._trace_prefix}-{next(self._trace_seq)}"
+        request.trace_id = trace_id
+        self.reqtrace.begin(trace_id, priority=request.priority)
         # admission BEFORE allocating a uid: a shed request was never in
         # the fleet (no record, no partial state to clean up)
         cands = self._candidates(ROLE_PREFILL)
-        self.admission.check(request, cands)
+        try:
+            self.admission.check(request, cands)
+        except RejectedError:
+            self.reqtrace.finish(trace_id, "shed")
+            raise
+        except BaseException:
+            self.reqtrace.discard(trace_id)
+            raise
         target, via = self._route(request.prompt_ids, cands)
         uid = next(self._uid)
-        rec = _RequestRecord(request)
+        rec = _RequestRecord(request, trace_id=trace_id)
         self._requests[uid] = rec
+        tr = self.reqtrace.get(trace_id)
+        if tr is not None:
+            tr.uid = uid
         # an engine-level bounded queue may refuse the favorite: try the
         # remaining candidates coolest-first (record_shed=False in
         # _place_engine — at most ONE shed per request, counted here,
@@ -290,7 +331,7 @@ class FleetRouter:
                 max_new_tokens=request.max_new_tokens,
                 temperature=request.temperature, eos_id=request.eos_id,
                 uid=uid, priority=request.priority,
-                deadline_s=request.deadline_s)
+                deadline_s=request.deadline_s, trace_id=trace_id)
             placed = self._place_engine(req, target, cands)
             if placed is None:
                 # roles are preferences, not gates: before shedding, try
@@ -310,7 +351,8 @@ class FleetRouter:
                 hint = retry_after_hint(
                     self.admission.fleet_queue_depth(cands))
                 record_shed(request.priority, "engine_queue_full", hint,
-                            uid=uid)
+                            uid=uid, trace_id=trace_id)
+                self.reqtrace.finish(trace_id, "shed")
                 logger.warning(
                     f"fleet: shed priority-{request.priority} request — "
                     "every accepting engine's bounded queue is full; "
@@ -323,13 +365,16 @@ class FleetRouter:
         except BaseException:
             # the request was never admitted anywhere: a ghost record
             # with done=False would wedge has_work() True forever
+            # (the shed path above already finished the ledger entry —
+            # discard is a no-op for it)
             self._requests.pop(uid, None)
+            self.reqtrace.discard(trace_id)
             raise
         rec.replica = target.name
         self._m_requests.inc()
         record_event("fleet_route", cat="serve", uid=uid,
                      replica=target.name, via=via,
-                     priority=request.priority,
+                     priority=request.priority, trace_id=trace_id,
                      prompt_tokens=len(request.prompt_ids))
         self._publish()
         return uid
@@ -350,6 +395,7 @@ class FleetRouter:
         remaining = rec.request.max_new_tokens - len(rec.emitted)
         if remaining <= 0:
             rec.done = True
+            self.reqtrace.finish(rec.trace_id, "complete")
             return
         if charge:
             rec.redispatches += 1
@@ -357,6 +403,7 @@ class FleetRouter:
                 rec.done = rec.failed = True
                 rec.replica = None
                 self._m_failed.inc()
+                self.reqtrace.finish(rec.trace_id, "failed")
                 logger.error(f"fleet: request {uid} abandoned after "
                              f"{rec.redispatches - 1} re-dispatches")
                 return
@@ -369,11 +416,18 @@ class FleetRouter:
         if not cands:
             rec.done = rec.failed = True
             self._m_failed.inc()
+            self.reqtrace.finish(rec.trace_id, "failed")
             logger.error(f"fleet: request {uid} lost — no live replicas")
             return
         key = affinity_key(prompt, self._page_size,
                            self.config.affinity_pages)
         target, _via = pick_replica(key, cands, self.config.load_gap)
+        tr = self.reqtrace.get(rec.trace_id)
+        if tr is not None:
+            # the prior-attempt ledger rides the re-dispatch (satellite:
+            # no clock restart): attempts++ and back to queue_wait; the
+            # replacement prefill classifies as recompute
+            tr.note_redispatch()
         # the hop inherits the request's REMAINING deadline budget (a
         # re-dispatch never resets the SLO clock) and its priority.
         # An engine-level bounded queue may refuse the favorite — an
@@ -387,10 +441,12 @@ class FleetRouter:
             temperature=rec.request.temperature,
             eos_id=rec.request.eos_id, uid=uid,
             priority=rec.request.priority,
-            deadline_s=rec.deadline_left()), target, cands)
+            deadline_s=rec.deadline_left(),
+            trace_id=rec.trace_id), target, cands)
         if placed is None:
             rec.done = rec.failed = True
             self._m_failed.inc()
+            self.reqtrace.finish(rec.trace_id, "failed")
             logger.error(f"fleet: request {uid} lost — every live replica "
                          "refused the re-dispatch (bounded queues full)")
             return
@@ -400,7 +456,9 @@ class FleetRouter:
             self._m_redispatch.inc()
         record_event("fleet_redispatch", cat="serve", uid=uid,
                      replica=target.name, emitted=len(rec.emitted),
-                     attempt=rec.redispatches, planned=not charge)
+                     attempt=rec.redispatches, planned=not charge,
+                     **({} if rec.trace_id is None
+                        else {"trace_id": rec.trace_id}))
 
     def _owned_uids(self, name: str) -> List[int]:
         return [uid for uid, rec in self._requests.items()
@@ -472,7 +530,9 @@ class FleetRouter:
                 self._m_migrations.inc()
                 self._m_migrated_pages.inc(moved)
                 record_event("fleet_migrate", cat="serve", uid=uid,
-                             src=src.name, dst=dst.name, pages=moved)
+                             src=src.name, dst=dst.name, pages=moved,
+                             **({} if rec is None or rec.trace_id is None
+                                else {"trace_id": rec.trace_id}))
                 return True
         self._m_migration_failures.inc()
         return False
@@ -523,6 +583,9 @@ class FleetRouter:
                 logger.info(f"fleet: breaker half-open on {r.name} — "
                             "probing with live traffic")
             elif action == "recover":
+                # dstpu-lint: allow[slo-exemplar] a recovery clears a
+                # fault condition — there is no single offending request
+                # whose trace_id could serve as the exemplar
                 self._m_breaker_recover.inc()
                 record_event("breaker_recover", cat="serve", replica=r.name)
                 logger.info(f"fleet: breaker closed on {r.name} — "
@@ -533,6 +596,12 @@ class FleetRouter:
     def _on_breaker_trip(self, r: EngineReplica, fleet_median: float) -> None:
         self._m_breaker_trips.inc()
         lost = self._owned_uids(r.name)
+        # the trip's exemplars are the streams it disrupted: every
+        # in-flight request on the tripped replica links its trace
+        for uid in lost:
+            slo_exemplar("deepspeed_tpu_serving_slo_breaker_trips_total",
+                         self._requests[uid].trace_id, replica=r.name,
+                         uid=uid)
         record_event("breaker_trip", cat="serve", replica=r.name,
                      p50_s=round(r.step_p50(), 6),
                      p95_s=round(r.step_p95(), 6),
@@ -666,7 +735,8 @@ class FleetRouter:
                 "redispatches": rec.redispatches,
                 "finish_reason": rec.finish_reason,
                 "priority": rec.request.priority,
-                "deadline_left_s": rec.deadline_left()}
+                "deadline_left_s": rec.deadline_left(),
+                "trace_id": rec.trace_id}
 
     def health(self) -> Dict[str, Any]:
         return {name: r.health() for name, r in self.replicas.items()}

@@ -33,6 +33,10 @@ from .mfu import (PEAK_BF16_FLOPS, mfu, peak_flops_for_device,
                   peak_flops_for_kind)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, get_registry, set_registry)
+from .reqtrace import (ReqTraceLedger, RequestTrace, get_reqtrace_ledger,
+                       last_reqtrace_summary, merged_trace_events,
+                       set_reqtrace_ledger, slo_exemplar,
+                       write_merged_trace)
 from .spans import (SpanRecorder, begin_span, configure_spans, end_span,
                     get_span_recorder, record_event, set_span_recorder, span,
                     trace_dump)
@@ -61,6 +65,9 @@ __all__ = [
     "last_timeline_record",
     "GoodputLedger", "get_goodput_ledger", "set_goodput_ledger",
     "last_goodput_summary",
+    "RequestTrace", "ReqTraceLedger", "get_reqtrace_ledger",
+    "set_reqtrace_ledger", "slo_exemplar", "last_reqtrace_summary",
+    "merged_trace_events", "write_merged_trace",
     "StallWatchdog", "Telemetry",
 ]
 

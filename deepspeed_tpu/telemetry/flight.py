@@ -142,6 +142,15 @@ class FlightRecorder:
             # dstpu-lint: allow[swallow] same contract as the memory record
             except Exception:
                 pass
+            try:
+                from .reqtrace import last_reqtrace_summary
+
+                rt = last_reqtrace_summary()
+                if rt is not None:
+                    line(dict({"kind": "reqtrace"}, **rt))
+            # dstpu-lint: allow[swallow] same contract as the memory record
+            except Exception:
+                pass
             line({"kind": "snapshot", "ts": time.time(),
                   "metrics": snapshot_metrics(self.registry)})
             for rec in (extra_records or []):

@@ -78,6 +78,13 @@ def _now_us() -> float:
     return (time.perf_counter() - _TRACE_ORIGIN) * 1e6
 
 
+def perf_to_us(t: float) -> float:
+    """Map a ``perf_counter`` stamp onto the span timeline (µs since the
+    process trace origin) — the reqtrace fleet merge uses this so ledger
+    phase slices and ring events share one clock."""
+    return (t - _TRACE_ORIGIN) * 1e6
+
+
 def _tid() -> int:
     try:
         return threading.get_native_id()

@@ -97,6 +97,45 @@ def test_catalog_checks_skipped_without_doc(tmp_path):
     assert lint.check(str(tmp_path)) == []
 
 
+def test_reqtrace_family_is_single_owner_by_module(tmp_path):
+    """The `deepspeed_tpu_serving_reqtrace_*` family belongs to
+    `telemetry/reqtrace.py` alone: a second module minting into the
+    family fails by name (it would fork the request-lifecycle
+    accounting)."""
+    lint = _load_lint()
+    pkg = tmp_path / "deepspeed_tpu"
+    (pkg / "telemetry").mkdir(parents=True)
+    (tmp_path / "tools").mkdir()
+    (pkg / "telemetry" / "reqtrace.py").write_text(
+        "reg.counter('deepspeed_tpu_serving_reqtrace_requests_total')\n")
+    (pkg / "rogue.py").write_text(
+        "reg.gauge('deepspeed_tpu_serving_reqtrace_forked_requests')\n")
+    errors = lint.check(str(tmp_path))
+    joined = "\n".join(errors)
+    assert "deepspeed_tpu_serving_reqtrace_forked_requests" in joined
+    assert "outside the family owner" in joined
+    assert "telemetry" in joined and "reqtrace.py" in joined
+    # the legitimate owner's registration produced no error
+    assert "deepspeed_tpu_serving_reqtrace_requests_total" not in joined
+
+
+def test_package_registers_reqtrace_family_in_owner_module():
+    """The real tree: all four reqtrace metrics exist and every one is
+    registered in the owning module."""
+    lint = _load_lint()
+    names = lint.collect(REPO)
+    family = {n: sites for n, sites in names.items()
+              if n.startswith("deepspeed_tpu_serving_reqtrace_")}
+    assert set(family) == {
+        "deepspeed_tpu_serving_reqtrace_requests_total",
+        "deepspeed_tpu_serving_reqtrace_phase_seconds_total",
+        "deepspeed_tpu_serving_reqtrace_open_requests",
+        "deepspeed_tpu_serving_reqtrace_exemplars_total"}
+    owner = os.path.join("deepspeed_tpu", "telemetry", "reqtrace.py")
+    for n, sites in family.items():
+        assert all(f == owner for f, _ln, _t in sites), (n, sites)
+
+
 def test_lint_ignores_unrelated_calls(tmp_path):
     lint = _load_lint()
     pkg = tmp_path / "deepspeed_tpu"

@@ -1,0 +1,239 @@
+"""Request-trace ledger tests (`telemetry/reqtrace.py`).
+
+All host logic, fast tier: the phase state machine and its partition
+invariant (phases sum to end-to-end latency by construction), the
+recompute rename on re-dispatch/preemption, the clock-free wire
+snapshot round trip (including transit folding), ledger terminal
+accounting into the `deepspeed_tpu_serving_reqtrace_*` family, the SLO
+exemplar store, and the merged Perfetto artifact's schema.
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.reqtrace import (PHASES, ReqTraceLedger,
+                                              RequestTrace,
+                                              get_reqtrace_ledger,
+                                              merged_trace_events,
+                                              set_reqtrace_ledger,
+                                              slo_exemplar,
+                                              write_merged_trace)
+
+
+@pytest.fixture
+def ledger():
+    led = ReqTraceLedger(registry=MetricsRegistry())
+    set_reqtrace_ledger(led)
+    yield led
+    set_reqtrace_ledger(None)
+
+
+# ------------------------------------------------- phase state machine
+def test_phases_partition_submit_to_finish_exactly():
+    """transition() closes the open interval at the instant the next
+    opens, so per-phase seconds sum to elapsed_s with no gap/overlap."""
+    tr = RequestTrace("r1-0", uid=5, now=100.0)
+    assert tr.phase == "queue_wait"
+    tr.transition("prefill", "prefill0", now=100.5)
+    tr.transition("kv_transfer", "prefill0", now=101.25)
+    tr.transition("decode", "decode0", now=101.5)
+    tr.note_first_token(now=101.75)
+    tr.finish("complete", now=103.0)
+    ph = tr.phase_seconds()
+    assert ph["queue_wait"] == pytest.approx(0.5)
+    assert ph["prefill"] == pytest.approx(0.75)
+    assert ph["kv_transfer"] == pytest.approx(0.25)
+    assert ph["decode"] == pytest.approx(1.5)
+    assert sum(ph.values()) == pytest.approx(tr.elapsed_s(), abs=1e-12)
+    assert tr.first_token_s == pytest.approx(1.75)
+    assert tr.owners == ["router", "prefill0", "decode0"]
+    # terminal: further transitions are ignored, not corrupting
+    tr.transition("decode", "decode1", now=104.0)
+    assert tr.elapsed_s() == pytest.approx(3.0)
+
+
+def test_redispatch_keeps_original_clock_and_renames_to_recompute():
+    """Satellite: re-dispatch does NOT restart the end-to-end clock,
+    and the replacement prefill classifies as recompute."""
+    tr = RequestTrace("r1-1", now=10.0)
+    tr.transition("prefill", "prefill0", now=10.2)
+    tr.transition("decode", "decode0", now=10.6)
+    tr.note_redispatch(now=10.9)            # replica died mid-decode
+    assert tr.phase == "queue_wait" and tr.attempts == 1
+    tr.transition("prefill", "decode1", now=11.0)
+    assert tr.phase == "recompute"          # renamed, not first-attempt
+    tr.note_first_token(now=11.3)
+    tr.finish("complete", now=11.5)
+    assert tr.first_token_s == pytest.approx(1.3)   # from FIRST submit
+    ph = tr.phase_seconds()
+    assert ph["prefill"] == pytest.approx(0.4)      # first attempt only
+    assert ph["recompute"] == pytest.approx(0.5)    # the re-run
+    assert ph["queue_wait"] == pytest.approx(0.3)   # incl. re-dispatch gap
+    assert sum(ph.values()) == pytest.approx(tr.elapsed_s(), abs=1e-12)
+
+
+def test_preempt_renames_next_prefill_to_recompute():
+    tr = RequestTrace("r1-2", now=0.0)
+    tr.transition("prefill", "p0", now=0.1)
+    tr.note_preempt("p0", now=0.3)
+    tr.transition("prefill", "p0", now=0.4)
+    assert tr.phase == "recompute"
+    tr.finish("complete", now=0.6)
+    assert tr.phase_seconds()["recompute"] == pytest.approx(0.2)
+
+
+def test_unknown_phase_rejected():
+    tr = RequestTrace("r1-3", now=0.0)
+    with pytest.raises(ValueError, match="unknown reqtrace phase"):
+        tr.transition("warmup", "router")
+
+
+# ----------------------------------------------------- wire round trip
+def test_wire_snapshot_round_trip_preserves_partition_invariant():
+    """The snapshot is clock-free (durations only); re-anchoring on the
+    importing host keeps phases summing to elapsed, with transit folded
+    in as kv_transfer time."""
+    import time
+
+    t0 = time.perf_counter() - 0.8          # "submitted 0.8s ago"
+    tr = RequestTrace("r2-0", uid=9, priority=1, now=t0)
+    tr.transition("prefill", "prefill0", now=t0 + 0.3)
+    tr.transition("kv_transfer", "prefill0", now=t0 + 0.8)  # open at export
+    snap = tr.wire_snapshot()
+    assert snap["trace_id"] == "r2-0" and snap["open_phase"] == "kv_transfer"
+    assert all(len(p) == 3 for p in snap["phases"])     # durations only
+    assert snap["elapsed_s"] >= 0.8                     # wall kept running
+
+    n2 = time.perf_counter() + 5.0          # importing host, its own clock
+    rt = RequestTrace.from_wire_snapshot(snap, transit_s=0.25, now=n2)
+    assert rt.trace_id == "r2-0" and rt.uid == 9 and rt.priority == 1
+    assert rt.transit_s == pytest.approx(0.25)
+    ph = rt.phase_seconds()
+    # remote elapsed + transit tile [submit_t, n2] on the LOCAL clock
+    total = snap["elapsed_s"] + 0.25
+    assert rt.elapsed_s(now=n2) == pytest.approx(total, abs=1e-9)
+    assert sum(ph.values()) == pytest.approx(total, abs=1e-9)
+    assert ph["queue_wait"] == pytest.approx(0.3)
+    assert ph["prefill"] == pytest.approx(0.5)
+    assert ph["kv_transfer"] >= 0.25                    # transit rides here
+    # intervals are contiguous — no gaps, no overlaps
+    spans = sorted(rt.intervals, key=lambda iv: iv[2])
+    for a, b in zip(spans, spans[1:]):
+        assert b[2] == pytest.approx(a[3], abs=1e-9)
+
+
+def test_ledger_adopt_installs_wire_snapshot_as_open_trace(ledger):
+    tr = ledger.begin("r2-1", uid=3)
+    tr.transition("prefill", "p0")
+    snap = tr.wire_snapshot()
+    ledger.discard("r2-1")                  # left the exporting side
+    adopted = ledger.adopt(snap, transit_s=0.0)
+    assert ledger.get("r2-1") is adopted
+    ledger.finish("r2-1", "complete")
+    assert ledger.lookup("r2-1").done
+
+
+# ---------------------------------------------------- ledger accounting
+def test_ledger_terminal_accounting_feeds_reqtrace_metrics(ledger):
+    reg = ledger._m_requests  # registered on the fixture registry
+    tr = ledger.begin("r3-0", uid=1)
+    tr.transition("prefill", "p0")
+    tr.transition("decode", "d0")
+    ledger.begin("r3-1", uid=2)
+    assert ledger._m_open.value() == 2
+    ledger.finish("r3-0", "complete")
+    ledger.finish("r3-1", "shed")
+    assert ledger._m_open.value() == 0
+    assert reg.total() == 2
+    s = ledger.summary()
+    assert s["finished"] == 2 and s["reasons"] == {"complete": 1, "shed": 1}
+    assert sum(s["phase_seconds"].values()) >= 0.0
+    # finish is idempotent; discard of unknown ids is a no-op
+    ledger.finish("r3-0", "complete")
+    ledger.discard("never-began")
+    assert reg.total() == 2
+
+
+def test_ledger_finished_phase_seconds_sum_to_e2e(ledger):
+    tr = ledger.begin("r3-2")
+    tr.transition("prefill", "p0")
+    tr.transition("decode", "d0")
+    ledger.finish("r3-2", "complete")
+    done = ledger.lookup("r3-2")
+    assert done.done
+    assert (sum(done.phase_seconds().values())
+            == pytest.approx(done.elapsed_s(), abs=1e-9))
+
+
+# ----------------------------------------------------------- exemplars
+def test_slo_exemplar_records_trace_id_with_attrs(ledger):
+    slo_exemplar("deepspeed_tpu_serving_slo_shed_total", "r4-0",
+                 reason="queue_full", priority=2)
+    slo_exemplar("deepspeed_tpu_serving_slo_shed_total", None)  # no ctx: noop
+    ex = ledger.exemplars()
+    rows = ex["deepspeed_tpu_serving_slo_shed_total"]
+    assert rows == [{"metric": "deepspeed_tpu_serving_slo_shed_total",
+                     "trace_id": "r4-0", "reason": "queue_full",
+                     "priority": 2}]
+    assert ledger._m_exemplars.total() == 1
+
+
+def test_slo_exemplar_noop_without_ledger():
+    set_reqtrace_ledger(None)
+    assert get_reqtrace_ledger() is None
+    slo_exemplar("deepspeed_tpu_serving_slo_shed_total", "r4-1")  # no raise
+
+
+def test_exemplar_ring_is_bounded(ledger):
+    for i in range(40):
+        slo_exemplar("deepspeed_tpu_serving_slo_ttft_violations_total",
+                     f"r4-{i}")
+    rows = ledger.exemplars()[
+        "deepspeed_tpu_serving_slo_ttft_violations_total"]
+    assert len(rows) == 32                      # ring, not unbounded
+    assert rows[-1]["trace_id"] == "r4-39"      # newest kept
+
+
+# ------------------------------------------------------- merged artifact
+def test_merged_trace_artifact_schema_and_tracks(ledger, tmp_path):
+    for i, owner in enumerate(["decode0", "decode1"]):
+        tr = ledger.begin(f"r5-{i}", uid=i)
+        tr.transition("prefill", "prefill0")
+        tr.transition("kv_transfer", "prefill0")
+        tr.transition("decode", owner)
+        ledger.finish(f"r5-{i}", "complete")
+    events = merged_trace_events(ledger=ledger)
+    assert events, "finished traces must produce events"
+    for ev in events:
+        assert {"ph", "ts", "dur", "pid", "tid", "name"} <= set(ev)
+        assert ev["ph"] in ("X", "M")
+    owners = {ev["args"]["name"] for ev in events
+              if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert owners == {"router", "prefill0", "decode0", "decode1"}
+    tracks = {ev["args"]["name"] for ev in events
+              if ev["ph"] == "M" and ev["name"] == "thread_name"}
+    assert tracks == {"r5-0", "r5-1"}           # one thread per trace_id
+    for tid in ("r5-0", "r5-1"):
+        slices = {ev["name"] for ev in events if ev["ph"] == "X"
+                  and ev.get("args", {}).get("trace_id") == tid}
+        assert {"queue_wait", "prefill", "kv_transfer", "decode"} <= slices
+
+    path = str(tmp_path / "fleet_trace.json")
+    n = write_merged_trace(path, ledger=ledger)
+    with open(path) as f:
+        doc = json.load(f)
+    assert len(doc["traceEvents"]) == n == len(events)
+
+
+def test_merged_trace_empty_without_ledger():
+    set_reqtrace_ledger(None)
+    assert merged_trace_events() == []
+
+
+def test_phase_taxonomy_is_frozen():
+    """The docs' sums-to-latency contract names exactly these phases;
+    adding one is a docs + catalog change, not a drive-by."""
+    assert PHASES == ("queue_wait", "prefill", "recompute", "kv_transfer",
+                      "decode")

@@ -198,6 +198,79 @@ def test_bundle_bytes_roundtrip_without_engine():
     for leaf in arrays:
         assert rt.arrays[leaf].dtype == arrays[leaf].dtype
         assert np.array_equal(rt.arrays[leaf], arrays[leaf])
+    assert rt.trace is None  # no trace attached -> none invented
+
+
+def _trace_bundle(trace):
+    from deepspeed_tpu.inference.v2 import KVPageBundle
+
+    arrays = {"k": np.arange(1 * 1 * 8 * 2 * 2, dtype=np.float32)
+              .reshape(1, 1, 8, 2, 2)}
+    return KVPageBundle(uid=3, tokens=list(range(10)), prompt_len=9,
+                        max_new_tokens=4, temperature=0.0, eos_id=None,
+                        prefilled=9, decode_entry=False, page_size=8,
+                        page_keys=[b"\x07" * 32],
+                        src_pages=[{"page": 1, "refcount": 1,
+                                    "key": b"\x07" * 32}],
+                        arrays=arrays, model_sig=(1, 2, 2), kv_quant=False,
+                        dtype="fp32", trace=trace)
+
+
+def test_bundle_wire_preserves_trace_context():
+    """The optional trace block survives the CRC-guarded wire: id and
+    ledger snapshot intact, one hop appended with send/receive stamps,
+    and transit measured on the receive side."""
+    snap = {"trace_id": "r1-7", "elapsed_s": 0.25,
+            "phases": [["prefill", "prefill0", 0.25]]}
+    rt = bundle_from_bytes(bundle_to_bytes(_trace_bundle(snap)))
+    assert rt.trace is not None
+    assert rt.trace["trace_id"] == "r1-7"
+    assert rt.trace["phases"] == [["prefill", "prefill0", 0.25]]
+    hops = rt.trace["hops"]
+    assert len(hops) == 1
+    assert "sent_unix" in hops[0] and "recv_unix" in hops[0]
+    assert rt.trace["transit_s"] >= 0.0
+    # a second hop (re-migration) appends, never overwrites
+    rt2 = bundle_from_bytes(bundle_to_bytes(_trace_bundle(rt.trace)))
+    assert len(rt2.trace["hops"]) == 2
+
+
+def test_bundle_wire_legacy_no_trace_imports_with_null_trace():
+    """A bundle serialized WITHOUT a trace block (legacy sender) must
+    import cleanly with ``trace=None`` — the block is optional by
+    construction, not a new wire version."""
+    wire = bundle_to_bytes(_trace_bundle(None))
+    assert b'"trace_crc"' not in wire  # header simply omits the block
+    rt = bundle_from_bytes(wire)
+    assert rt.trace is None
+    assert rt.uid == 3 and np.array_equal(
+        rt.arrays["k"].ravel(), np.arange(32, dtype=np.float32))
+
+
+def test_bundle_wire_torn_trace_block_refused_by_name():
+    """A trace block whose CRC no longer matches (torn/bit-flipped in
+    transport) is refused with an error naming the trace block — never
+    silently imported with a wrong trace."""
+    from deepspeed_tpu.serving.kv_transfer import (CorruptBundleError,
+                                                   _MAGIC)
+    import json as _json
+
+    wire = bundle_to_bytes(_trace_bundle({"trace_id": "r1-9", "hops": []}))
+    off = len(_MAGIC)
+    hlen = int.from_bytes(wire[off:off + 8], "little")
+    header = _json.loads(wire[off + 8:off + 8 + hlen].decode())
+    header["trace"]["trace_id"] = "r1-FORGED"  # flip a byte, keep old CRC
+    hdr = _json.dumps(header).encode()
+    torn = (_MAGIC + len(hdr).to_bytes(8, "little") + hdr
+            + wire[off + 8 + hlen:])
+    with pytest.raises(CorruptBundleError, match="trace block"):
+        bundle_from_bytes(torn)
+    # page payload itself is intact: stripping the trace keys imports fine
+    header.pop("trace"), header.pop("trace_crc")
+    hdr = _json.dumps(header).encode()
+    ok = (_MAGIC + len(hdr).to_bytes(8, "little") + hdr
+          + wire[off + 8 + hlen:])
+    assert bundle_from_bytes(ok).trace is None
 
 
 # ----------------------------- slow: engine oracles -------------------------

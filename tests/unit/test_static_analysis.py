@@ -216,6 +216,55 @@ def test_hazard_marker_rides_comment_block_and_statement(tmp_path):
     assert hl.check(root) == []
 
 
+def test_hazard_slo_exemplar_contract_fails_by_name(tmp_path):
+    """The exemplar-coverage contract: a `deepspeed_tpu_serving_slo_*`
+    `.inc()` inside a function that never calls `slo_exemplar` fails by
+    name — for BOTH counter idioms (name/attribute bound at
+    registration, and an accessor function returning a registration)."""
+    hl = _hazard_lint()
+    root = _write_tree(tmp_path, {
+        "deepspeed_tpu/serving/slo_x.py":
+            "from deepspeed_tpu.telemetry.reqtrace import slo_exemplar\n"
+            "class Shed:\n"
+            "    def __init__(self, reg):\n"
+            "        self._m_shed = reg.counter(\n"
+            "            'deepspeed_tpu_serving_slo_shed_total', 'h',\n"
+            "            labelnames=('reason',))\n"
+            "    def bad(self):\n"
+            "        self._m_shed.inc(reason='queue_full')\n"
+            "    def good(self, tid):\n"
+            "        self._m_shed.inc(reason='queue_full')\n"
+            "        slo_exemplar('deepspeed_tpu_serving_slo_shed_total',\n"
+            "                     tid, reason='queue_full')\n"
+            "def ttft_counter(reg):\n"
+            "    return reg.counter(\n"
+            "        'deepspeed_tpu_serving_slo_ttft_violations_total', 'h')\n"
+            "def also_bad(reg):\n"
+            "    ttft_counter(reg).inc()\n"})
+    vs = [v for v in hl.check(root) if v.rule == "slo-exemplar"]
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 2, msgs                    # bad + also_bad, not good
+    assert "deepspeed_tpu_serving_slo_shed_total.inc() in 'bad'" in msgs
+    assert ("deepspeed_tpu_serving_slo_ttft_violations_total.inc() "
+            "in 'also_bad'") in msgs
+    assert "offending trace_id" in msgs
+
+    # no-single-request increments (breaker recovery) suppress with a
+    # REASONED marker like every other rule
+    root2 = _write_tree(tmp_path / "ok", {
+        "deepspeed_tpu/serving/slo_x.py":
+            "class B:\n"
+            "    def __init__(self, reg):\n"
+            "        self._m_rec = reg.counter(\n"
+            "            'deepspeed_tpu_serving_slo_breaker_recoveries_total'"
+            ", 'h')\n"
+            "    def recover(self):\n"
+            "        # dstpu-lint: allow[slo-exemplar] a recovery clears a\n"
+            "        # replica-level state; there is no offending request\n"
+            "        self._m_rec.inc()\n"})
+    assert [v for v in hl.check(root2) if v.rule == "slo-exemplar"] == []
+
+
 # ---------------------------------------------------------- HLO contracts
 @pytest.fixture(scope="module")
 def contracts_mod():

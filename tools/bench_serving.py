@@ -125,6 +125,61 @@ def _observability_sections(timeline_rec, goodput_ledger,
     return sections
 
 
+def _reqtrace_annex(model, params, page: int) -> dict:
+    """``reqtrace`` section for the bench JSON: a short fleet-routed
+    wave on a FRESH request-trace ledger (docs/OBSERVABILITY.md
+    "Request tracing") — writes the merged multi-replica trace artifact
+    (``DSTPU_SBENCH_TRACE_OUT``, default ./bench_serving_trace.json)
+    and reports per-phase ledger medians.  Runs OUTSIDE every timed
+    window, on the bench's own model and weights."""
+    try:
+        import statistics
+
+        from deepspeed_tpu.inference.v2 import (RaggedInferenceConfig,
+                                                RaggedRequest)
+        from deepspeed_tpu.serving import ServingConfig, build_fleet
+        from deepspeed_tpu.telemetry.reqtrace import (ReqTraceLedger,
+                                                      set_reqtrace_ledger,
+                                                      write_merged_trace)
+
+        led = ReqTraceLedger()
+        set_reqtrace_ledger(led)
+        fleet = build_fleet(
+            model, ServingConfig(enabled=True, prefill_replicas=1,
+                                 decode_replicas=1, disaggregated=True,
+                                 prefill_chunk=page),
+            engine_config=RaggedInferenceConfig(
+                page_size=page, num_pages=64, max_seqs=4,
+                max_pages_per_seq=12, enable_prefix_cache=True),
+            params=params)
+        rng = np.random.RandomState(2)
+        vocab = model.config.vocab_size
+        prefix = rng.randint(1, vocab, 2 * page).tolist()
+        uids = [fleet.submit(RaggedRequest(
+            prompt_ids=prefix + rng.randint(1, vocab, 3 + i).tolist(),
+            max_new_tokens=4)) for i in range(3)]
+        for _ in range(400):
+            if not fleet.has_work():
+                break
+            fleet.step()
+        out_path = os.path.abspath(os.environ.get(
+            "DSTPU_SBENCH_TRACE_OUT", "bench_serving_trace.json"))
+        write_merged_trace(out_path, ledger=led)
+        per_phase = {}
+        for u in uids:
+            tr = led.lookup(fleet.request_state(u)["trace_id"])
+            if tr is None:
+                continue
+            for p, s in tr.phase_seconds().items():
+                per_phase.setdefault(p, []).append(s)
+        medians = {p: round(statistics.median(v), 6)
+                   for p, v in sorted(per_phase.items())}
+        return {"reqtrace": {"merged_trace_path": out_path,
+                             "phase_medians_s": medians}}
+    except Exception:
+        return {}  # tracing must never sink the benchmark numbers
+
+
 def _new_goodput_ledger():
     """Fresh private-registry ledger, or None when telemetry is broken."""
     try:
@@ -238,6 +293,7 @@ def main() -> None:
     }
     result.update(_observability_sections(
         tl_rec, gp, warm_off + warm_on, dt_off + dt_on, measured_steps=2))
+    result.update(_reqtrace_annex(model, params, page))
     reason = os.environ.get("DSTPU_BENCH_FALLBACK_REASON", "")
     if reason and jax.default_backend() == "cpu":
         result["fallback_reason"] = reason

@@ -45,6 +45,15 @@ weights:
   **bit-identical** to an UNCAPPED single-engine control, the
   allocator audit must stay green with in-flight spill pins accounted,
   and the host-tier occupancy must surface in replica ``health()``.
+* **Tracing leg** (fresh fleet, fresh request-trace ledger) — the
+  disaggregated prefill→decode handoff plus a mid-stream replica kill
+  must each read as ONE connected trace per request in the merged
+  fleet Perfetto artifact (``fleet_trace.json``: prefill, KV transit,
+  decode and recompute as distinct slices keyed by the router-minted
+  ``trace_id``); every request's phase ledger must sum to its
+  end-to-end latency; and the forced TTFT violations (unmeetable
+  ``slo_ttft_s``) must carry exemplars resolving to traces present in
+  the artifact.
 * **Metric-name lint** — the run registers the
   ``deepspeed_tpu_serving_fleet_*`` + ``deepspeed_tpu_serving_slo_*``
   + ``deepspeed_tpu_serving_kv_tier_*`` families, then
@@ -208,6 +217,27 @@ def _build(n_requests: int, new_tokens: int, seed: int = 7):
                     max_new_tokens=new_tokens) for i in range(per_fam)])
         return waves
 
+    def build_trace_fleet():
+        """Fresh 1-prefill + 2-decode disaggregated fleet on a FRESH
+        request-trace ledger, with an unmeetable TTFT SLO
+        (``slo_ttft_s`` = 1µs) so every stream records a violation
+        exemplar — the tracing leg proves each exemplar resolves to a
+        trace in the merged artifact."""
+        from deepspeed_tpu.telemetry.reqtrace import (ReqTraceLedger,
+                                                      set_reqtrace_ledger)
+
+        led = ReqTraceLedger()
+        set_reqtrace_ledger(led)
+        tr_base = RaggedInferenceConfig(
+            dtype="fp32", page_size=PAGE_SIZE, num_pages=64, max_seqs=4,
+            max_pages_per_seq=12, enable_prefix_cache=True,
+            slo_ttft_s=1e-6)
+        tr_serving = ServingConfig(
+            enabled=True, prefill_replicas=1, decode_replicas=2,
+            disaggregated=True, affinity_pages=2, prefill_chunk=PAGE_SIZE)
+        return build_fleet(model, tr_serving, engine_config=tr_base,
+                           params=params), led
+
     def build_multistep_fleet():
         """Fresh 1-prefill + 1-decode fleet with the fused multi-step
         decode horizon applied fleet-wide (``serving.decode_horizon``
@@ -222,7 +252,8 @@ def _build(n_requests: int, new_tokens: int, seed: int = 7):
                            params=params)
 
     return (fleet, make_requests, control_run, build_slo_fleet,
-            build_tier_fleet, make_tier_waves, build_multistep_fleet)
+            build_tier_fleet, make_tier_waves, build_multistep_fleet,
+            build_trace_fleet)
 
 
 def run_demo(out: str, n_requests: int, new_tokens: int,
@@ -234,8 +265,8 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
     print(f"fleet drill: {n_requests} requests x {new_tokens} tokens, "
           f"1 prefill + 2 decode replicas, seed {seed} -> {out}")
     (fleet, make_requests, control_run, build_slo_fleet,
-     build_tier_fleet, make_tier_waves, build_multistep_fleet) = _build(
-        n_requests, new_tokens, seed)
+     build_tier_fleet, make_tier_waves, build_multistep_fleet,
+     build_trace_fleet) = _build(n_requests, new_tokens, seed)
     reg = get_registry()
 
     def counter(name):
@@ -576,6 +607,85 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
            ms_leaks[:2] if ms_leaks else
            f"{len(ms_fleet.replicas)} replicas audited")
 
+    # ---- leg 8: fleet-wide request tracing — fresh disaggregated fleet
+    # + mid-stream kill on a fresh ledger; every request must read as ONE
+    # connected trace in the merged artifact, its phase ledger must sum
+    # to end-to-end latency, and the forced TTFT violations must carry
+    # exemplars that resolve INTO the artifact
+    print("  leg 8: request tracing (merged fleet trace + phase ledger)")
+    from deepspeed_tpu.telemetry.reqtrace import write_merged_trace
+
+    tr_fleet, tr_led = build_trace_fleet()
+    tr_reqs = make_requests(n_requests, salt=31)
+    tr_uids = [tr_fleet.submit(r) for r in tr_reqs]
+    tr_states = []
+    for _ in range(200):
+        tr_fleet.step()
+        tr_states = [tr_fleet.request_state(u) for u in tr_uids]
+        if any((s["replica"] or "").startswith("decode")
+               and 1 <= len(s["emitted"]) < new_tokens for s in tr_states):
+            break
+    tr_hosts = {}
+    for s in tr_states:
+        if (s["replica"] or "").startswith("decode"):
+            tr_hosts[s["replica"]] = tr_hosts.get(s["replica"], 0) + 1
+    tr_victim = max(tr_hosts, key=tr_hosts.get) if tr_hosts else "decode0"
+    print(f"    killing {tr_victim} mid-stream for the recompute slice")
+    tr_fleet.kill_replica(tr_victim)
+    for _ in range(400):
+        if not tr_fleet.has_work():
+            break
+        tr_fleet.step()
+    tids = [tr_fleet.request_state(u)["trace_id"] for u in tr_uids]
+    _check(checks, "trace_ids_minted_and_fleet_unique",
+           all(tids) and len(set(tids)) == len(tids),
+           f"{len(set(tids))} unique / {len(tids)}")
+    redisp_tids = [t for t, u in zip(tids, tr_uids)
+                   if tr_fleet.request_state(u)["redispatches"] >= 1]
+    ledger_ok, ledger_err = True, f"{len(tids)} ledgers closed"
+    for tid in tids:
+        tr = tr_led.lookup(tid)
+        if tr is None or not tr.done:
+            ledger_ok, ledger_err = False, f"{tid}: missing or still open"
+            break
+        gap = abs(sum(tr.phase_seconds().values()) - tr.elapsed_s())
+        if gap > 1e-3:
+            ledger_ok, ledger_err = \
+                False, f"{tid}: phases off end-to-end by {gap:.6f}s"
+            break
+    _check(checks, "ledger_phases_sum_to_end_to_end", ledger_ok,
+           ledger_err)
+    trace_path = os.path.join(out, "fleet_trace.json")
+    n_ev = write_merged_trace(trace_path, ledger=tr_led)
+    with open(trace_path) as f:
+        tr_events = json.load(f)["traceEvents"]
+    schema_bad = [e for e in tr_events if not all(
+        k in e for k in ("ph", "ts", "dur", "pid", "tid", "name"))]
+    _check(checks, "merged_trace_event_schema",
+           n_ev > 0 and len(tr_events) == n_ev and not schema_bad,
+           f"{n_ev} events -> {trace_path}")
+    tr_slices = {}
+    for e in tr_events:
+        e_tid = (e.get("args") or {}).get("trace_id")
+        if e.get("ph") == "X" and e_tid:
+            tr_slices.setdefault(e_tid, set()).add(e["name"])
+    need = {"prefill", "kv_transfer", "decode"}
+    connected = [t for t in tids if need <= tr_slices.get(t, set())]
+    _check(checks, "every_request_one_connected_trace",
+           len(connected) == len(tids),
+           f"{len(connected)}/{len(tids)} traces carry {sorted(need)}")
+    _check(checks, "redispatch_produces_recompute_slice",
+           bool(redisp_tids)
+           and all("recompute" in tr_slices.get(t, set())
+                   for t in redisp_tids),
+           f"{len(redisp_tids)} stream(s) re-dispatched")
+    exs = [e for ring in tr_led.exemplars().values() for e in ring]
+    resolved = [e for e in exs if e["trace_id"] in tr_slices]
+    _check(checks, "slo_exemplars_resolve_into_merged_artifact",
+           bool(exs) and len(resolved) == len(exs),
+           f"{len(resolved)}/{len(exs)} exemplars resolve "
+           f"({sorted(tr_led.exemplars())})")
+
     # ---- metric-name lint over the tree (fleet family included)
     import check_metric_names as lint
 
@@ -594,6 +704,11 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
                         if n.startswith("deepspeed_tpu_serving_kv_tier_"))
     _check(checks, "kv_tier_metric_family_registered",
            len(tier_names) >= 5, tier_names[:4])
+    reqtrace_names = sorted(
+        n for n in lint.collect(_REPO_DIR)
+        if n.startswith("deepspeed_tpu_serving_reqtrace_"))
+    _check(checks, "reqtrace_metric_family_registered",
+           len(reqtrace_names) >= 4, reqtrace_names[:4])
     ms_family = ("deepspeed_tpu_serving_decode_tokens_per_dispatch",
                  "deepspeed_tpu_serving_decode_host_syncs_total",
                  "deepspeed_tpu_serving_decode_horizon_shrink_total")
@@ -608,12 +723,14 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
                "health": fleet.health(),
                "slo_health": slo_fleet.health(),
                "fleet_metrics": fleet_names, "slo_metrics": slo_names,
+               "trace_artifact": trace_path, "reqtrace": tr_led.summary(),
                "checks": checks}
     with open(os.path.join(out, "fleet_drill.json"), "w") as f:
         json.dump(summary, f, indent=2)
     print(json.dumps({k: v for k, v in summary.items()
                       if k not in ("checks", "health", "slo_health",
-                                   "fleet_metrics", "slo_metrics")}))
+                                   "fleet_metrics", "slo_metrics",
+                                   "reqtrace")}))
     return 0 if ok else 1
 
 

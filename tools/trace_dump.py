@@ -18,6 +18,14 @@ It also forces ONE re-jit (a train step with a changed batch shape) and
 asserts the recompile counter moved by exactly one — the acceptance gate
 for step-attributed compile accounting.
 
+The **fleet leg** then runs a tiny 1-prefill + 1-decode disaggregated
+fleet on a fresh request-trace ledger and writes the MERGED
+multi-replica Perfetto artifact (``fleet_trace.json``): one process row
+per owning replica, one thread track per router-minted ``trace_id``,
+KV transit as its own slice — schema-verified (every event carries the
+required Chrome-trace keys) and gated on every request reading as one
+connected prefill → kv_transfer → decode trace.
+
 The output is ONE JSON summary line; exit status is non-zero when a
 required span family, Chrome-trace key, flight record, or the
 exactly-once recompile increment is missing.
@@ -136,6 +144,88 @@ def _serving_demo(n_requests: int):
     return eng
 
 
+def _fleet_demo(out_dir: str, n_requests: int):
+    """Fleet tracing leg: 1-prefill + 1-decode disaggregated fleet on a
+    FRESH request-trace ledger; writes the merged multi-replica Perfetto
+    artifact and returns (path, trace_ids)."""
+    import jax
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (RaggedInferenceConfig,
+                                                      RaggedRequest)
+    from deepspeed_tpu.models.llama import llama_model
+    from deepspeed_tpu.serving import ServingConfig, build_fleet
+    from deepspeed_tpu.telemetry.reqtrace import (ReqTraceLedger,
+                                                  set_reqtrace_ledger,
+                                                  write_merged_trace)
+
+    led = ReqTraceLedger()
+    set_reqtrace_ledger(led)
+    model = llama_model("tiny", max_seq_len=128)
+    params = model.init_params(jax.random.PRNGKey(0))
+    base = RaggedInferenceConfig(dtype="fp32", page_size=8, num_pages=64,
+                                 max_seqs=4, max_pages_per_seq=12,
+                                 enable_prefix_cache=True)
+    fleet = build_fleet(
+        model, ServingConfig(enabled=True, prefill_replicas=1,
+                             decode_replicas=1, disaggregated=True,
+                             prefill_chunk=8),
+        engine_config=base, params=params)
+    rng = np.random.RandomState(1)
+    vocab = model.config.vocab_size
+    prefix = rng.randint(1, vocab, 16).tolist()
+    uids = [fleet.submit(RaggedRequest(
+        prompt_ids=prefix + rng.randint(1, vocab, 3 + i).tolist(),
+        max_new_tokens=4)) for i in range(max(2, n_requests))]
+    for _ in range(400):
+        if not fleet.has_work():
+            break
+        fleet.step()
+    tids = [fleet.request_state(u)["trace_id"] for u in uids]
+    path = os.path.join(out_dir, "fleet_trace.json")
+    write_merged_trace(path, ledger=led)
+    return path, tids
+
+
+def _verify_merged_trace(path: str, tids):
+    """Schema + connectivity gate for the merged fleet artifact: every
+    event carries the Chrome-trace keys, every submitted trace_id reads
+    as one connected prefill → kv_transfer → decode track, and the
+    merge spans more than one owner row (it IS cross-replica)."""
+    problems = []
+    with open(path) as f:
+        events = json.load(f).get("traceEvents", [])
+    if not events:
+        problems.append("merged fleet trace has no traceEvents")
+    for ev in events:
+        missing = [k for k in TRACE_EVENT_KEYS if k not in ev]
+        if missing:
+            problems.append(f"fleet event {ev.get('name')!r} missing "
+                            f"{missing}")
+            break
+        if ev["ph"] not in ("X", "M") \
+                or not isinstance(ev["ts"], (int, float)) \
+                or not isinstance(ev["dur"], (int, float)):
+            problems.append(f"fleet event {ev.get('name')!r} malformed: "
+                            f"ph={ev['ph']!r} ts={ev['ts']!r}")
+            break
+    slices = {}
+    for ev in events:
+        tid = (ev.get("args") or {}).get("trace_id")
+        if ev.get("ph") == "X" and tid:
+            slices.setdefault(tid, set()).add(ev["name"])
+    need = {"prefill", "kv_transfer", "decode"}
+    broken = [t for t in tids if not need <= slices.get(t, set())]
+    if broken:
+        problems.append(f"fleet traces missing {sorted(need)} slices: "
+                        f"{broken}")
+    owners = {ev["args"]["name"] for ev in events
+              if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    if len(owners) < 2:
+        problems.append(f"merged trace has {len(owners)} owner row(s); a "
+                        "cross-replica merge needs at least 2")
+    return len(events), sorted(owners), problems
+
+
 def _verify_trace(path: str):
     """Perfetto-loadability gate: the file parses, every event carries
     the required keys with numeric ts/dur, and the demo's span families
@@ -195,6 +285,8 @@ def main(argv=None) -> int:
 
     engine, recompile_delta = _train_demo(out_dir, args.steps)
     serve = _serving_demo(args.serve_requests)
+    fleet_trace_path, fleet_tids = _fleet_demo(out_dir,
+                                               args.serve_requests)
 
     # ---- write both artifacts ------------------------------------------
     trace_path = trace_dump(os.path.join(out_dir, "trace.json"))
@@ -207,7 +299,9 @@ def main(argv=None) -> int:
     # ---- verify them ---------------------------------------------------
     n_events, span_names, trace_problems = _verify_trace(trace_path)
     n_flight, flight_problems = _verify_flight(flight_path)
-    problems = trace_problems + flight_problems
+    n_fleet_events, fleet_owners, fleet_problems = _verify_merged_trace(
+        fleet_trace_path, fleet_tids)
+    problems = trace_problems + flight_problems + fleet_problems
     if recompile_delta != 1:
         problems.append(f"forced re-jit moved the recompile counter by "
                         f"{recompile_delta}, expected exactly 1")
@@ -223,6 +317,10 @@ def main(argv=None) -> int:
         "trace_events": n_events,
         "span_families": span_names,
         "flight_records": n_flight,
+        "fleet_trace_path": fleet_trace_path,
+        "fleet_trace_events": n_fleet_events,
+        "fleet_trace_owners": fleet_owners,
+        "fleet_trace_ids": fleet_tids,
         "recompile_delta": recompile_delta,
         "compiles_total": (reg.get("deepspeed_tpu_compiles_total").total()
                            if reg.get("deepspeed_tpu_compiles_total") else 0),
