@@ -278,6 +278,15 @@ def build_model_and_config(size: str, seq: int, micro_bs: int, env=None,
         "gradient_clipping": 1.0,
         "data_types": {"grad_accum_dtype": acc},
     }
+    if env.get("DSTPU_BENCH_NUMERICS", "1") == "1":
+        # numerics observatory (docs/OBSERVABILITY.md): per-layer health
+        # stats ride the fused step as extra tiny outputs, pulled only at
+        # the steps_per_print boundary.  Shared here so the estimator
+        # compiles the same program the bench runs; the cadence is pinned
+        # low enough that even the short CPU rung crosses a boundary.
+        config["telemetry"] = {"enabled": True,
+                               "numerics": {"enabled": True}}
+        config["steps_per_print"] = int(env.get("DSTPU_BENCH_SPP", "5") or 5)
     if pipe > 1:
         # pipe stages claim their axis; data absorbs the remaining chips
         config["mesh"] = {"pipe": pipe, "data": -1}
@@ -433,6 +442,35 @@ def _run(size: str, seq: int, micro_bs: int, steps: int,
     if struct:
         result["pipe_bubble_fraction"] = round(struct["bubble_fraction"], 4)
         result["pipe_stages"] = struct["stages"]
+    # numerics annex: a perf rung doubles as a training-health artifact —
+    # layer-norm medians, anomaly counts, and the cross-rank divergence
+    # verdict are stamped into the bench JSON so a throughput number that
+    # rode a silently-diverging or overflow-storming run is self-labelled
+    num = None
+    try:
+        num = engine.numerics_report()
+    except Exception as e:  # the annex must never sink a bench run
+        print(f"bench: numerics report failed ({e}); omitting",
+              file=sys.stderr)
+    if num:
+        last = num.get("last_report") or {}
+        div = num.get("divergence")
+
+        def _layer_median(key):
+            vals = (last.get("layers") or {}).get(key) or []
+            return round(float(np.median(vals)), 6) if vals else None
+
+        result["numerics"] = {
+            "boundaries": num["boundaries"],
+            "anomaly_counts": num["anomaly_counts"],
+            "grad_norm_median": num.get("grad_norm_median"),
+            "grad_layer_norm_median": _layer_median("grad_norm"),
+            "act_layer_norm_median": _layer_median("act_norm"),
+            "param_layer_norm_median": _layer_median("param_norm"),
+            "grad_nonfinite": last.get("grad_nonfinite"),
+            "divergence_ok": None if div is None else bool(div.get("ok")),
+            "first_diverging_leaf": (div or {}).get("first_diverging_leaf"),
+        }
     # provenance: which program contracts (tests/contracts/*.json) this
     # result ran under — a perf claim is only comparable to another run
     # with the same contract-set hash (same collectives, same donation)

@@ -76,6 +76,10 @@ _SPAN_EXCLUDE_FILES = {os.path.join("deepspeed_tpu", "telemetry", "spans.py")}
 _FAMILY_OWNERS = {
     "deepspeed_tpu_serving_reqtrace_":
         os.path.join("deepspeed_tpu", "telemetry", "reqtrace.py"),
+    # the numerics sentinel is the sole authority for training-health
+    # anomaly accounting (docs/OBSERVABILITY.md "Numerics observatory")
+    "deepspeed_tpu_train_numerics_":
+        os.path.join("deepspeed_tpu", "telemetry", "numerics.py"),
 }
 
 Site = Tuple[str, int, str]  # (relpath, lineno, metric_type)

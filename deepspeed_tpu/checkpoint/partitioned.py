@@ -121,11 +121,23 @@ def save_partitioned(engine, save_dir: str, tag: str,
     comm.barrier("partitioned-save")
     final = os.path.join(save_dir, tag)
     if rank == 0:
-        finalize_commit(save_dir, tag, keep_n=keep_n, meta={
+        commit_meta = {
             "global_steps": engine.global_steps,
             "world": jax.process_count(),
             "mesh": dict(engine.topology.axis_sizes),
-        })
+        }
+        try:
+            # numerics incident annotation — same contract as
+            # saving.save_checkpoint: consume-once, never blocks the save
+            from ..telemetry.numerics import pending_incident_meta
+
+            inc = pending_incident_meta()
+            if inc is not None:
+                commit_meta["numerics_incident"] = inc
+        # dstpu-lint: allow[swallow] annotation only
+        except Exception:
+            pass
+        finalize_commit(save_dir, tag, keep_n=keep_n, meta=commit_meta)
     comm.barrier("partitioned-commit")
     log_dist(f"saved partitioned checkpoint {final}")
     return final

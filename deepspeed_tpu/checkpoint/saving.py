@@ -73,6 +73,22 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "mesh": dict(engine.topology.axis_sizes),
             "array_crc32": array_checksums(arrays),
         }
+        try:
+            # numerics observatory: if the anomaly sentinel fired since
+            # the last save, stamp the incident into this tag's commit
+            # manifest — resume-time triage (``resolve_tag`` reports /
+            # ``manifest_meta``) sees WHAT fired and WHICH layer without
+            # hunting for the flight dump.  consume-once: only the first
+            # checkpoint after the incident carries it.
+            from ..telemetry.numerics import pending_incident_meta
+
+            inc = pending_incident_meta()
+            if inc is not None:
+                commit_meta["numerics_incident"] = inc
+        # dstpu-lint: allow[swallow] annotation only — a broken sentinel
+        # must never block the checkpoint itself
+        except Exception:
+            pass
         with checkpoint_commit(save_dir, tag, meta=commit_meta,
                                keep_n=keep_n) as staging:
             np.savez(os.path.join(staging, MODEL_FILE), **arrays)

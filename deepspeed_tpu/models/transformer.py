@@ -126,6 +126,12 @@ class TransformerConfig:
     moe_use_residual: bool = False
     # ALST-style tiled logits+loss: sequence chunk size (0 = off)
     loss_chunk: int = 0
+    #: numerics observatory (engine-set per trace, like qwz): the layer
+    #: scan emits a stacked [L, 3] (l2_norm, max_abs, nonfinite) side
+    #: output over each block's activations and causal_lm_loss returns
+    #: (loss, act) — carried as extra fused-step outputs, pulled only at
+    #: the steps_per_print boundary (telemetry/numerics.py)
+    numerics_act_stats: bool = False
     # ZeRO++ qwZ: per-layer weight gathers move int8 codes + block scales
     # instead of bf16 (set by the engine when zero_quantized_weights is on)
     qwz: bool = False
@@ -596,8 +602,15 @@ def _block(cfg: TransformerConfig, x, layer, positions, mask, attn_fn):
 
 
 def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
-                        token_type_ids=None):
-    """[B, S] int tokens -> ([B, S, H] final hidden states, aux loss)."""
+                        token_type_ids=None, with_act_stats=False):
+    """[B, S] int tokens -> ([B, S, H] final hidden states, aux loss).
+
+    ``with_act_stats`` (numerics observatory): additionally return a
+    stacked ``[L, 3]`` per-layer activation-health side output
+    (``telemetry.numerics.activation_stats`` rows over each block's
+    output) as a third element.  Computed OUTSIDE the (possibly
+    overlap-wrapped, possibly remat'd) block call, so the overlap hook's
+    shard_map specs and the remat policy are untouched."""
     x = params["embed"]["tok"][input_ids]
     B, S = input_ids.shape
     positions = jnp.broadcast_to(jnp.arange(S), (B, S))
@@ -611,6 +624,9 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
         x = _norm(x, params["embed"]["norm"]["scale"],
                   params["embed"]["norm"].get("bias"), cfg.norm, cfg.norm_eps)
     attn_fn = _pick_attn(cfg)
+    if with_act_stats:
+        # lazy: telemetry must stay an optional dependency of the model code
+        from ..telemetry.numerics import activation_stats as _act_row
 
     plan = getattr(cfg, "overlap_plan", None)
     # compressed-overlap comm state (runtime/zero/overlap.py): the engine
@@ -651,21 +667,23 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
             def scan_body(carry, xs):
                 layer, comm_s = xs
                 y, aux = block(carry, layer, comm_s)
-                return y, aux
+                return y, ((aux, _act_row(y)) if with_act_stats else aux)
 
-            x, auxs = jax.lax.scan(scan_body, x,
-                                   (params["layers"], comm_state),
-                                   unroll=unroll)
+            x, ys = jax.lax.scan(scan_body, x,
+                                 (params["layers"], comm_state),
+                                 unroll=unroll)
         else:
             def scan_body(carry, layer):
                 y, aux = block(carry, layer)
-                return y, aux
+                return y, ((aux, _act_row(y)) if with_act_stats else aux)
 
-            x, auxs = jax.lax.scan(scan_body, x, params["layers"],
-                                   unroll=unroll)
+            x, ys = jax.lax.scan(scan_body, x, params["layers"],
+                                 unroll=unroll)
+        auxs, act = ys if with_act_stats else (ys, None)
         aux = jnp.sum(auxs)
     else:
         aux = jnp.asarray(0.0, jnp.float32)
+        act_rows = []
         for i in range(cfg.n_layers):
             layer = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
             if comm_state is not None:
@@ -674,13 +692,16 @@ def transformer_forward(cfg: TransformerConfig, params, input_ids, mask=None,
             else:
                 x, a = block(x, layer)
             aux = aux + a
+            if with_act_stats:
+                act_rows.append(_act_row(x))
+        act = jnp.stack(act_rows) if with_act_stats else None
 
     if cfg.post_norm:
         # each block already ends in norm2; a final norm would re-normalize
-        return x, aux
+        return (x, aux, act) if with_act_stats else (x, aux)
     hidden = _norm(x, params["final_norm"]["scale"], params["final_norm"].get("bias"),
                    cfg.norm, cfg.norm_eps)
-    return hidden, aux
+    return (hidden, aux, act) if with_act_stats else (hidden, aux)
 
 
 def logits_fn(cfg: TransformerConfig, params, hidden):
@@ -697,17 +718,29 @@ def logits_fn(cfg: TransformerConfig, params, hidden):
 
 def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
     """Next-token cross entropy.  batch: dict(input_ids, optional labels,
-    optional attention_mask) or a raw [B, S] token array."""
+    optional attention_mask) or a raw [B, S] token array.
+
+    With ``cfg.numerics_act_stats`` set (engine-set per trace), returns
+    ``(loss, act)`` where ``act`` is the forward's stacked ``[L, 3]``
+    per-layer activation-health side output — the engine carries it as
+    an extra fused-step output for the numerics observatory."""
     if isinstance(batch, dict):
         ids = batch["input_ids"]
         labels = batch.get("labels", ids)
         mask = batch.get("attention_mask")
     else:
         ids, labels, mask = batch, batch, None
-    hidden, aux = transformer_forward(cfg, params, ids, mask)
+    with_act = bool(getattr(cfg, "numerics_act_stats", False))
+    fwd = transformer_forward(cfg, params, ids, mask,
+                              with_act_stats=with_act)
+    hidden, aux = fwd[0], fwd[1]
+    act = fwd[2] if with_act else None
     hidden = hidden[:, :-1]
     targets = labels[:, 1:]
     m = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+
+    def _out(loss):
+        return (loss, act) if with_act else loss
 
     if cfg.loss_chunk and hidden.shape[1] > cfg.loss_chunk:
         if hidden.shape[1] % cfg.loss_chunk == 0:
@@ -717,7 +750,7 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
             # inside
             nll_sum, cnt = _tiled_nll(cfg, params, hidden, targets, m,
                                       cfg.loss_chunk)
-            return nll_sum / jnp.maximum(cnt, 1.0) + aux
+            return _out(nll_sum / jnp.maximum(cnt, 1.0) + aux)
         from ..utils.logging import warning_once
 
         warning_once(
@@ -729,8 +762,8 @@ def causal_lm_loss(cfg: TransformerConfig, params, batch, rng=None):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = nll_pick(logp, targets)
     if m is not None:
-        return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0) + aux
-    return jnp.mean(nll) + aux
+        return _out(jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0) + aux)
+    return _out(jnp.mean(nll) + aux)
 
 
 def nll_pick(logp: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:

@@ -336,6 +336,20 @@ def verify_tag(save_dir: str, tag: str) -> dict:
             "meta": manifest.get("meta", {}), "problems": problems}
 
 
+def manifest_meta(save_dir: str, tag: str) -> dict:
+    """The caller-supplied ``meta`` block of a committed tag's manifest
+    (``{}`` for pre-protocol tags / unreadable manifests).  Cheap — no
+    checksum pass — so resume paths can triage (e.g. a
+    ``numerics_incident`` stamped by the anomaly sentinel) without
+    paying a full :func:`verify_tag`."""
+    man = os.path.join(save_dir, tag, MANIFEST)
+    try:
+        with open(man) as f:
+            return dict(json.load(f).get("meta") or {})
+    except (OSError, ValueError, TypeError):
+        return {}
+
+
 def _record_corruption(save_dir: str, tag: str, problems: list) -> None:
     metrics.corrupt_checkpoints_total().inc()
     logger.error(f"resilience: checkpoint {save_dir}/{tag} FAILED "

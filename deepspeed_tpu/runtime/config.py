@@ -410,6 +410,58 @@ class GoodputConfig(ConfigModel):
 
 
 @dataclasses.dataclass
+class NumericsConfig(ConfigModel):
+    """``numerics`` sub-block of ``telemetry``: the numerics observatory
+    (telemetry/numerics.py; docs/OBSERVABILITY.md "Numerics
+    observatory").  When enabled the fused train step carries per-layer
+    / per-leaf health stats (grad/param norm, max-abs, nonfinite count,
+    EF-residual norm per comm slot, loss-scale state) as EXTRA DEVICE
+    OUTPUTS, pulled only at the ``steps_per_print`` boundary where the
+    anomaly sentinel runs its detectors.  ``activation_stats``
+    additionally threads a ``[L, 3]`` activation-health side output
+    through the transformer layer scan (per-stage through the pipe
+    scan).  The divergence audit checksums master params across the
+    data axis every ``divergence_every``-th boundary (ZeRO stage <= 1
+    only — ranks must be bit-identical there; higher stages skip it).
+
+    Detector knobs: spikes fire when the boundary value exceeds
+    ``*_factor`` x the rolling median of the last ``history`` healthy
+    boundaries (armed after ``min_history``); ``overflow_storm`` is the
+    skipped-step delta between boundaries that rates as a storm;
+    ``stagnant_boundaries``/``stagnant_tol`` flag a loss pinned within
+    tolerance for that many consecutive boundaries (0 disables)."""
+
+    enabled: bool = False
+    activation_stats: bool = True
+    history: int = 64
+    min_history: int = 8
+    loss_spike_factor: float = 3.0
+    grad_spike_factor: float = 10.0
+    overflow_storm: int = 3
+    stagnant_boundaries: int = 8
+    stagnant_tol: float = 0.0
+    divergence_audit: bool = True
+    divergence_every: int = 1
+
+    def validate(self) -> None:
+        if self.history < 2:
+            raise ValueError("telemetry.numerics.history must be >= 2")
+        if self.min_history < 2:
+            raise ValueError("telemetry.numerics.min_history must be >= 2")
+        if self.loss_spike_factor <= 1.0 or self.grad_spike_factor <= 1.0:
+            raise ValueError(
+                "telemetry.numerics spike factors must be > 1")
+        if self.overflow_storm < 1:
+            raise ValueError("telemetry.numerics.overflow_storm must be >= 1")
+        if self.stagnant_boundaries < 0 or self.stagnant_tol < 0:
+            raise ValueError(
+                "telemetry.numerics stagnant knobs must be >= 0")
+        if self.divergence_every < 1:
+            raise ValueError(
+                "telemetry.numerics.divergence_every must be >= 1")
+
+
+@dataclasses.dataclass
 class TelemetryConfig(ConfigModel):
     """``telemetry`` block: the unified metrics registry + export paths
     (see deepspeed_tpu/telemetry/ and docs/OBSERVABILITY.md).
@@ -446,6 +498,8 @@ class TelemetryConfig(ConfigModel):
         default_factory=TimelineConfig)
     goodput: GoodputConfig = dataclasses.field(
         default_factory=GoodputConfig)
+    numerics: NumericsConfig = dataclasses.field(
+        default_factory=NumericsConfig)
 
     def validate(self) -> None:
         if self.export_interval < 1:

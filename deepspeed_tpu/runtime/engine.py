@@ -51,6 +51,7 @@ from .module import ModelSpec, as_model_spec
 from .optimizers import build_optimizer
 from .precision import (LossScaleState, cast_tree, check_overflow,
                         clip_by_global_norm, global_grad_norm,
+                        loss_scale_summary, nonfinite_count,
                         update_loss_scale)
 from .zero.strategy import ZeroShardingPlan
 
@@ -226,8 +227,36 @@ class DeepSpeedTPUEngine:
         self._acc_dirty = False
         self._rng = jax.random.PRNGKey(seed if seed is not None else config.seed)
 
+        # numerics observatory (telemetry/numerics.py): the fused step
+        # carries an in-graph stats tree as an extra output, pulled only
+        # at the steps_per_print boundary.  Fused stats gate off under
+        # optimizer offload (that path's boundary update runs on host and
+        # its device program is micro-steps only) — the sentinel still
+        # observes the host-available scalars there.  Activation stats
+        # additionally need a transformer-config model (the per-layer
+        # scan emits them) and gate off under qgZ/hierarchical reduce
+        # (per-chunk vmap'd stats would need their own reduce) and the
+        # pipe paths (the pipe engine owns per-STAGE stats instead).
+        self._numerics = (self.telemetry.numerics
+                          if self.telemetry is not None else None)
+        self._numerics_fused = (self._numerics is not None
+                                and self.offload_optimizer is None)
+        self._numerics_act = False
+        self._last_numerics = None
+        self._div_fn = None
+
         self.state = self._init_state()
         self._build_overlap_plan()
+        _mc = getattr(self.model, "config", None)
+        self._numerics_act = (
+            self._numerics_fused
+            and bool(getattr(config.telemetry.numerics, "activation_stats",
+                             True))
+            and _mc is not None and hasattr(_mc, "numerics_act_stats")
+            and not (self._qgz or self._hier_inner)
+            and getattr(self, "_pipe_hop_spec", None) is None
+            and getattr(self, "_pipe_plan", None) is None
+            and not self._pipe_schedule_active())
         self._init_comm_errors()
         self._compile_steps()
         self._wire_memory_ledger()
@@ -801,23 +830,30 @@ class DeepSpeedTPUEngine:
         )
 
     # ------------------------------------------------------------- programs
-    def _model_loss(self, p, batch, rng):
+    def _model_loss(self, p, batch, rng, act_stats=False):
         """model.loss_fn with the engine's qwZ / stage-3-prefetch flags
         applied for the duration of the trace (not a permanent config
-        mutation — engines may share a model object)."""
+        mutation — engines may share a model object).
+
+        ``act_stats``: numerics-observatory per-layer activation stats —
+        set ONLY by the training trace (``_micro_grads``); the loss then
+        returns ``(loss, [L, 3] act)`` (models/transformer.py).  The
+        eval path never sets it, so eval losses stay scalar."""
         mc = getattr(self.model, "config", None)
         has_q = mc is not None and hasattr(mc, "qwz")
         has_pf = mc is not None and hasattr(mc, "zero3_prefetch")
         has_ov = mc is not None and hasattr(mc, "overlap_plan")
         has_hop = mc is not None and hasattr(mc, "pipe_hop_spec")
         has_pp = mc is not None and hasattr(mc, "pipe_overlap_plan")
-        if not (has_q or has_pf or has_ov or has_hop or has_pp):
+        has_nm = mc is not None and hasattr(mc, "numerics_act_stats")
+        if not (has_q or has_pf or has_ov or has_hop or has_pp or has_nm):
             return self.model.loss_fn(p, batch, rng)
         old_q = mc.qwz if has_q else None
         old_pf = mc.zero3_prefetch if has_pf else None
         old_ov = mc.overlap_plan if has_ov else None
         old_hop = mc.pipe_hop_spec if has_hop else None
         old_pp = mc.pipe_overlap_plan if has_pp else None
+        old_nm = mc.numerics_act_stats if has_nm else None
         if has_q:
             mc.qwz = self._qwz
         if has_pf:
@@ -828,6 +864,8 @@ class DeepSpeedTPUEngine:
             mc.pipe_hop_spec = getattr(self, "_pipe_hop_spec", None)
         if has_pp:
             mc.pipe_overlap_plan = getattr(self, "_pipe_plan", None)
+        if has_nm:
+            mc.numerics_act_stats = bool(act_stats)
         try:
             return self.model.loss_fn(p, batch, rng)
         finally:
@@ -841,6 +879,8 @@ class DeepSpeedTPUEngine:
                 mc.pipe_hop_spec = old_hop
             if has_pp:
                 mc.pipe_overlap_plan = old_pp
+            if has_nm:
+                mc.numerics_act_stats = old_nm
 
     def _fetch_params(self, master_params):
         """Host-offloaded masters (offload_param): stream them into device
@@ -861,23 +901,35 @@ class DeepSpeedTPUEngine:
         p = cast_tree(self._fetch_params(master_params), self.compute_dtype)
         return self.zero_plan.constrain(p, "param")
 
-    def _micro_grads(self, state: TrainState, batch, rng, compute_params=None):
+    def _micro_grads(self, state: TrainState, batch, rng, compute_params=None,
+                     want_overflow=False):
         """One micro-batch's gradients (accum dtype, grad-sharded) + loss
         + the updated compressed-collective EF residuals (None when no
-        compressed path carries error feedback on this trace).
+        compressed path carries error feedback on this trace) + a numerics
+        ``extras`` dict: ``"act"`` ([L, 3] per-layer activation stats when
+        the observatory's act stats ride this trace, else None) and
+        ``"overflow"`` (the fp16 finiteness verdict over the post-cast
+        grads — computed ONCE here and threaded both to the EF residual
+        gate and, with ``want_overflow``, to ``_apply_step_body``'s skip
+        decision, which otherwise recomputes the same full-tree
+        reduction).
 
         ``compute_params``: pre-cast compute-dtype params — the fused
         gas>1 scan casts the fp32 master ONCE outside the scan instead of
         re-casting every micro-step (params only change at the boundary)."""
         if compute_params is None:
             compute_params = self._compute_params(state.params)
+        act_on = getattr(self, "_numerics_act", False)
 
         def scaled_loss_fn(p, b=None):
-            loss = self._model_loss(p, b if b is not None else batch, rng)
+            out = self._model_loss(p, b if b is not None else batch, rng,
+                                   act_stats=act_on)
+            loss, act = out if act_on else (out, None)
             if self.fp16_enabled:
                 # scale in fp32: the default scale (2^16) overflows float16
-                return loss.astype(jnp.float32) * state.loss_scale.cur_scale, loss
-            return loss, loss
+                return (loss.astype(jnp.float32) * state.loss_scale.cur_scale,
+                        (loss, act))
+            return loss, (loss, act)
 
         new_comm = None
         plan = getattr(self, "_overlap_plan", None)
@@ -909,7 +961,7 @@ class DeepSpeedTPUEngine:
             if pipe_ef:
                 comm_in["e"] = state.comm_errors["pipe"]
             p2["_pipe_comm"] = comm_in
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(p2)
+            grads, (loss, act) = jax.grad(scaled_loss_fn, has_aux=True)(p2)
             grads = dict(grads)
             comm_g = grads.pop("_pipe_comm")
             if pipe_plan is not None:
@@ -926,7 +978,7 @@ class DeepSpeedTPUEngine:
             p2 = dict(compute_params)
             p2["_overlap_comm"] = {"g": plan.grad_slots(),
                                    "e": plan.eslot_state(state.comm_errors)}
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(p2)
+            grads, (loss, act) = jax.grad(scaled_loss_fn, has_aux=True)(p2)
             grads = dict(grads)
             comm_g = grads.pop("_overlap_comm")
             grads["layers"] = plan.merge_comm_grads(grads["layers"],
@@ -935,12 +987,22 @@ class DeepSpeedTPUEngine:
                 new_comm = dict(state.comm_errors)
                 new_comm["overlap"] = comm_g["e"]
         elif self._qgz or self._hier_inner:
-            grads, loss, new_comm = self._qgz_grads(
+            grads, loss, act, new_comm = self._qgz_grads(
                 scaled_loss_fn, compute_params, batch, state.comm_errors)
             if new_comm is not None:
                 new_comm = {**state.comm_errors, **new_comm}
         else:
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
+            grads, (loss, act) = jax.grad(scaled_loss_fn,
+                                          has_aux=True)(compute_params)
+        grads = cast_tree(grads, self.grad_accum_dtype)
+        grads = self.zero_plan.constrain(grads, "grad")
+        bad = None
+        if self.fp16_enabled and (new_comm is not None or want_overflow):
+            # ONE finiteness verdict per micro-step, on the POST-CAST
+            # grads (exactly the tree _apply_step_body's skip decision
+            # checks; the cast can only create nonfinites, never remove
+            # them, so this is conservative for the residual gate too)
+            bad = check_overflow(grads)
         if new_comm is not None and self.fp16_enabled:
             # an fp16 overflow step must not poison the carried residuals:
             # the backward's inf/nan rides the quantize (scale=inf -> NaN
@@ -948,12 +1010,9 @@ class DeepSpeedTPUEngine:
             # (_apply_step_body) never touches comm_errors — so gate the
             # residual update on the same finiteness signal and keep the
             # previous residuals on overflow steps
-            bad = check_overflow(grads)
             new_comm = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(bad, o, n),
                 new_comm, state.comm_errors)
-        grads = cast_tree(grads, self.grad_accum_dtype)
-        grads = self.zero_plan.constrain(grads, "grad")
         if getattr(self, "_overlap_struct", None) is not None:
             # trace-time span-timeline event for the gradient bytes the
             # overlap hook does NOT cover (the post-backward tail) — the
@@ -961,18 +1020,23 @@ class DeepSpeedTPUEngine:
             from .zero.overlap import record_tail_reduce
 
             record_tail_reduce(self._overlap_struct["tail_bytes"])
-        return grads, loss, new_comm
+        return grads, loss, new_comm, {"act": act, "overflow": bad}
 
     def _micro_step_body(self, state: TrainState, batch, rng,
-                         compute_params=None) -> Tuple[TrainState, jnp.ndarray]:
-        grads, loss, new_comm = self._micro_grads(
+                         compute_params=None, with_act=False):
+        """One accumulation micro-step.  ``with_act`` (numerics scan
+        path only) returns ``(state, (loss, act))`` so the gas>1 scan
+        can stack the per-layer activation stats; the incremental API
+        keeps the plain ``(state, loss)`` shape."""
+        grads, loss, new_comm, extras = self._micro_grads(
             state, batch, rng, compute_params=compute_params)
         new_acc = jax.tree_util.tree_map(jnp.add, state.grad_acc, grads)
         state = dataclasses.replace(
             state, grad_acc=new_acc, micro_step=state.micro_step + 1,
             comm_errors=(new_comm if new_comm is not None
                          else state.comm_errors))
-        return state, loss.astype(jnp.float32)
+        loss = loss.astype(jnp.float32)
+        return (state, (loss, extras["act"])) if with_act else (state, loss)
 
     def _qgz_grads(self, scaled_loss_fn, compute_params, batch,
                    comm_errors=None):
@@ -986,9 +1050,10 @@ class DeepSpeedTPUEngine:
 
         ``comm_errors``: with ``grad_reduce_error_feedback`` the per-bucket
         residuals under the "reduce" key thread into the flat-path
-        reducers and the updated set returns as the third value (None
-        otherwise) — carried in train state so checkpoint/resume keeps
-        them (the EF lifecycle contract)."""
+        reducers and the updated set returns as the last value of the
+        ``(grads, loss, act, new_comm)`` 4-tuple (None otherwise) —
+        carried in train state so checkpoint/resume keeps them (the EF
+        lifecycle contract)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.mesh import DATA_AXIS
@@ -1007,8 +1072,9 @@ class DeepSpeedTPUEngine:
             warning_once("qgZ: batch carries attention_mask — per-chunk "
                          "masked means would reweight the loss; falling back "
                          "to the fp gradient reduce for this step")
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(compute_params)
-            return grads, loss, None
+            grads, (loss, act) = jax.grad(scaled_loss_fn,
+                                          has_aux=True)(compute_params)
+            return grads, loss, act, None
 
         def chunk(x):
             if x.shape[0] % W != 0:
@@ -1017,9 +1083,12 @@ class DeepSpeedTPUEngine:
             return x.reshape(W, x.shape[0] // W, *x.shape[1:])
 
         batch_c = jax.tree_util.tree_map(chunk, batch)
-        grads_c, losses = jax.vmap(
+        grads_c, (losses, acts) = jax.vmap(
             lambda b: jax.grad(scaled_loss_fn, has_aux=True)(compute_params, b)
         )(batch_c)
+        # act stats stay None under qgZ (the engine gates them off for
+        # this path: per-chunk stats would need a second reduce)
+        del acts
         # chunk specs: leading data axis + the param's TP spec (stage<=2:
         # live params carry no zero axes)
         from .zero.strategy import _path_str
@@ -1053,9 +1122,9 @@ class DeepSpeedTPUEngine:
                         if (ef_keys and spec is not None) else None))
             if ef_keys and spec is not None:
                 grads, new_errs = result
-                return grads, jnp.mean(losses), {
+                return grads, jnp.mean(losses), None, {
                     "reduce": dict(zip(ef_keys, new_errs))}
-            return result, jnp.mean(losses), None
+            return result, jnp.mean(losses), None, None
         # target = the accumulation buffer's sharding: data-sharded leaves
         # come back as the SCATTERED partition (one all_to_all, no hop-2
         # gather — reference all_to_all_quant_reduce returns the partition)
@@ -1070,15 +1139,22 @@ class DeepSpeedTPUEngine:
             errors=([ef_slot[k] for k in ef_keys] if ef_keys else None))
         if ef_keys:
             grads, new_errs = result
-            return grads, jnp.mean(losses), {
+            return grads, jnp.mean(losses), None, {
                 "reduce": dict(zip(ef_keys, new_errs))}
-        return result, jnp.mean(losses), None
+        return result, jnp.mean(losses), None, None
 
-    def _apply_step_body(self, state: TrainState, grads_src=None) -> TrainState:
+    def _apply_step_body(self, state: TrainState, grads_src=None,
+                         overflow=None) -> TrainState:
         """Boundary update.  ``grads_src``: gradients to apply instead of
         ``state.grad_acc`` — the fused gas=1 path feeds the micro-step's
         gradients straight through, skipping the accumulation-buffer
-        read/modify/write entirely."""
+        read/modify/write entirely.  ``overflow``: a precomputed fp16
+        finiteness verdict over ``grads_src`` (``_micro_grads`` already
+        ran the full-tree reduction for the EF residual gate; the
+        unscale/clip below cannot turn a nonfinite leaf finite, so
+        re-checking here would be a duplicate pass over the gradients).
+        gas>1 always recomputes: the accumulation-buffer SUM can
+        overflow even when every micro-step's grads were finite."""
         gas = self.config.gradient_accumulation_steps or 1
         denom = jnp.asarray(float(gas), jnp.float32)
         if self.fp16_enabled:
@@ -1146,7 +1222,8 @@ class DeepSpeedTPUEngine:
             return params, opt_state, jnp.asarray(1, jnp.int32)
 
         if self.fp16_enabled:
-            overflow = check_overflow(grads)
+            if overflow is None:
+                overflow = check_overflow(grads)
             new_params, new_opt, skipped = jax.lax.cond(
                 overflow, skip_update, do_update,
                 (fetched_params, state.opt_state, grads))
@@ -1177,27 +1254,50 @@ class DeepSpeedTPUEngine:
             global_grad_norm=norm,
         )
 
-    def _train_batch_body(self, state: TrainState, batches, rng) -> Tuple[TrainState, jnp.ndarray]:
+    def _train_batch_body(self, state: TrainState, batches, rng):
         """Fused full step: scan micro-batches then apply.  ``batches`` has a
         leading gradient-accumulation dim.  At gas=1 the micro-batch's
         gradients feed the update directly — no accumulation-buffer
-        round-trip (the buffer stays zeros)."""
+        round-trip (the buffer stays zeros).
+
+        With the numerics observatory on (``_numerics_fused``) a THIRD
+        output rides the fused step: the in-graph stats tree
+        (``_numerics_tree``) — device-resident until the existing
+        steps_per_print boundary pulls it, so the hot path gains zero
+        host syncs."""
         gas = self.config.gradient_accumulation_steps or 1
+        nm = getattr(self, "_numerics_fused", False)
         if gas == 1:
             batch = jax.tree_util.tree_map(lambda x: x[0], batches)
             # same rng stream as the scan path (split, don't use raw) so a
             # seeded run reproduces across both paths
-            grads, loss, new_comm = self._micro_grads(
-                state, batch, jax.random.split(rng, 1)[0])
+            grads, loss, new_comm, extras = self._micro_grads(
+                state, batch, jax.random.split(rng, 1)[0],
+                want_overflow=self.fp16_enabled)
             if new_comm is not None:
                 state = dataclasses.replace(state, comm_errors=new_comm)
-            state = self._apply_step_body(state, grads_src=grads)
-            return state, loss.astype(jnp.float32)
+            state = self._apply_step_body(state, grads_src=grads,
+                                          overflow=extras["overflow"])
+            loss = loss.astype(jnp.float32)
+            if not nm:
+                return state, loss
+            return state, loss, self._numerics_tree(state, grads, loss,
+                                                    extras["act"])
+        if nm:
+            act_on = getattr(self, "_numerics_act", False)
+            res = self._micro_scan_body(state, batches, rng,
+                                        with_act=act_on)
+            (state, loss), act = ((res[0], res[1]), res[2]) if act_on \
+                else (res, None)
+            grads = state.grad_acc  # pre-apply: apply zeroes the buffer
+            state = self._apply_step_body(state)
+            return state, loss, self._numerics_tree(state, grads, loss, act)
         state, loss = self._micro_scan_body(state, batches, rng)
         state = self._apply_step_body(state)
         return state, loss
 
-    def _micro_scan_body(self, state: TrainState, batches, rng):
+    def _micro_scan_body(self, state: TrainState, batches, rng,
+                         with_act=False):
         gas = self.config.gradient_accumulation_steps or 1
         rngs = jax.random.split(rng, gas)
         compute_params = self._compute_params(state.params)
@@ -1205,10 +1305,64 @@ class DeepSpeedTPUEngine:
         def body(st, xs):
             batch, r = xs
             return self._micro_step_body(st, batch, r,
-                                         compute_params=compute_params)
+                                         compute_params=compute_params,
+                                         with_act=with_act)
 
-        state, losses = jax.lax.scan(body, state, (batches, rngs))
-        return state, jnp.mean(losses)
+        state, ys = jax.lax.scan(body, state, (batches, rngs))
+        if not with_act:
+            return state, jnp.mean(ys)
+        losses, acts = ys  # acts: [gas, L, 3]
+        # fold the per-micro-step rows the way each column means:
+        # norms average, max-abs maxes, nonfinite counts sum
+        act = jnp.stack([jnp.mean(acts[..., 0], axis=0),
+                         jnp.max(acts[..., 1], axis=0),
+                         jnp.sum(acts[..., 2], axis=0)], axis=-1)
+        return state, jnp.mean(losses), act
+
+    def _numerics_tree(self, state: TrainState, grads, loss, act):
+        """In-graph numerics stats tree (telemetry/numerics.py) — the
+        fused step's third output.  Pure jnp over trees the step already
+        computed; the host never touches it until the steps_per_print
+        boundary pulls the whole tree in one device_get.  ``grads`` are
+        the pre-unscale accumulated gradients, so magnitude stats carry
+        ``inv_scale = 1/(gas * loss_scale)`` to report TRUE values;
+        ``state`` is post-apply (its grad_norm/skipped_steps are this
+        boundary's)."""
+        from ..telemetry import numerics as _nm
+
+        gas = self.config.gradient_accumulation_steps or 1
+        inv = jnp.float32(1.0 / float(gas))
+        if self.fp16_enabled:
+            inv = inv / state.loss_scale.cur_scale
+        stats = {
+            "step": state.step,
+            "loss": loss,
+            "grad_norm": state.global_grad_norm,
+            "skipped_steps": state.skipped_steps,
+            "grad": _nm.tree_health(grads, inv_scale=inv),
+            "param": _nm.tree_health(state.params),
+            "opt_nonfinite": nonfinite_count(state.opt_state),
+            "grad_leaf_nonfinite": _nm.leaf_nonfinite(grads),
+        }
+        if isinstance(grads, dict) and "layers" in grads:
+            gl = _nm.stacked_health(grads["layers"], inv_scale=inv)
+            if gl is not None:
+                stats["grad_layers"] = gl
+        if isinstance(state.params, dict) and "layers" in state.params:
+            pl = _nm.stacked_health(state.params["layers"])
+            if pl is not None:
+                stats["param_layers"] = pl
+        ef = _nm.ef_residual_norms(state.comm_errors)
+        if ef:
+            stats["ef_residual"] = ef
+        plan = getattr(self, "_overlap_plan", None)
+        if plan is not None and "overlap" in (state.comm_errors or {}):
+            stats["ef_bucket"] = plan.residual_norms(state.comm_errors)
+        if state.loss_scale is not None:
+            stats["loss_scale"] = loss_scale_summary(state.loss_scale)
+        if act is not None:
+            stats["act_layers"] = act
+        return stats
 
     def _compile_steps(self, opt_state_memory_kind: Optional[str] = None,
                        param_memory_kind: Optional[str] = None) -> None:
@@ -1290,8 +1444,13 @@ class DeepSpeedTPUEngine:
                 self._param_host_shardings = None
                 self._apply_step = jax.jit(self._apply_step_body,
                                            out_shardings=state_sh, **donate)
+                # third output slot = the numerics stats tree (XLA places
+                # the small scalars/vectors itself)
+                out_sh = ((state_sh, None, None)
+                          if getattr(self, "_numerics_fused", False)
+                          else (state_sh, None))
                 self._train_batch = jax.jit(self._train_batch_body,
-                                            out_shardings=(state_sh, None),
+                                            out_shardings=out_sh,
                                             **donate)
                 return
             if opt_state_memory_kind is not None:
@@ -1650,8 +1809,15 @@ class DeepSpeedTPUEngine:
             with cap, trace, span("train_batch", cat="train",
                                   step=self.global_steps):
                 with self.topology.mesh:
-                    self.state, loss = self._train_batch(self.state, batch,
-                                                         self._next_rng())
+                    if getattr(self, "_numerics_fused", False):
+                        # stats stay device-resident (no sync): pulled at
+                        # the steps_per_print boundary by _report_telemetry
+                        self.state, loss, self._last_numerics = \
+                            self._train_batch(self.state, batch,
+                                              self._next_rng())
+                    else:
+                        self.state, loss = self._train_batch(
+                            self.state, batch, self._next_rng())
                 self._repin_opt_state()
                 if self.offload_optimizer is not None:
                     self._apply_step_offload()
@@ -1854,6 +2020,13 @@ class DeepSpeedTPUEngine:
             "bytes of compressed-collective error-feedback residual "
             "state carried in TrainState.comm_errors (per-bucket; "
             "docs/COMM.md 'Compressed overlap')")
+        self._m_comp_residual_norm = reg.gauge(
+            "deepspeed_tpu_comm_compression_residual_norm",
+            "L2 norm of the compressed-collective error-feedback "
+            "residual state per comm_errors slot (in-graph, pulled at "
+            "the reporting boundary; a norm growing without bound means "
+            "error feedback is diverging, not compensating)",
+            labelnames=("slot",))
         self._m_steps = reg.counter("deepspeed_tpu_train_steps_total",
                                     "optimizer steps taken")
         self._m_skipped = reg.counter(
@@ -2043,6 +2216,10 @@ class DeepSpeedTPUEngine:
         self._m_comp_residual.set(sum(
             int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
             for l in jax.tree_util.tree_leaves(self.state.comm_errors)))
+        # numerics observatory: pull the fused step's stats tree (one
+        # boundary-cadence device_get), feed the anomaly sentinel, run
+        # the cross-rank divergence audit at its cadence
+        self._numerics_boundary(loss)
         if self._win_time > 0:
             bs = self.config.train_batch_size or 1
             self._m_samples_ps.set(self._win_steps * bs / self._win_time)
@@ -2067,6 +2244,127 @@ class DeepSpeedTPUEngine:
         if self.monitor is not None:
             self.monitor.write_registry(tm.registry, self.global_steps)
         tm.export(self.global_steps)
+
+    def _numerics_boundary(self, loss) -> None:
+        """Numerics-observatory boundary (called from _report_telemetry
+        INSIDE the steps_per_print gate): pull the fused step's stats
+        tree in one device_get, shape it into the sentinel's report, set
+        the EF-residual-norm gauges, and run the cross-data-rank
+        divergence audit at its configured cadence."""
+        nm = self._numerics
+        if nm is None:
+            return
+        report: dict = {"step": self.global_steps}
+        stats = self._last_numerics
+        if stats is not None:
+            # dstpu-lint: allow[host-sync] boundary cadence only (the
+            # steps_per_print gate in _report_telemetry); train_batch
+            # already drained the dispatch queue at this boundary
+            host = jax.device_get(stats)
+            from ..telemetry.numerics import shape_boundary_report
+
+            report.update(shape_boundary_report(host))
+        else:
+            # offload / incremental path: no fused stats tree — the
+            # sentinel still watches the host-available scalars
+            # dstpu-lint: allow[host-sync] boundary cadence, queue drained
+            report["loss"] = None if loss is None else float(loss)
+            # dstpu-lint: allow[host-sync] boundary cadence, queue drained
+            report["grad_norm"] = float(self.state.global_grad_norm)
+            # dstpu-lint: allow[host-sync] boundary cadence, queue drained
+            report["skipped_steps"] = int(self.state.skipped_steps)
+            if self.state.loss_scale is not None:
+                report["loss_scale"] = self.loss_scale()
+        for slot, v in (report.get("ef_residual_norm") or {}).items():
+            self._m_comp_residual_norm.set(v, slot=slot)
+        for bucket, v in (report.get("ef_bucket_norm") or {}).items():
+            self._m_comp_residual_norm.set(v, slot=f"overlap/{bucket}")
+        cfg = nm.config
+        every = int(getattr(cfg, "divergence_every", 1) or 0)
+        if (bool(getattr(cfg, "divergence_audit", True)) and every > 0
+                and nm.boundaries % every == 0):
+            div = self.divergence_audit()
+            if div is not None:
+                report["divergence"] = div
+        nm.observe_boundary(report)
+
+    def divergence_audit(self) -> Optional[dict]:
+        """Cross-data-rank divergence audit (telemetry/numerics.py):
+        bit-exact uint32 checksums over the master params, compared
+        across the data axis.  At ZeRO <= 1 every data rank's copy of a
+        data-replicated leaf must be BIT-IDENTICAL; a mismatch names the
+        first diverging leaf — silent data corruption or a diverging
+        collective, caught before it spreads through the next
+        all-reduce.  Returns the verdict dict, or None when structurally
+        inapplicable (single data rank, ZeRO >= 2 sharded masters, no
+        eligible leaves).
+
+        Each device computes the checksum of ITS local copy; model-axis
+        shards all-reduce within their data row, so the per-device
+        verdicts are per-data-rank.  Audits the process-local device
+        set.  Boundary cadence: one small jitted reduction (compiled
+        once — announced to the recompile sentinel) + one uint32 pull
+        per (leaf, local device)."""
+        from ..parallel.mesh import DATA_AXIS
+        from ..telemetry.numerics import compare_rank_checksums
+
+        if self.topology.axis_size(DATA_AXIS) < 2 \
+                or self.config.zero_config.stage > 1:
+            return None
+
+        def _data_free(leaf):
+            # data-SHARDED leaves legitimately differ per rank; audit
+            # only leaves replicated over the data axis
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            if spec is None:
+                return False
+            names = []
+            for el in spec:
+                if el is None:
+                    continue
+                names.extend(el if isinstance(el, tuple) else (el,))
+            return DATA_AXIS not in names
+
+        from ..telemetry.numerics import _path_str
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.state.params)
+        eligible = {_path_str(p): leaf for p, leaf in flat
+                    if _data_free(leaf)}
+        if not eligible:
+            return None
+        if self._div_fn is None:
+            from ..telemetry.numerics import leaf_checksums
+
+            expect_recompile("numerics.divergence_audit")
+            self._div_fn = jax.jit(leaf_checksums)
+        with self.topology.mesh:
+            sums = self._div_fn(eligible)
+        # dstpu-lint: allow[host-sync] host mesh-topology metadata, not a
+        # device value
+        mesh_devs = np.asarray(self.topology.mesh.devices)
+        ax = list(self.topology.mesh.axis_names).index(DATA_AXIS)
+        coord = {}
+        for idx in np.ndindex(mesh_devs.shape):
+            coord[mesh_devs[idx].id] = int(idx[ax])
+        per_rank: dict = {}
+        for path, arr in sums.items():
+            for sh in arr.addressable_shards:
+                r = coord.get(sh.device.id)
+                if r is None:
+                    continue
+                # dstpu-lint: allow[host-sync] boundary-cadence audit; one
+                # uint32 scalar per (leaf, local device)
+                per_rank.setdefault(r, {})[path] = int(np.asarray(sh.data))
+        return compare_rank_checksums(per_rank)
+
+    def numerics_report(self) -> Optional[dict]:
+        """Numerics observatory summary (bench annex / tools): the
+        sentinel's rolling-window summary plus a fresh divergence-audit
+        verdict.  None when the observatory is off."""
+        if self._numerics is None:
+            return None
+        out = dict(self._numerics.summary())
+        out["divergence"] = self.divergence_audit()
+        return out
 
     def close(self) -> None:
         """Flush and release observability sinks (telemetry exporters,
@@ -2166,6 +2464,13 @@ class DeepSpeedTPUEngine:
             partitioned = jax.process_count() > 1
         rcfg = self.config.resilience
         keep_n = rcfg.keep_n if rcfg.enabled else None
+        if self._numerics is not None:
+            # numerics observatory rides client_state: the sentinel's
+            # rolling window survives resume (a loss spike right after
+            # restart is judged against the pre-restart median, not an
+            # empty history).  setdefault — a caller-provided slot wins.
+            client_state = dict(client_state or {})
+            client_state.setdefault("numerics", self._numerics.state_dict())
 
         def _save():
             if partitioned:
@@ -2222,12 +2527,35 @@ class DeepSpeedTPUEngine:
             logger.warning(f"no loadable checkpoint in {load_dir}; "
                            "nothing loaded")
             return None, {}
+        inc = (_report.get("meta") or {}).get("numerics_incident") \
+            if isinstance(_report, dict) else None
+        if inc:
+            # resume-time triage: this tag was the first save after the
+            # anomaly sentinel fired — say WHAT fired and WHERE before
+            # the operator burns a day rediscovering it
+            first = (inc.get("anomalies") or [{}])[0]
+            layer = first.get("first_nonfinite_layer")
+            leaf = (first.get("first_nonfinite_leaf")
+                    or first.get("first_diverging_leaf"))
+            logger.warning(
+                f"resuming from '{resolved}' which carries a numerics "
+                f"incident: kinds={inc.get('kinds')} "
+                f"step={inc.get('step')} first_nonfinite_layer={layer} "
+                f"leaf={leaf}")
         t0 = time.perf_counter()
         try:
             with span("checkpoint_load", cat="ckpt", tag=resolved):
                 if os.path.exists(os.path.join(load_dir, resolved, META_FILE)):
-                    return load_partitioned(self, load_dir, tag=resolved)
-                return load_checkpoint(self, load_dir, tag=resolved)
+                    ret = load_partitioned(self, load_dir, tag=resolved)
+                else:
+                    ret = load_checkpoint(self, load_dir, tag=resolved)
+                if self._numerics is not None and isinstance(ret, tuple) \
+                        and len(ret) > 1:
+                    # restore the sentinel's rolling window (see
+                    # save_checkpoint); absent slot -> no-op reset-free
+                    self._numerics.load_state_dict(
+                        (ret[1] or {}).get("numerics"))
+                return ret
         finally:
             gp = (self.telemetry.goodput if self.telemetry is not None
                   else None)

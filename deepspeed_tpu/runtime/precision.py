@@ -50,6 +50,27 @@ def check_overflow(grads: Any) -> jnp.ndarray:
     return out
 
 
+def nonfinite_count(tree: Any) -> jnp.ndarray:
+    """Total inf/nan elements over a pytree (i32 scalar, in-trace).
+    The counting sibling of :func:`check_overflow` — the numerics
+    observatory (telemetry/numerics.py) reports HOW MUCH went nonfinite,
+    not just whether the step must be skipped."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0, jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(g.astype(jnp.float32)))
+               for g in leaves).astype(jnp.int32)
+
+
+def loss_scale_summary(state: LossScaleState) -> dict:
+    """In-trace scalars describing the dynamic loss-scale state — ride
+    the numerics stats tree so the boundary report shows the scale the
+    step ACTUALLY used (pre-update) next to its trackers."""
+    return {"cur_scale": state.cur_scale,
+            "growth_tracker": state.growth_tracker,
+            "hysteresis_tracker": state.hysteresis_tracker}
+
+
 def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray,
                       config: FP16Config) -> LossScaleState:
     """Dynamic scaling: on overflow halve (respecting hysteresis) and reset

@@ -82,7 +82,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -236,6 +236,18 @@ class OverlapPlan:
         ``deepspeed_tpu_comm_compression_residual_bytes`` gauge)."""
         W = int(self.mesh.shape[self.axis])
         return sum(self.n_layers * W * s * 4 for s in self._eslot_sizes)
+
+    def residual_norms(self, comm_errors) -> Dict[str, Any]:
+        """Per-bucket L2 norm of the carried EF residuals (in-trace fp32
+        scalars, keyed like ``init_errors``).  residual_bytes says how
+        much compensation state exists STRUCTURALLY; these say how big
+        the compensation actually IS — a bucket norm growing without
+        bound means error feedback is diverging, not catching up.  Rides
+        the numerics stats tree; the engine publishes it as the
+        ``deepspeed_tpu_comm_compression_residual_norm`` gauge."""
+        slots = comm_errors.get("overlap", {}) if comm_errors else {}
+        return {k: jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+                for k, v in slots.items()}
 
     def eslot_state(self, comm_errors):
         """The eslot tree for this step: the carried train-state

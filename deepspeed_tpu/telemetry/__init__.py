@@ -31,6 +31,9 @@ from .memory import (MemoryLedger, get_memory_ledger, is_resource_exhausted,
                      top_live_buffers)
 from .mfu import (PEAK_BF16_FLOPS, mfu, peak_flops_for_device,
                   peak_flops_for_kind)
+from .numerics import (NumericsLedger, compare_rank_checksums,
+                       get_numerics_ledger, last_numerics_summary,
+                       set_numerics_ledger)
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, get_registry, set_registry)
 from .reqtrace import (ReqTraceLedger, RequestTrace, get_reqtrace_ledger,
@@ -65,6 +68,8 @@ __all__ = [
     "last_timeline_record",
     "GoodputLedger", "get_goodput_ledger", "set_goodput_ledger",
     "last_goodput_summary",
+    "NumericsLedger", "get_numerics_ledger", "set_numerics_ledger",
+    "last_numerics_summary", "compare_rank_checksums",
     "RequestTrace", "ReqTraceLedger", "get_reqtrace_ledger",
     "set_reqtrace_ledger", "slo_exemplar", "last_reqtrace_summary",
     "merged_trace_events", "write_merged_trace",
@@ -100,6 +105,7 @@ class Telemetry:
         self.ledger: Optional[MemoryLedger] = None
         self.timeline: Optional[StepTimeline] = None
         self.goodput: Optional[GoodputLedger] = None
+        self.numerics: Optional[NumericsLedger] = None
         self.export_interval = 1
         self.trace_annotations = True
         self._last_export: Optional[int] = None
@@ -159,6 +165,12 @@ class Telemetry:
             # process default: resilience (auto-resume reclassification)
             # and flight dumps reach the ledger without an engine handle
             set_goodput_ledger(self.goodput)
+        nm = getattr(config, "numerics", None)
+        if nm is not None and getattr(nm, "enabled", False):
+            self.numerics = NumericsLedger(nm, registry=self.registry)
+            # process default: flight dumps and checkpoint commits reach
+            # the sentinel without an engine handle
+            set_numerics_ledger(self.numerics)
 
     def _on_stall(self, name: str, step, ratio: float) -> None:
         """Watchdog incident edge -> flight-recorder dump (black box for
@@ -229,6 +241,9 @@ class Telemetry:
                 pass
             if get_goodput_ledger() is self.goodput:
                 set_goodput_ledger(None)
+        if self.numerics is not None \
+                and get_numerics_ledger() is self.numerics:
+            set_numerics_ledger(None)
         for sink, part in (("prometheus_file", self.prom_file),
                            ("prometheus_http", self.prom_http),
                            ("jsonl", self.jsonl)):

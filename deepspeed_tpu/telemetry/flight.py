@@ -27,6 +27,8 @@ File schema (one JSON object per line, same spirit as
 * ``{"kind": "memory", "ts", "components", "stats", "watermarks", ...}``
   — the memory ledger's reading at dump time (telemetry/memory.py), so
   every incident file answers memory questions too
+* ``{"kind": "numerics", ...}`` — the numerics observatory's last
+  boundary report + sentinel window (``numerics.last_numerics_summary``)
 * ``{"kind": "snapshot", "ts", "metrics": {...}}`` — the registry at
   dump time (the final record of a plain dump)
 * ``{"kind": "oom_incident", ...}`` — appended by OOM forensics
@@ -148,6 +150,19 @@ class FlightRecorder:
                 rt = last_reqtrace_summary()
                 if rt is not None:
                     line(dict({"kind": "reqtrace"}, **rt))
+            # dstpu-lint: allow[swallow] same contract as the memory record
+            except Exception:
+                pass
+            try:
+                # numerics observatory: the last boundary's per-layer
+                # health report + sentinel window, so any dump (stall,
+                # exception, OOM — not just numerics-triggered ones)
+                # answers "was training numerically healthy?"
+                from .numerics import last_numerics_summary
+
+                nm = last_numerics_summary()
+                if nm is not None:
+                    line(dict({"kind": "numerics"}, **nm))
             # dstpu-lint: allow[swallow] same contract as the memory record
             except Exception:
                 pass

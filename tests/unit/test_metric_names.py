@@ -146,3 +146,43 @@ def test_lint_ignores_unrelated_calls(tmp_path):
         "collections.Counter('abc')\n"
         "reg.counter(name_variable)\n")
     assert lint.check(str(tmp_path)) == []
+
+
+def test_numerics_family_is_single_owner_by_module(tmp_path):
+    """The `deepspeed_tpu_train_numerics_*` family belongs to
+    `telemetry/numerics.py` alone: a second module minting into the
+    family fails by name (it would fork the training-health anomaly
+    accounting the sentinel is the sole authority for)."""
+    lint = _load_lint()
+    pkg = tmp_path / "deepspeed_tpu"
+    (pkg / "telemetry").mkdir(parents=True)
+    (tmp_path / "tools").mkdir()
+    (pkg / "telemetry" / "numerics.py").write_text(
+        "reg.counter('deepspeed_tpu_train_numerics_anomalies_total')\n")
+    (pkg / "rogue.py").write_text(
+        "reg.counter('deepspeed_tpu_train_numerics_forked_total')\n")
+    errors = lint.check(str(tmp_path))
+    joined = "\n".join(errors)
+    assert "deepspeed_tpu_train_numerics_forked_total" in joined
+    assert "outside the family owner" in joined
+    assert "telemetry" in joined and "numerics.py" in joined
+    # the legitimate owner's registration produced no error
+    assert "deepspeed_tpu_train_numerics_anomalies_total" not in joined
+
+
+def test_package_registers_numerics_family_in_owner_module():
+    """The real tree: the five numerics-observatory metrics exist and
+    every one is registered in the owning module."""
+    lint = _load_lint()
+    names = lint.collect(REPO)
+    family = {n: sites for n, sites in names.items()
+              if n.startswith("deepspeed_tpu_train_numerics_")}
+    assert set(family) == {
+        "deepspeed_tpu_train_numerics_anomalies_total",
+        "deepspeed_tpu_train_numerics_boundaries_total",
+        "deepspeed_tpu_train_numerics_grad_nonfinite_elems",
+        "deepspeed_tpu_train_numerics_grad_norm_median",
+        "deepspeed_tpu_train_numerics_divergence_failures_total"}
+    owner = os.path.join("deepspeed_tpu", "telemetry", "numerics.py")
+    for n, sites in family.items():
+        assert all(f == owner for f, _ln, _t in sites), (n, sites)

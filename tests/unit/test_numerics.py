@@ -1,0 +1,279 @@
+"""Numerics observatory (telemetry/numerics.py): in-graph per-layer
+training-health stats, the anomaly sentinel + flight dump + checkpoint
+incident annotation, and the cross-data-rank divergence audit.
+
+The engine-level tests run the REAL fused path (stats ride the step as a
+third output, pulled only at the steps_per_print boundary) so they prove
+the wiring, not just the pure functions.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.telemetry import numerics as nm
+
+from tests.unit.simple_model import simple_mlp_spec
+
+HIDDEN = 16
+
+
+def _mlp_engine(tmp_path, extra_cfg=None, numerics_cfg=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "steps_per_print": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "telemetry": {
+            "enabled": True,
+            "numerics": dict({"enabled": True}, **(numerics_cfg or {})),
+            # keep anomaly dumps inside the test sandbox (the recorder
+            # is on by default with a cwd-relative dir)
+            "flight_recorder": {"enabled": True,
+                                "path": str(tmp_path / "flight")},
+        },
+    }
+    cfg.update(extra_cfg or {})
+    engine, *_ = deepspeed_tpu.initialize(model=simple_mlp_spec(HIDDEN),
+                                          config=cfg)
+    return engine
+
+
+def _mlp_batch(engine, seed=0, scale=1.0, poison=None):
+    rng = np.random.RandomState(seed)
+    B = engine.config.train_batch_size
+    x = (rng.randn(B, HIDDEN) * scale).astype(np.float32)
+    y = (x * 0.5).astype(np.float32)
+    if poison is not None:
+        x[:] = poison
+    return (jnp.asarray(x[None]), jnp.asarray(y[None]))
+
+
+# --------------------------------------------------------------- pure parts
+
+def test_tree_health_and_stacked_health():
+    tree = {"a": jnp.array([3.0, 4.0]), "b": jnp.array([[0.0, jnp.inf]])}
+    h = jax.device_get(nm.tree_health(tree))
+    assert int(h["nonfinite"]) == 1
+    # max_abs reports the RAW magnitude — an inf there is the signal
+    assert float(h["max_abs"]) == float("inf")
+    stacked = {"w": jnp.ones((3, 4)), "b": jnp.zeros((3,))}
+    s = jax.device_get(nm.stacked_health(stacked))
+    assert s["norm"].shape == (3,)
+    assert np.allclose(s["norm"], 2.0)  # sqrt(4*1 + 0)
+    # not a stacked tree (leading dims disagree) -> None, callers gate
+    assert nm.stacked_health({"w": jnp.ones((3, 4)),
+                              "v": jnp.ones((2, 4))}) is None
+
+
+def test_compare_rank_checksums_names_first_diverging_leaf():
+    ok = nm.compare_rank_checksums({0: {"a/w": 1, "b/w": 2},
+                                    1: {"a/w": 1, "b/w": 2}})
+    assert ok["ok"] and ok["first_diverging_leaf"] is None
+    bad = nm.compare_rank_checksums({0: {"a/w": 1, "b/w": 2},
+                                     1: {"a/w": 1, "b/w": 3}})
+    assert not bad["ok"]
+    assert bad["first_diverging_leaf"] == "b/w"
+    assert bad["diverging"] == ["b/w"]
+    # a single rank is vacuously consistent
+    assert nm.compare_rank_checksums({0: {"a/w": 7}})["ok"]
+
+
+def test_shape_boundary_report_first_nonfinite_layer():
+    host = {
+        "loss": np.float32(2.0), "grad_norm": np.float32(1.0),
+        "skipped_steps": np.int32(0), "opt_nonfinite": np.int32(0),
+        "grad": {"norm": np.float32(1.0), "max_abs": np.float32(0.5),
+                 "nonfinite": np.int32(3)},
+        "param": {"norm": np.float32(9.0), "max_abs": np.float32(1.0),
+                  "nonfinite": np.int32(0)},
+        "grad_leaf_nonfinite": {"layer_1/w": np.int32(3),
+                                "layer_0/w": np.int32(0)},
+        # [L, 3] act stats: layer 0 healthy, layer 2 went nonfinite
+        "act_layers": np.array([[1.0, 0.5, 0.0],
+                                [2.0, 0.7, 0.0],
+                                [np.inf, np.inf, 4.0]], np.float32),
+    }
+    rep = nm.shape_boundary_report(host)
+    assert rep["grad_nonfinite"] == 3
+    assert rep["first_nonfinite_layer"] == 2
+    assert rep["first_nonfinite_leaf"] == "layer_1/w"
+    assert rep["layers"]["act_nonfinite"] == [0, 0, 4]
+    # the report is JSON-serializable as-is (flight dumps write it)
+    json.dumps(nm._json_safe(rep))
+
+
+def test_ledger_detects_and_state_roundtrips():
+    led = nm.NumericsLedger(None)
+    base = {"step": 0, "loss": 1.0, "grad_norm": 1.0, "skipped_steps": 0,
+            "grad_nonfinite": 0}
+    for i in range(8):
+        # slight drift keeps the stagnant-loss detector quiet
+        assert led.observe_boundary(dict(base, step=i,
+                                         loss=1.0 + 0.01 * i)) == []
+    # loss spike vs the rolling median fires, and records an incident
+    spiked = led.observe_boundary(dict(base, step=8, loss=100.0))
+    assert [a["kind"] for a in spiked] == ["loss_spike"]
+    assert led.anomaly_counts["loss_spike"] == 1
+    inc = led.pending_incident()
+    assert inc and inc["kinds"] == ["loss_spike"]
+    # round-trip: a restored ledger carries the window AND the incident
+    led2 = nm.NumericsLedger(None)
+    led2.load_state_dict(json.loads(json.dumps(led.state_dict())))
+    assert led2.summary()["boundaries"] == led.summary()["boundaries"]
+    assert led2.anomaly_counts == led.anomaly_counts
+    assert led2.consume_incident() == inc
+    assert led2.consume_incident() is None  # consume-once
+    # overflow storm: skipped-step delta between boundaries >= threshold
+    led3 = nm.NumericsLedger(None)
+    led3.observe_boundary(dict(base, skipped_steps=0))
+    storm = led3.observe_boundary(dict(base, step=1, skipped_steps=4))
+    assert [a["kind"] for a in storm] == ["overflow_storm"]
+    assert storm[0]["skipped_since_last_boundary"] == 4
+
+
+# ---------------------------------------------------------- engine wiring
+
+def test_nan_injection_names_layer_in_report_and_dump(tmp_path):
+    """NaN poisoned into the batch goes nonfinite in layer 0 first: the
+    boundary report attributes it, the sentinel counts it, the flight
+    dump carries the per-layer breakdown, and the next checkpoint tag's
+    manifest is annotated for resume-time triage."""
+    engine = _mlp_engine(tmp_path)
+    engine.train_batch(_mlp_batch(engine, 0))
+    engine.train_batch(_mlp_batch(engine, 1, poison=np.nan))
+    rep = engine.numerics_report()
+    assert rep is not None
+    assert rep["anomaly_counts"].get("nonfinite", 0) >= 1
+    last = rep["last_report"]
+    assert last["grad_nonfinite"] > 0
+    # leaf attribution: the first (lexicographic) nonfinite grad leaf
+    assert last["first_nonfinite_leaf"].startswith("layer_0/")
+    assert any(l.startswith("layer_0/") for l in last["nonfinite_leaves"])
+    # the dump fired with the numerics record naming the same leaf
+    dumps = glob.glob(str(tmp_path / "flight" / "*numerics_nonfinite*"))
+    assert dumps, "anomaly must fire a flight dump"
+    recs = [json.loads(l) for l in open(dumps[0])]
+    numrec = [r for r in recs if r.get("kind") == "numerics"]
+    assert numrec and numrec[0]["last_report"]["first_nonfinite_leaf"] \
+        .startswith("layer_0/")
+    # checkpoint annotation: the incident rides the next tag's manifest
+    from deepspeed_tpu.resilience.commit import manifest_meta
+
+    ckpt = str(tmp_path / "ckpt")
+    engine.save_checkpoint(ckpt, tag="incident")
+    inc = manifest_meta(ckpt, "incident").get("numerics_incident")
+    assert inc and "nonfinite" in inc["kinds"]
+    first = inc["anomalies"][0]
+    assert first["first_nonfinite_leaf"].startswith("layer_0/")
+    # consume-once: a later clean save is NOT re-stamped
+    engine.save_checkpoint(ckpt, tag="clean")
+    assert "numerics_incident" not in manifest_meta(ckpt, "clean")
+
+
+def test_overflow_storm_trips_sentinel(tmp_path):
+    """fp16 at 2^20 loss scale with huge activations overflows every
+    early step; the skipped-step delta inside one reporting window trips
+    the overflow_storm detector (the first boundary only seeds the
+    skipped baseline, so the storm fires at the second)."""
+    engine = _mlp_engine(
+        tmp_path,
+        extra_cfg={"fp16": {"enabled": True, "initial_scale_power": 20},
+                   "steps_per_print": 4},
+        numerics_cfg={"overflow_storm": 3})
+    for i in range(8):
+        engine.train_batch(_mlp_batch(engine, i, scale=1e3))
+    assert int(engine.state.skipped_steps) >= 6
+    rep = engine.numerics_report()
+    assert rep["anomaly_counts"].get("overflow_storm", 0) >= 1
+    # the loss-scale state rode the stats tree to the boundary report
+    # (backed off from the forced 2^20 start by the overflow skips)
+    assert rep["last_report"]["loss_scale"] < 2 ** 20
+
+
+def test_divergence_audit_catches_bit_flip(tmp_path, devices8):
+    """Master params are replicated across the data axis at ZeRO 0/1:
+    the boundary checksum audit is bit-exact, and a single flipped bit
+    in ONE rank's local replica fails the audit naming the leaf."""
+    engine = _mlp_engine(tmp_path)
+    if engine.topology.axis_size("data") < 2:
+        pytest.skip("needs a >=2-way data axis")
+    engine.train_batch(_mlp_batch(engine, 0))
+    div = engine.divergence_audit()
+    assert div is not None and div["ok"], div
+    assert div["ranks"] >= 2
+
+    p = engine.state.params["layer_0"]["w"]
+    shards = sorted(p.addressable_shards, key=lambda s: s.device.id)
+    bufs = []
+    for i, sh in enumerate(shards):
+        arr = np.array(sh.data)
+        if i == 0:  # one rank's replica, one bit
+            arr.view(np.uint32).ravel()[0] ^= 1
+        bufs.append(jax.device_put(arr, sh.device))
+    flipped = jax.make_array_from_single_device_arrays(
+        p.shape, p.sharding, bufs)
+    engine.state.params["layer_0"] = dict(
+        engine.state.params["layer_0"], w=flipped)
+
+    div = engine.divergence_audit()
+    assert not div["ok"]
+    assert div["first_diverging_leaf"] == "layer_0/w"
+    assert div["diverging"] == ["layer_0/w"]
+
+    # the flip survives an (identical-across-ranks) optimizer update, so
+    # the NEXT boundary's audit catches it end-to-end: anomaly counted,
+    # flight dump fired naming the leaf
+    engine.train_batch(_mlp_batch(engine, 1))
+    rep = engine.numerics_report()
+    assert rep["anomaly_counts"].get("divergence", 0) >= 1
+    dumps = glob.glob(str(tmp_path / "flight" / "*numerics_divergence*"))
+    assert dumps, "divergence anomaly must fire a flight dump"
+
+
+def test_sentinel_state_survives_checkpoint_roundtrip(tmp_path):
+    """The rolling windows ride checkpoint client_state: a spike right
+    after restore is judged against the pre-crash history."""
+    e1 = _mlp_engine(tmp_path)
+    for i in range(3):
+        e1.train_batch(_mlp_batch(e1, i))
+    before = e1._numerics.summary()
+    assert before["boundaries"] == 3
+    ckpt = str(tmp_path / "ckpt")
+    e1.save_checkpoint(ckpt)
+
+    from deepspeed_tpu.parallel import mesh as _mesh
+
+    _mesh.reset_topology()
+    e2 = _mlp_engine(tmp_path)
+    assert e2._numerics.summary()["boundaries"] == 0
+    e2.load_checkpoint(ckpt)
+    after = e2._numerics.summary()
+    assert after["boundaries"] == 3
+    assert after["grad_norm_median"] == pytest.approx(
+        before["grad_norm_median"])
+
+
+def test_replay_recompiles_zero_with_numerics_on(tmp_path):
+    """The acceptance pin: turning the observatory on must not grow the
+    replay path a recompile (the stats tree is a fixed extra output of
+    the SAME fused program)."""
+    from deepspeed_tpu.telemetry.compile_sentinel import (
+        compile_counts, install_compile_listener)
+
+    install_compile_listener()
+    engine = _mlp_engine(tmp_path)
+    for i in range(2):  # warm-up: trace + donation-variant compiles
+        engine.train_batch(_mlp_batch(engine, i))
+    c0 = compile_counts()[0]
+    for i in range(4):
+        engine.train_batch(_mlp_batch(engine, 2 + i))
+    assert compile_counts()[0] == c0, "replay must not recompile"
+    rep = engine.numerics_report()
+    assert rep["boundaries"] == 6

@@ -134,6 +134,40 @@ def test_hazard_pipe_tick_body_is_hot(tmp_path):
     assert hl.check(root3) == []
 
 
+def test_hazard_numerics_stats_pull_is_boundary_cadence_only(tmp_path):
+    """The numerics observatory's contract: the in-graph stats tree is
+    device-resident until the steps_per_print boundary pulls it.  An
+    eager `.item()` on the stats tree seeded into the fused train_batch
+    path fails the host-sync rule by name — turning numerics on must not
+    grow the hot path a per-step sync."""
+    hl = _hazard_lint()
+    root = _write_tree(tmp_path, {
+        "deepspeed_tpu/runtime/engine.py":
+            "def train_batch(self, batch):\n"
+            "    state, loss, stats = self._fused(batch)\n"
+            "    self._last_numerics = stats\n"
+            "    gn = stats['grad_norm'].item()\n"
+            "    return loss\n"})
+    violations = hl.check(root)
+    assert [v.rule for v in violations] == ["host-sync"]
+    assert ".item()" in violations[0].message
+    assert "train_batch" in violations[0].message
+    # the legitimate shape — one documented device_get at the reporting
+    # boundary, off the per-step path — lints clean
+    root2 = _write_tree(tmp_path / "boundary", {
+        "deepspeed_tpu/runtime/engine.py":
+            "def train_batch(self, batch):\n"
+            "    state, loss, stats = self._fused(batch)\n"
+            "    self._last_numerics = stats\n"
+            "    self._numerics_boundary()\n"
+            "    return loss\n"
+            "def _numerics_boundary(self):\n"
+            "    # dstpu-lint: allow[host-sync] boundary cadence pull\n"
+            "    host = jax.device_get(self._last_numerics)\n"
+            "    return host\n"})
+    assert hl.check(root2) == []
+
+
 def test_hazard_rules_fire_and_allowlist_suppresses(tmp_path):
     hl = _hazard_lint()
     root = _write_tree(tmp_path, {

@@ -87,6 +87,9 @@ def _train_demo(out_dir: str, steps: int):
                 "jsonl_path": os.path.join(out_dir, "events.jsonl"),
                 "export_interval": 2,
                 "stall_watchdog": {"enabled": True, "multiple": 3.0},
+                "flight_recorder": {"enabled": True,
+                                    "path": os.path.join(out_dir, "flight")},
+                "numerics": {"enabled": True, "min_history": 2},
             },
         })
     B = engine.config.train_batch_size
@@ -107,6 +110,32 @@ def _train_demo(out_dir: str, steps: int):
         engine.backward(loss)
         engine.step()
     return engine
+
+
+def _numerics_demo(engine, out_dir: str):
+    """Numerics observatory end-to-end: poison one batch with NaNs, let
+    the next reporting boundary's stats pull trip the `nonfinite`
+    sentinel (anomaly counter + flight dump with the per-leaf
+    breakdown), then save a checkpoint and read the incident back out
+    of the tag's commit manifest — the full anomaly → dump → manifest
+    triage loop, in-process."""
+    import jax.numpy as jnp
+
+    B = engine.config.train_batch_size
+    hidden = 16
+    x = np.full((1, B, hidden), np.nan, np.float32)
+    y = np.zeros((1, B, hidden), np.float32)
+    for _ in range(2):  # two steps always cross a steps_per_print=2 boundary
+        engine.train_batch((jnp.asarray(x), jnp.asarray(y)))
+    report = engine.numerics_report()
+    ckpt_dir = os.path.join(out_dir, "ckpt")
+    engine.save_checkpoint(ckpt_dir, tag="numerics_demo")
+
+    from deepspeed_tpu.resilience.commit import manifest_meta
+
+    incident = manifest_meta(ckpt_dir, "numerics_demo").get(
+        "numerics_incident")
+    return report, incident
 
 
 def _serving_demo(n_requests: int):
@@ -168,6 +197,8 @@ REQUIRED_FAMILIES = (
     "deepspeed_tpu_comm_bytes_total",
     "deepspeed_tpu_memory_bytes_in_use",          # memory ledger gauges
     "deepspeed_tpu_memory_component_bytes",
+    "deepspeed_tpu_train_numerics_boundaries_total",  # numerics observatory
+    "deepspeed_tpu_train_numerics_anomalies_total",   # (the demo trips one)
 )
 
 
@@ -187,6 +218,7 @@ def main(argv=None) -> int:
     from deepspeed_tpu.telemetry import get_registry, parse_prometheus_text
 
     engine = _train_demo(out_dir, args.steps)
+    numerics, incident = _numerics_demo(engine, out_dir)
     cache = _serving_demo(args.serve_requests)
     cl = _comms_demo(engine.topology)
     if cl is not None:
@@ -239,10 +271,21 @@ def main(argv=None) -> int:
                            for k, v in mem["components"].items()},
             "watermarks": mem["watermarks"],
         },
+        "numerics": {
+            "boundaries": numerics["boundaries"] if numerics else 0,
+            "anomaly_counts": numerics["anomaly_counts"] if numerics else {},
+            "first_nonfinite_leaf": ((numerics.get("last_report") or {})
+                                     .get("first_nonfinite_leaf")
+                                     if numerics else None),
+            "divergence_ok": ((numerics.get("divergence") or {}).get("ok")
+                              if numerics else None),
+            "incident_annotated": bool(incident),
+        },
         "missing_required": missing,
         "lint_errors": lint_errors,
         "bad_runtime_names": bad_names,
-        "ok": not (missing or lint_errors or bad_names),
+        "ok": not (missing or lint_errors or bad_names)
+        and bool(incident),
     }
     print(json.dumps(summary, default=float))
     return 0 if summary["ok"] else 1
