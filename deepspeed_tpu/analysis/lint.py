@@ -14,6 +14,15 @@ jobs at runtime but are perfectly visible at review time:
     round-trip serializing the dispatch queue) or a deliberate boundary
     that deserves an inline justification.
 
+``socket-hot``
+    Blocking socket reads — ``.recv()``, ``.recv_into()``,
+    ``.recvfrom()``, ``.accept()`` — inside functions reachable from
+    the hot step roots (same reachability walk as ``host-sync``).  A
+    blocking socket wait on the engine/router step path stalls device
+    dispatch exactly like a host sync does; cross-process KV transport
+    belongs on the dedicated sender thread
+    (``serving/transport.BundleSender``), never inline in ``step``.
+
 ``wall-clock``
     ``time.time()`` in step/determinism paths.  Wall clock is fine for
     record timestamps; it is a hazard when used for *durations* or
@@ -86,8 +95,8 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 #: rule ids (the catalog in docs/STATIC_ANALYSIS.md mirrors this)
-RULES = ("host-sync", "wall-clock", "unseeded-random", "swallow",
-         "mutable-default", "pytree-order", "grad-overlap",
+RULES = ("host-sync", "socket-hot", "wall-clock", "unseeded-random",
+         "swallow", "mutable-default", "pytree-order", "grad-overlap",
          "slo-exemplar")
 
 ALLOW_RE = re.compile(
@@ -330,6 +339,29 @@ def _check_host_sync(rel, tree, out: List[Violation]) -> None:
                         f"{label} in '{fname}' (reachable from hot step "
                         f"path {sorted(roots)}): device-value sync on the "
                         "step path serializes the dispatch queue"))
+
+
+#: blocking socket receive-side calls — each parks the calling thread
+#: until the peer sends, which on a step path stalls device dispatch
+_SOCKET_BLOCKING_ATTRS = ("recv", "recv_into", "recvfrom", "accept")
+
+
+def _check_socket_hot(rel, tree, out: List[Violation]) -> None:
+    roots = HOT_ROOTS.get(rel)
+    if not roots:
+        return
+    for fname, fn in _reachable(tree, roots):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SOCKET_BLOCKING_ATTRS:
+                out.append(Violation(
+                    "socket-hot", rel, node.lineno,
+                    f".{node.func.attr}() in '{fname}' (reachable from "
+                    f"hot step path {sorted(roots)}): a blocking socket "
+                    "wait on the step path stalls device dispatch — "
+                    "route cross-process I/O through the transport "
+                    "sender thread"))
 
 
 def _check_wall_clock(rel, tree, out: List[Violation]) -> None:
@@ -581,7 +613,8 @@ def _check_slo_exemplar(rel, tree, out: List[Violation]) -> None:
                             "request (docs/OBSERVABILITY.md)"))
 
 
-_CHECKS = (_check_host_sync, _check_wall_clock, _check_unseeded_random,
+_CHECKS = (_check_host_sync, _check_socket_hot, _check_wall_clock,
+           _check_unseeded_random,
            _check_swallow, _check_mutable_default, _check_pytree_order,
            _check_grad_overlap, _check_slo_exemplar)
 

@@ -80,6 +80,14 @@ _FAMILY_OWNERS = {
     # anomaly accounting (docs/OBSERVABILITY.md "Numerics observatory")
     "deepspeed_tpu_train_numerics_":
         os.path.join("deepspeed_tpu", "telemetry", "numerics.py"),
+    # the cross-process serving fleet families (docs/SERVING.md
+    # "Cross-process fleet") each have exactly one registering module
+    "deepspeed_tpu_serving_transport_":
+        os.path.join("deepspeed_tpu", "serving", "transport.py"),
+    "deepspeed_tpu_serving_autoscale_":
+        os.path.join("deepspeed_tpu", "serving", "autoscale.py"),
+    "deepspeed_tpu_serving_kv_nvme_":
+        os.path.join("deepspeed_tpu", "serving", "kv_tier.py"),
 }
 
 Site = Tuple[str, int, str]  # (relpath, lineno, metric_type)

@@ -10,10 +10,11 @@ docs/SERVING.md "Fleet serving".
 so config parsing never pulls in jax-facing engine code.
 """
 
-from .config import KVTierConfig, ServingConfig  # noqa: F401
+from .config import (AutoscaleConfig, KVTierConfig,  # noqa: F401
+                     ServingConfig, TransportConfig)
 
 _LAZY = {
-    "HostKVTier": "kv_tier",
+    "HostKVTier": "kv_tier", "NVMeKVTier": "kv_tier",
     "FleetRouter": "router", "build_fleet": "router",
     "affinity_key": "router", "hrw_score": "router",
     "pick_replica": "router",
@@ -23,11 +24,18 @@ _LAZY = {
     "BREAKER_HALF_OPEN": "replica",
     "migrate_sequence": "kv_transfer", "bundle_to_bytes": "kv_transfer",
     "bundle_from_bytes": "kv_transfer", "CorruptBundleError": "kv_transfer",
+    "pages_to_bytes": "kv_transfer", "pages_from_bytes": "kv_transfer",
+    "rebase_deadline_left": "kv_transfer",
     "AdmissionController": "admission", "RejectedError": "admission",
     "retry_after_hint": "admission", "estimate_pages": "admission",
+    "EngineServer": "transport", "RemoteEngineProxy": "transport",
+    "BundleSender": "transport", "pipelined_migrate": "transport",
+    "spawn_engine_server": "transport", "TransportError": "transport",
+    "FleetAutoscaler": "autoscale",
 }
 
-__all__ = ["ServingConfig", "KVTierConfig"] + sorted(_LAZY)
+__all__ = ["ServingConfig", "KVTierConfig", "AutoscaleConfig",
+           "TransportConfig"] + sorted(_LAZY)
 
 
 def __getattr__(name):

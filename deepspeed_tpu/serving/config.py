@@ -45,6 +45,18 @@ class KVTierConfig(ConfigModel):
     #: prefetched while the current batch decodes (0 = admission-time
     #: restore only)
     prefetch_requests: int = 1
+    #: NVMe third tier under the host LRU (docs/SERVING.md
+    #: "Cross-process fleet"): pages evicted from the byte-budgeted host
+    #: LRU demote to a file-backed LRU (one DSTPUKV2 page record per
+    #: file under ``nvme_dir``) instead of being dropped, and a host
+    #: miss consults the files — CRC-verified on read, promote-on-hit.
+    nvme_enabled: bool = False
+    #: directory for the page files; empty = a per-tier mkdtemp under
+    #: the system temp dir (fine for drills; production points this at
+    #: an NVMe mount)
+    nvme_dir: str = ""
+    #: byte budget for page files on disk (LRU beyond it)
+    nvme_bytes: int = 16 << 30
 
     def validate(self) -> None:
         if self.host_bytes < 0:
@@ -53,6 +65,91 @@ class KVTierConfig(ConfigModel):
             raise ValueError("kv_tier.spill_inflight must be >= 1")
         if self.prefetch_requests < 0:
             raise ValueError("kv_tier.prefetch_requests must be >= 0")
+        if self.nvme_bytes < 0:
+            raise ValueError("kv_tier.nvme_bytes must be >= 0")
+
+
+@dataclasses.dataclass
+class TransportConfig(ConfigModel):
+    """Cross-process KV transport (``serving/transport.py``): socket
+    framing + the sender's bounded connect/send retry policy.  The
+    backoff schedule mirrors the ``resilience/`` elastic-agent policy
+    (exponential, capped, seeded jitter) so a dead peer costs a BOUNDED
+    number of connect attempts — never an infinite reconnect loop."""
+
+    #: bounded connect/reconnect attempts before the transport gives up
+    #: (the caller keeps the pages — a dead peer loses nothing)
+    connect_retries: int = 5
+    #: exponential backoff between attempts: base * 2^(attempt-1),
+    #: capped at max, times (1 + jitter * seeded_uniform)
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    backoff_jitter: float = 0.25
+    #: per-frame send/recv socket timeout (seconds); a peer that stalls
+    #: longer than this mid-frame tears the connection instead of
+    #: wedging the sender thread forever
+    io_timeout_s: float = 30.0
+    #: bounded depth of the async sender's in-flight queue (2 = double
+    #: buffering: bundle N on the wire while N+1 serializes)
+    sender_depth: int = 2
+
+    def validate(self) -> None:
+        if self.connect_retries < 1:
+            raise ValueError("transport.connect_retries must be >= 1")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("transport backoff seconds must be >= 0")
+        if self.backoff_jitter < 0:
+            raise ValueError("transport.backoff_jitter must be >= 0")
+        if self.io_timeout_s <= 0:
+            raise ValueError("transport.io_timeout_s must be > 0")
+        if self.sender_depth < 1:
+            raise ValueError("transport.sender_depth must be >= 1")
+
+
+@dataclasses.dataclass
+class AutoscaleConfig(ConfigModel):
+    """Elastic fleet sizing (``serving/autoscale.py``): grow/shrink the
+    replica count from queue-depth / TTFT-violation signals, reusing
+    the drain/evacuation machinery so scale-down never drops a stream."""
+
+    enabled: bool = False
+    #: replica-count bounds the policy may move between
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: grow when fleet queue depth per accepting replica exceeds this
+    #: for ``grow_streak`` consecutive pump evaluations
+    grow_queue_per_replica: float = 4.0
+    grow_streak: int = 2
+    #: grow when NEW TTFT deadline violations appeared since the last
+    #: evaluation (0 disables the TTFT rule)
+    grow_on_ttft_violations: bool = True
+    #: shrink when the fleet has been under this queue-depth-per-replica
+    #: for ``shrink_streak`` consecutive evaluations AND the candidate
+    #: replica is idle enough to evacuate cheaply
+    shrink_queue_per_replica: float = 0.5
+    shrink_streak: int = 8
+    #: pump evaluations to wait after ANY scale action before the next
+    #: (hysteresis — a fresh replica needs time to absorb load)
+    cooldown_pumps: int = 8
+
+    def validate(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError("autoscale.min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("autoscale.max_replicas must be >= "
+                             "min_replicas")
+        if self.grow_queue_per_replica <= 0:
+            raise ValueError("autoscale.grow_queue_per_replica must be > 0")
+        if self.shrink_queue_per_replica < 0:
+            raise ValueError("autoscale.shrink_queue_per_replica must "
+                             "be >= 0")
+        if self.shrink_queue_per_replica >= self.grow_queue_per_replica:
+            raise ValueError("autoscale.shrink_queue_per_replica must be "
+                             "< grow_queue_per_replica (hysteresis band)")
+        if self.grow_streak < 1 or self.shrink_streak < 1:
+            raise ValueError("autoscale grow/shrink streaks must be >= 1")
+        if self.cooldown_pumps < 0:
+            raise ValueError("autoscale.cooldown_pumps must be >= 0")
 
 
 @dataclasses.dataclass
@@ -144,6 +241,36 @@ class ServingConfig(ConfigModel):
     breaker_cooldown_pumps: int = 8
     breaker_probe_steps: int = 4
 
+    # -- live decode rebalancing (router._rebalance_decode) -----------------
+    #: migrate DECODE load off hot replicas continuously (today's router
+    #: only places NEW work; this moves RUNNING streams).  Bit-identity
+    #: is the migration contract, so a moved stream is indistinguishable
+    #: from one that stayed.
+    rebalance_enabled: bool = False
+    #: act when the hottest replica's load exceeds the coolest accepting
+    #: peer's by MORE than this many requests
+    rebalance_load_gap: int = 4
+    #: also act when a replica's rolling p50 step latency exceeds this
+    #: factor x the fleet median of its peers (keep BELOW
+    #: breaker_latency_factor so rebalancing relieves a warm replica
+    #: before the breaker declares it failed)
+    rebalance_p50_factor: float = 2.0
+    #: sequences moved per router pump (small: each migration steals a
+    #: step slot from the destination)
+    rebalance_max_per_pump: int = 2
+    #: deadline awareness: a sequence with less than this many seconds
+    #: of deadline budget left is never migrated (the move itself costs
+    #: time the stream doesn't have)
+    rebalance_min_deadline_s: float = 0.5
+
+    #: elastic replica scaling policy (serving/autoscale.py); None =
+    #: fixed fleet size
+    autoscale: Optional[AutoscaleConfig] = None
+    #: cross-process KV transport knobs (serving/transport.py); None =
+    #: defaults (the transport is only exercised by cross-process
+    #: replicas — single-process fleets never open a socket)
+    transport: Optional[TransportConfig] = None
+
     def validate(self) -> None:
         if isinstance(self.speculative, dict):
             # Optional[...] coercion swallows nested validation errors
@@ -157,6 +284,15 @@ class ServingConfig(ConfigModel):
             self.kv_tier = KVTierConfig.from_dict(self.kv_tier)
         if self.kv_tier is not None:
             self.kv_tier.validate()
+        if isinstance(self.autoscale, dict):
+            # same Optional[...] coercion hazard: fail HERE, loudly
+            self.autoscale = AutoscaleConfig.from_dict(self.autoscale)
+        if self.autoscale is not None:
+            self.autoscale.validate()
+        if isinstance(self.transport, dict):
+            self.transport = TransportConfig.from_dict(self.transport)
+        if self.transport is not None:
+            self.transport.validate()
         if self.decode_horizon is not None and self.decode_horizon < 1:
             raise ValueError("serving.decode_horizon must be >= 1 "
                              "(1 = the classic one-step decode loop)")
@@ -199,6 +335,22 @@ class ServingConfig(ConfigModel):
         if self.breaker_cooldown_pumps < 1 or self.breaker_probe_steps < 1:
             raise ValueError("serving.breaker_cooldown_pumps and "
                              "breaker_probe_steps must be >= 1")
+        if self.rebalance_load_gap < 1:
+            raise ValueError("serving.rebalance_load_gap must be >= 1")
+        if self.rebalance_p50_factor <= 1.0:
+            raise ValueError("serving.rebalance_p50_factor must be > 1")
+        if self.rebalance_enabled and self.breaker_enabled and (
+                self.rebalance_p50_factor >= self.breaker_latency_factor):
+            raise ValueError(
+                "serving.rebalance_p50_factor must be < "
+                "breaker_latency_factor (rebalance relieves a warm "
+                "replica BEFORE the breaker declares it failed)")
+        if self.rebalance_max_per_pump < 1:
+            raise ValueError("serving.rebalance_max_per_pump must be >= 1")
+        if self.rebalance_min_deadline_s < 0:
+            raise ValueError("serving.rebalance_min_deadline_s must "
+                             "be >= 0")
 
 
-__all__ = ["ServingConfig", "KVTierConfig"]
+__all__ = ["ServingConfig", "KVTierConfig", "AutoscaleConfig",
+           "TransportConfig"]

@@ -27,7 +27,7 @@ import io
 import json
 import time
 import zlib
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -71,6 +71,25 @@ def migrate_sequence(src_engine: Any, dst_engine: Any, uid: int) -> int:
     return bundle.n_pages
 
 
+def rebase_deadline_left(left: Any, sent_unix: Any) -> Optional[float]:
+    """THE transit clamp: wall time elapsed since the ``sent_unix``
+    stamp CONSUMES the remaining deadline budget, and skew-negative
+    elapsed (receiver clock behind the sender's) clamps to zero so a
+    backwards clock never *grants* budget.  One rule for every path a
+    bundle can sit outside an engine — the cross-process wire
+    (:func:`bundle_from_bytes`) and the NVMe/host tier's spilled-bundle
+    restore (``kv_tier.NVMeKVTier.restore_bundle``) both re-base
+    through here: a page that sat spilled gets no free deadline."""
+    if left is None:
+        return None
+    if sent_unix is not None:
+        # dstpu-lint: allow[wall-clock] transit vs the sender's wall-clock
+        # stamp; clamped non-negative so skew never grants budget back
+        transit = max(0.0, time.time() - float(sent_unix))
+        left = max(0.0, float(left) - transit)
+    return float(left)
+
+
 def _dtype_name(arr: np.ndarray) -> str:
     return arr.dtype.name  # "bfloat16" round-trips through ml_dtypes
 
@@ -109,73 +128,37 @@ def page_crcs(arrays: Dict[str, np.ndarray],
     return [c & 0xFFFFFFFF for c in crcs]
 
 
-def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
-    """Serialize a bundle for cross-process transport: magic, a json
-    header (metadata + per-leaf shape/dtype + per-page CRC32s, page
-    keys hex-encoded), then each leaf's raw C-order bytes in header
-    order.  The absolute in-process ``deadline`` is re-based to
-    seconds-left (``deadline_left_s``) — perf_counter clocks don't
-    survive a process boundary."""
-    leaves = sorted(bundle.arrays)
-    header = {
-        "uid": bundle.uid, "tokens": list(map(int, bundle.tokens)),
-        "prompt_len": bundle.prompt_len,
-        "max_new_tokens": bundle.max_new_tokens,
-        "temperature": bundle.temperature, "eos_id": bundle.eos_id,
-        "prefilled": bundle.prefilled, "decode_entry": bundle.decode_entry,
-        "page_size": bundle.page_size,
-        "priority": bundle.priority,
-        "deadline_left_s": (max(0.0, bundle.deadline - time.perf_counter())
-                            if bundle.deadline else None),
-        # wall-clock send stamp: transit time must CONSUME the deadline
-        # budget (best-effort across hosts — skew-negative elapsed is
-        # clamped to 0, never granting budget back)
-        # dstpu-lint: allow[wall-clock] cross-host wire timestamp; monotonic
-        # clocks do not compare across machines (see comment above)
-        "sent_unix": time.time(),
-        "page_keys": [k.hex() if isinstance(k, bytes) else k
-                      for k in bundle.page_keys],
-        "src_pages": [{"page": m["page"], "refcount": m["refcount"],
-                       "key": (m["key"].hex()
-                               if isinstance(m.get("key"), bytes) else None)}
-                      for m in bundle.src_pages],
-        "model_sig": list(bundle.model_sig), "kv_quant": bundle.kv_quant,
-        "dtype": bundle.dtype,
-        "leaves": [{"name": n, "shape": list(bundle.arrays[n].shape),
-                    "dtype": _dtype_name(bundle.arrays[n])}
-                   for n in leaves],
-        "page_crcs": page_crcs(bundle.arrays, leaves),
-    }
-    if bundle.trace is not None:
-        # optional trace-context block (fleet request tracing): the
-        # router-minted trace_id, a clock-free ledger snapshot, and the
-        # per-hop send stamps.  OPTIONAL by construction — absent on
-        # legacy bundles, and its absence never fails an import.
-        trace = dict(bundle.trace)
-        # dstpu-lint: allow[wall-clock] per-hop wire timestamp; transit
-        # is measured sender-wall vs receiver-wall (same contract as
-        # sent_unix above — monotonic clocks don't cross machines)
-        hop = {"sent_unix": time.time()}
-        trace["hops"] = list(trace.get("hops") or []) + [hop]
-        header["trace"] = trace
-        header["trace_crc"] = _trace_crc(trace)
+def pages_to_bytes(arrays: Dict[str, np.ndarray],
+                   meta: Optional[Dict[str, Any]] = None) -> bytes:
+    """THE DSTPUKV2 page-record serialization: magic, a json header
+    (``meta`` + per-leaf shape/dtype + per-page CRC32s), then each
+    leaf's raw C-order bytes in sorted-leaf order.  The record layer
+    shared by the wire format (:func:`bundle_to_bytes` rides on it) and
+    the NVMe tier's on-disk page files (``kv_tier.NVMeKVTier``) — one
+    layout, one checksum rule, everywhere pages leave the process."""
+    leaves = sorted(arrays)
+    header = dict(meta or {})
+    header["leaves"] = [{"name": n, "shape": list(arrays[n].shape),
+                         "dtype": _dtype_name(arrays[n])} for n in leaves]
+    header["page_crcs"] = page_crcs(arrays, leaves)
     buf = io.BytesIO()
     hdr = json.dumps(header).encode()
     buf.write(_MAGIC)
     buf.write(len(hdr).to_bytes(8, "little"))
     buf.write(hdr)
     for n in leaves:
-        buf.write(np.ascontiguousarray(bundle.arrays[n]).tobytes())
+        buf.write(np.ascontiguousarray(arrays[n]).tobytes())
     return buf.getvalue()
 
 
-def bundle_from_bytes(data: bytes) -> KVPageBundle:
-    """Inverse of :func:`bundle_to_bytes` (bit-identical arrays).
+def pages_from_bytes(data: bytes
+                     ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Inverse of :func:`pages_to_bytes` (bit-identical arrays).
 
-    Integrity is verified BEFORE anything is adopted: bad magic, an
-    old/unknown wire version, a truncated payload, or a per-page CRC32
-    mismatch raises :class:`CorruptBundleError` — a refused import
-    loses nothing (the exporting engine still holds the pages)."""
+    Integrity first: bad magic, a retired wire version, truncation, or
+    a per-page CRC32 mismatch raises :class:`CorruptBundleError` naming
+    the page — refusal loses nothing, the source record/engine still
+    holds the pages."""
     if data[:len(_MAGIC)] in _OLD_MAGICS:
         raise CorruptBundleError(
             f"serialized KVPageBundle uses retired wire version "
@@ -209,7 +192,7 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
             data[off:off + n], dtype=dt).reshape(leaf["shape"]).copy()
         off += n
     if off != len(data):
-        logger.warning(f"bundle_from_bytes: {len(data) - off} trailing "
+        logger.warning(f"pages_from_bytes: {len(data) - off} trailing "
                        "bytes ignored")
     leaves = sorted(arrays)
     want = list(header.get("page_crcs", []))
@@ -224,6 +207,65 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
             f"corrupt bundle: CRC32 mismatch on page(s) {bad} of "
             f"{len(got)} (bit flip or torn write in transport) — "
             "refused; source still holds the sequence")
+    return arrays, header
+
+
+def bundle_to_bytes(bundle: KVPageBundle) -> bytes:
+    """Serialize a bundle for cross-process transport: magic, a json
+    header (metadata + per-leaf shape/dtype + per-page CRC32s, page
+    keys hex-encoded), then each leaf's raw C-order bytes in header
+    order.  The absolute in-process ``deadline`` is re-based to
+    seconds-left (``deadline_left_s``) — perf_counter clocks don't
+    survive a process boundary."""
+    header = {
+        "uid": bundle.uid, "tokens": list(map(int, bundle.tokens)),
+        "prompt_len": bundle.prompt_len,
+        "max_new_tokens": bundle.max_new_tokens,
+        "temperature": bundle.temperature, "eos_id": bundle.eos_id,
+        "prefilled": bundle.prefilled, "decode_entry": bundle.decode_entry,
+        "page_size": bundle.page_size,
+        "priority": bundle.priority,
+        "deadline_left_s": (max(0.0, bundle.deadline - time.perf_counter())
+                            if bundle.deadline else None),
+        # wall-clock send stamp: transit time must CONSUME the deadline
+        # budget (best-effort across hosts — skew-negative elapsed is
+        # clamped to 0, never granting budget back)
+        # dstpu-lint: allow[wall-clock] cross-host wire timestamp; monotonic
+        # clocks do not compare across machines (see comment above)
+        "sent_unix": time.time(),
+        "page_keys": [k.hex() if isinstance(k, bytes) else k
+                      for k in bundle.page_keys],
+        "src_pages": [{"page": m["page"], "refcount": m["refcount"],
+                       "key": (m["key"].hex()
+                               if isinstance(m.get("key"), bytes) else None)}
+                      for m in bundle.src_pages],
+        "model_sig": list(bundle.model_sig), "kv_quant": bundle.kv_quant,
+        "dtype": bundle.dtype,
+    }
+    if bundle.trace is not None:
+        # optional trace-context block (fleet request tracing): the
+        # router-minted trace_id, a clock-free ledger snapshot, and the
+        # per-hop send stamps.  OPTIONAL by construction — absent on
+        # legacy bundles, and its absence never fails an import.
+        trace = dict(bundle.trace)
+        # dstpu-lint: allow[wall-clock] per-hop wire timestamp; transit
+        # is measured sender-wall vs receiver-wall (same contract as
+        # sent_unix above — monotonic clocks don't cross machines)
+        hop = {"sent_unix": time.time()}
+        trace["hops"] = list(trace.get("hops") or []) + [hop]
+        header["trace"] = trace
+        header["trace_crc"] = _trace_crc(trace)
+    return pages_to_bytes(bundle.arrays, header)
+
+
+def bundle_from_bytes(data: bytes) -> KVPageBundle:
+    """Inverse of :func:`bundle_to_bytes` (bit-identical arrays).
+
+    Integrity is verified BEFORE anything is adopted: bad magic, an
+    old/unknown wire version, a truncated payload, or a per-page CRC32
+    mismatch raises :class:`CorruptBundleError` — a refused import
+    loses nothing (the exporting engine still holds the pages)."""
+    arrays, header = pages_from_bytes(data)
     trace = None
     if "trace" in header:
         trace = header["trace"]
@@ -242,12 +284,8 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
             hops[-1]["recv_unix"] = now_unix
             trace["transit_s"] = max(
                 0.0, now_unix - float(hops[-1]["sent_unix"]))
-    left = header.get("deadline_left_s")
-    if left is not None and header.get("sent_unix") is not None:
-        # dstpu-lint: allow[wall-clock] transit vs the sender's wall-clock
-        # stamp; clamped non-negative so skew never grants budget back
-        transit = max(0.0, time.time() - float(header["sent_unix"]))
-        left = max(0.0, float(left) - transit)
+    left = rebase_deadline_left(header.get("deadline_left_s"),
+                                header.get("sent_unix"))
     return KVPageBundle(
         uid=header["uid"], tokens=list(header["tokens"]),
         prompt_len=header["prompt_len"],
@@ -270,5 +308,6 @@ def bundle_from_bytes(data: bytes) -> KVPageBundle:
 
 
 __all__ = ["migrate_sequence", "bundle_to_bytes", "bundle_from_bytes",
-           "CorruptBundleError"]
+           "pages_to_bytes", "pages_from_bytes", "page_crcs",
+           "rebase_deadline_left", "CorruptBundleError"]
 

@@ -230,6 +230,19 @@ class FleetRouter:
         self._m_breaker_recover = reg.counter(
             "deepspeed_tpu_serving_slo_breaker_recoveries_total",
             "breakers closed again after a healthy half-open probe")
+        self._m_rebalanced = reg.counter(
+            "deepspeed_tpu_serving_fleet_rebalanced_total",
+            "running decode streams migrated off hot replicas by live "
+            "rebalancing (placement fixed AFTER admission, "
+            "bit-identically)")
+        self._m_rebalance_skipped = reg.counter(
+            "deepspeed_tpu_serving_fleet_rebalance_skipped_deadline_total",
+            "rebalance candidates left in place because their remaining "
+            "deadline budget was below rebalance_min_deadline_s (the "
+            "move itself costs time the stream does not have)")
+        self._m_replicas_added = reg.counter(
+            "deepspeed_tpu_serving_fleet_replicas_added_total",
+            "replicas added to a running fleet (elastic scale-up)")
 
     def _publish(self) -> None:
         self._m_live.set(sum(1 for r in self.replicas.values()
@@ -551,6 +564,72 @@ class FleetRouter:
             for uid in list(r.engine.ready_uids()):
                 self._try_migrate(uid, r)
 
+    # -- live decode rebalancing ---------------------------------------------
+    def _hot_decode_replica(self, cands: List[EngineReplica]
+                            ) -> Optional[EngineReplica]:
+        """The replica rebalancing should relieve this pump, or None.
+        Two signals, either suffices: **occupancy** — its load exceeds
+        the coolest accepting peer's by more than
+        ``rebalance_load_gap`` — or **latency** — its rolling p50
+        exceeds ``rebalance_p50_factor`` x the median of its peers
+        (the breaker's gray-failure signal at a LOWER threshold:
+        rebalancing relieves a warm replica before the breaker
+        declares it failed and recomputes everything)."""
+        cfg = self.config
+        by_load = sorted(cands, key=lambda r: (r.load(), r.name))
+        hot = by_load[-1]
+        if hot.load() - by_load[0].load() > cfg.rebalance_load_gap:
+            return hot
+        for r in sorted(cands, key=lambda x: -x.step_p50()):
+            if r.lat_samples < cfg.breaker_min_samples:
+                continue
+            others = [o.step_p50() for o in cands if o is not r
+                      and o.breaker != BREAKER_OPEN
+                      and o.lat_samples >= cfg.breaker_min_samples]
+            if not others:
+                continue
+            floor = max(statistics.median(others),
+                        cfg.breaker_min_latency_s)
+            if r.step_p50() > cfg.rebalance_p50_factor * floor:
+                return r
+        return None
+
+    def _rebalance_decode(self) -> None:
+        """Migrate RUNNING decode streams off a hot replica (the router
+        historically only placed NEW work; this fixes placement after
+        admission).  Bounded per pump, deadline-budget-aware (a stream
+        with almost no budget left is never moved — the move costs
+        time it doesn't have), and bit-identical by the migration
+        contract: a moved stream is indistinguishable from one that
+        stayed."""
+        cfg = self.config
+        cands = [r for r in self.replicas.values()
+                 if r.alive and not r.retired
+                 and r.role in (ROLE_DECODE, ROLE_MIXED)]
+        if len(cands) < 2 or not any(r.accepts_new() for r in cands):
+            return
+        hot = self._hot_decode_replica(cands)
+        if hot is None or not self._decode_targets(hot):
+            return
+        moved = 0
+        for uid in list(hot.engine.ready_uids()):
+            if moved >= cfg.rebalance_max_per_pump:
+                break
+            rec = self._requests.get(uid)
+            left = rec.deadline_left() if rec is not None else None
+            if left is not None and left < cfg.rebalance_min_deadline_s:
+                self._m_rebalance_skipped.inc()
+                continue
+            if self._try_migrate(uid, hot):
+                moved += 1
+        if moved:
+            self._m_rebalanced.inc(moved)
+            record_event("fleet_rebalance", cat="serve", src=hot.name,
+                         moved=moved, src_load=hot.load(),
+                         src_p50_s=round(hot.step_p50(), 6))
+            logger.info(f"fleet: rebalanced {moved} decode stream(s) "
+                        f"off {hot.name}")
+
     # -- circuit breakers ----------------------------------------------------
     def _check_breakers(self) -> None:
         """Advance every live replica's breaker one pump.  The fleet
@@ -631,6 +710,8 @@ class FleetRouter:
         self._check_breakers()
         if self.config.disaggregated:
             self._pump_migrations()
+        if self.config.rebalance_enabled:
+            self._rebalance_decode()
         out: Dict[int, Dict[str, Any]] = {}
         for r in self.replicas.values():
             if not (r.alive and not r.retired):
@@ -683,6 +764,29 @@ class FleetRouter:
     def kill_replica(self, name: str) -> None:
         """Chaos hook: unannounced death; next ``step()`` re-dispatches."""
         self.replicas[name].kill()
+
+    def add_replica(self, replica: EngineReplica) -> None:
+        """Join a new replica to a RUNNING fleet (elastic scale-up, or
+        a cross-process replica over a :class:`~.transport.
+        RemoteEngineProxy`).  Same invariants as construction: unique
+        name, identical page geometry — KV migration needs one
+        geometry, and a remote engine advertises its page size at the
+        transport handshake precisely so this check works unchanged."""
+        if replica.name in self.replicas:
+            raise ValueError(f"replica name {replica.name!r} already in "
+                             "the fleet")
+        if replica.engine.block.page_size != self._page_size:
+            raise ValueError(
+                f"replica {replica.name!r} page_size "
+                f"{replica.engine.block.page_size} != fleet page_size "
+                f"{self._page_size} — KV migration needs one geometry")
+        self.replicas[replica.name] = replica
+        self._m_replicas_added.inc()
+        record_event("fleet_scale_up", cat="serve", replica=replica.name,
+                     role=replica.role, fleet_size=len(self.replicas))
+        logger.info(f"fleet: replica {replica.name} joined "
+                    f"(role={replica.role}, fleet={len(self.replicas)})")
+        self._publish()
 
     def retire_replica(self, name: str, migrate: bool = True) -> None:
         """Planned retirement.  ``migrate=True`` evacuates (KV migration

@@ -200,6 +200,157 @@ def test_prefix_match_consults_host_tier():
     assert pc.match(tokens) == ([p0], [keys[0]])
 
 
+# ----------------------------- fast: NVMe third tier ------------------------
+def _nvme_cfg(tmp_path, nvme_bytes=16 << 30, host_bytes=1 << 30):
+    return KVTierConfig(enabled=True, host_bytes=host_bytes,
+                        nvme_enabled=True, nvme_dir=str(tmp_path),
+                        nvme_bytes=nvme_bytes)
+
+
+def test_nvme_lru_budget_eviction_and_bit_identical_promote(tmp_path):
+    from deepspeed_tpu.serving.kv_tier import NVMeKVTier
+
+    # measure one record's on-disk size, then budget for exactly three
+    tier = NVMeKVTier(_nvme_cfg(tmp_path))
+    assert tier.put(b"k0", _page(0))
+    rec = tier.nvme_bytes
+    tier.pop(b"k0")
+    # (records differ by a few header bytes — CRC digit counts — so
+    # budget three records with slack, not an exact multiple)
+    tier = NVMeKVTier(_nvme_cfg(tmp_path, nvme_bytes=3 * rec + 64))
+    for i in range(3):
+        assert tier.put(f"k{i}".encode(), _page(i))
+    assert tier.nvme_pages == 3
+    assert tier.put(b"k3", _page(3))  # over budget: k0 unlinked
+    assert tier.nvme_pages == 3 and not tier.has(b"k0")
+    assert tier.evicted_pages == 1
+    # files on disk are exactly the LRU's view, DSTPUKV2 records
+    files = [f for f in __import__("os").listdir(tier.dir)
+             if f.endswith(".kvpage")]
+    assert len(files) == 3
+    # promote is bit-identical and refreshes recency
+    got = tier.get(b"k1")
+    assert got is not None and np.array_equal(got["k"], _page(1)["k"])
+    assert got["k"].dtype == np.float32
+    tier.put(b"k4", _page(4))  # k2 (not the refreshed k1) goes
+    assert tier.has(b"k1") and not tier.has(b"k2")
+    # a miss is counted; pop drops the entry AND the file
+    assert tier.get(b"nope") is None and tier.misses == 1
+    tier.pop(b"k1")
+    assert not tier.has(b"k1")
+
+
+def test_nvme_corrupt_file_refused_loudly_and_unlinked(tmp_path):
+    import os
+
+    from deepspeed_tpu.serving.kv_tier import NVMeKVTier
+
+    tier = NVMeKVTier(_nvme_cfg(tmp_path))
+    assert tier.put(b"\x05" * 8, _page(5))
+    path, _nb = tier._lru[b"\x05" * 8]
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0xFF  # bit-flip in the leaf bytes
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    assert tier.get(b"\x05" * 8) is None  # refused, not wrong data
+    assert tier.corrupt_pages == 1 and not os.path.exists(path)
+    assert not tier.has(b"\x05" * 8)  # dropped: the walk recomputes
+    # truncated file (torn write that dodged the atomic rename) too
+    assert tier.put(b"\x06" * 8, _page(6))
+    path, _nb = tier._lru[b"\x06" * 8]
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    assert tier.get(b"\x06" * 8) is None
+    assert tier.corrupt_pages == 2
+
+
+def test_host_tier_demotes_to_nvme_and_promotes_back(tmp_path):
+    """The integration contract: host-LRU eviction demotes to a file
+    instead of dropping; a host miss consults the files and promotes
+    the page back up-tier, bit-identical, moving ownership."""
+    tier = HostKVTier(_nvme_cfg(tmp_path, host_bytes=3 * 256))
+    for i in range(3):
+        assert _put(tier, f"k{i}".encode(), i)
+    _put(tier, b"k3", 3)  # host over budget: k0 demotes to NVMe
+    assert tier.host_evictions == 1
+    assert tier.nvme.nvme_pages == 1 and tier.nvme.has(b"k0")
+    assert tier.has(b"k0")  # membership spans both tiers
+    got = tier.get(b"k0")  # host miss -> file read -> promote
+    assert got is not None and np.array_equal(got["k"], _page(0)["k"])
+    assert tier.nvme.restored_pages == 1
+    assert not tier.nvme.has(b"k0")  # ownership moved up-tier
+    assert b"k0" in tier._lru  # ...and the promote itself demoted the
+    assert tier.nvme.has(b"k1")  # then-oldest host page, never k0
+    st = tier.stats()
+    assert st["nvme_spilled_pages"] == 2 and st["nvme_restored_pages"] == 1
+    assert st["nvme_hit_rate"] == 1.0
+
+
+def test_nvme_bundle_spill_restore_rebases_deadline(tmp_path):
+    """Satellite fix: a restored bundle's ``deadline_left_s`` passes
+    through the SAME transit clamp as the wire import — time spent
+    spilled consumes the budget, and skew-negative transit (a restore
+    clock behind the spill clock) clamps to zero consumption rather
+    than GRANTING deadline."""
+    import json
+    import time
+
+    from deepspeed_tpu.inference.v2 import KVPageBundle
+    from deepspeed_tpu.serving.kv_tier import NVMeKVTier
+    from deepspeed_tpu.serving.kv_transfer import (_MAGIC,
+                                                   rebase_deadline_left)
+
+    tier = NVMeKVTier(_nvme_cfg(tmp_path))
+    arrays = {"k": np.arange(32, dtype=np.float32).reshape(1, 1, 8, 2, 2)}
+    b = KVPageBundle(uid=9, tokens=list(range(10)), prompt_len=9,
+                     max_new_tokens=4, temperature=0.0, eos_id=None,
+                     prefilled=9, decode_entry=False, page_size=8,
+                     page_keys=[b"\x09" * 32],
+                     src_pages=[{"page": 1, "refcount": 1, "key": None}],
+                     arrays=arrays, model_sig=(1, 2, 2), kv_quant=False,
+                     dtype="fp32", deadline=time.perf_counter() + 10.0)
+    path = tier.spill_bundle(b)
+    # doctor the spilled record's sent_unix to simulate 4s on disk
+    raw = open(path, "rb").read()
+    hlen = int.from_bytes(raw[len(_MAGIC):len(_MAGIC) + 8], "little")
+    hdr = json.loads(raw[len(_MAGIC) + 8:len(_MAGIC) + 8 + hlen].decode())
+    assert 9.5 < hdr["deadline_left_s"] <= 10.0
+    hdr["sent_unix"] = time.time() - 4.0
+    enc = json.dumps(hdr).encode()
+    with open(path, "wb") as f:
+        f.write(_MAGIC + len(enc).to_bytes(8, "little") + enc
+                + raw[len(_MAGIC) + 8 + hlen:])
+    rt = tier.restore_bundle(path)
+    left = rt.deadline - time.perf_counter()
+    assert 5.0 < left < 6.5  # ~10s budget minus ~4s spilled
+    assert np.array_equal(rt.arrays["k"], arrays["k"])  # bit identical
+    # REGRESSION (skew-negative): sent_unix in the FUTURE must clamp
+    # transit to zero — never increase the budget
+    hdr["sent_unix"] = time.time() + 3600.0
+    enc = json.dumps(hdr).encode()
+    with open(path, "wb") as f:
+        f.write(_MAGIC + len(enc).to_bytes(8, "little") + enc
+                + raw[len(_MAGIC) + 8 + hlen:])
+    rt = tier.restore_bundle(path)
+    assert rt.deadline - time.perf_counter() <= 10.01
+    # and the clamp itself floors at zero, never negative
+    assert rebase_deadline_left(1.0, time.time() - 50.0) == 0.0
+    assert rebase_deadline_left(5.0, time.time() + 50.0) == 5.0
+    assert rebase_deadline_left(None, time.time()) is None
+
+
+def test_nvme_config_validation():
+    with pytest.raises(ValueError):
+        ServingConfig(kv_tier=KVTierConfig(
+            enabled=True, nvme_enabled=True, nvme_bytes=-1)).validate()
+    # dict-coercion carries the nvme knobs through
+    sc = ServingConfig(kv_tier={"enabled": True, "nvme_enabled": True,
+                                "nvme_bytes": 1 << 20})
+    sc.validate()
+    assert sc.kv_tier.nvme_bytes == 1 << 20
+
+
 # ----------------------------- slow: engine oracles -------------------------
 def _tiny(max_seq_len=128):
     import jax

@@ -273,6 +273,239 @@ def test_bundle_wire_torn_trace_block_refused_by_name():
     assert bundle_from_bytes(ok).trace is None
 
 
+# ------------------- fast: rebalance / elastic membership -------------------
+class _StubEngine:
+    """Pure-python engine for routing-policy tests: holds decode-ready
+    uids, moves them via the real migrate_sequence plumbing."""
+
+    def __init__(self, uids=(), queue=0):
+        from types import SimpleNamespace as NS
+
+        self.block = NS(page_size=8)
+        self.allocator = NS(free_pages=32, num_pages=64)
+        self.queue_depth = queue
+        self.uids = list(uids)
+        self.imported = []
+        self.released = []
+        self.trace_owner = None
+
+    @property
+    def active_count(self):
+        return len(self.uids)
+
+    def has_work(self):
+        return bool(self.uids) or self.queue_depth > 0
+
+    def ready_uids(self):
+        return list(self.uids)
+
+    def export_sequence(self, uid):
+        return SimpleNamespace(uid=uid, n_pages=2, trace=None)
+
+    def import_sequence(self, bundle):
+        self.uids.append(bundle.uid)
+        self.imported.append(bundle.uid)
+        return True
+
+    def release_sequence(self, uid, reason=""):
+        self.uids.remove(uid)
+        self.released.append(uid)
+
+    def abort_all(self, reason="abort"):
+        out, self.uids = list(self.uids), []
+        return out
+
+
+def _stub_fleet(*engines, config=None, role=None):
+    from deepspeed_tpu.serving.replica import ROLE_MIXED, EngineReplica
+    from deepspeed_tpu.serving.router import FleetRouter
+
+    reps = [EngineReplica(f"s{i}", e, role=role or ROLE_MIXED)
+            for i, e in enumerate(engines)]
+    return FleetRouter(reps, config or ServingConfig())
+
+
+def test_rebalance_moves_bounded_load_off_hot_replica():
+    cfg = ServingConfig(rebalance_enabled=True, rebalance_load_gap=4,
+                        rebalance_max_per_pump=2)
+    hot, cold = _StubEngine(uids=[1, 2, 3, 4, 5, 6]), _StubEngine()
+    router = _stub_fleet(hot, cold, config=cfg)
+    router._rebalance_decode()
+    # bounded per pump, routed through the real migration plumbing
+    assert cold.imported == [1, 2] and hot.released == [1, 2]
+    assert sorted(hot.uids) == [3, 4, 5, 6]
+    # gap now 4, NOT > rebalance_load_gap: hysteresis holds, no move
+    router._rebalance_decode()
+    assert cold.imported == [1, 2]
+
+
+def test_rebalance_skips_deadline_starved_streams():
+    from deepspeed_tpu.serving.router import _RequestRecord
+
+    cfg = ServingConfig(rebalance_enabled=True, rebalance_load_gap=2,
+                        rebalance_max_per_pump=8,
+                        rebalance_min_deadline_s=0.5)
+    hot, cold = _StubEngine(uids=[1, 2, 3, 4]), _StubEngine()
+    router = _stub_fleet(hot, cold, config=cfg)
+    # uid 2 has ~no deadline budget left: the move costs time it
+    # doesn't have — it must stay put while the others go
+    starved = RaggedRequest(prompt_ids=[1], uid=2, deadline_s=1e-9)
+    router._requests[2] = _RequestRecord(starved)
+    router._rebalance_decode()
+    assert 2 in hot.uids and 2 not in cold.imported
+    assert sorted(cold.imported) == [1, 3, 4]
+
+
+def test_rebalance_p50_signal_spots_warm_replica():
+    """The latency rule relieves a warm (gray-degrading) replica at a
+    LOWER threshold than the breaker declares it failed."""
+    cfg = ServingConfig(rebalance_enabled=True, rebalance_p50_factor=2.0,
+                        breaker_enabled=True)
+    eng = [_StubEngine(uids=[1]), _StubEngine(uids=[2]),
+           _StubEngine(uids=[3])]
+    router = _stub_fleet(*eng, config=cfg)
+    reps = list(router.replicas.values())
+    need = cfg.breaker_min_samples
+    for r in reps:  # equal load; only latency distinguishes them
+        for _ in range(need):
+            r._record_step(0.01, error=False)
+    assert router._hot_decode_replica(reps) is None  # healthy: no pick
+    # a WARM replica is slow on every step: the rolling MEDIAN moves
+    for _ in range(2 * need + 1):
+        reps[1]._record_step(10 * cfg.breaker_min_latency_s, error=False)
+    assert router._hot_decode_replica(reps) is reps[1]
+
+
+def test_add_replica_checks_name_and_geometry():
+    router = _stub_fleet(_StubEngine())
+    from deepspeed_tpu.serving.replica import EngineReplica
+
+    router.add_replica(EngineReplica("joined", _StubEngine()))
+    assert set(router.replicas) == {"s0", "joined"}
+    with pytest.raises(ValueError, match="already in"):
+        router.add_replica(EngineReplica("joined", _StubEngine()))
+    wrong = _StubEngine()
+    wrong.block.page_size = 16
+    with pytest.raises(ValueError, match="one geometry"):
+        router.add_replica(EngineReplica("odd", wrong))
+
+
+def test_rebalance_config_validation():
+    with pytest.raises(ValueError):
+        ServingConfig(rebalance_enabled=True,
+                      rebalance_max_per_pump=0).validate()
+    # rebalance must fire BELOW the breaker's latency threshold, or the
+    # breaker recomputes everything before rebalancing ever helps
+    with pytest.raises(ValueError, match="breaker_latency_factor"):
+        ServingConfig(rebalance_enabled=True, breaker_enabled=True,
+                      rebalance_p50_factor=50.0).validate()
+
+
+# ----------------------------- fast: autoscaler -----------------------------
+def _autoscaler(router, spawn=None, **kw):
+    from deepspeed_tpu.serving import AutoscaleConfig
+    from deepspeed_tpu.serving.autoscale import FleetAutoscaler
+
+    kw.setdefault("enabled", True)
+    return FleetAutoscaler(router, AutoscaleConfig(**kw),
+                           spawn_replica=spawn)
+
+
+def test_autoscaler_grows_on_sustained_queue_pressure():
+    from deepspeed_tpu.serving.replica import EngineReplica
+
+    router = _stub_fleet(_StubEngine(queue=9))
+    spawned = []
+
+    def spawn(i):
+        spawned.append(i)
+        return EngineReplica(f"auto{i}", _StubEngine())
+
+    a = _autoscaler(router, spawn, grow_queue_per_replica=4.0,
+                    grow_streak=2, grow_on_ttft_violations=False,
+                    max_replicas=2, cooldown_pumps=3)
+    assert a.evaluate() is None  # streak 1: pressure must SUSTAIN
+    assert a.evaluate() == "grow"
+    assert spawned == [0] and "auto0" in router.replicas
+    assert a.grown == ["auto0"]
+    # cooldown: the fresh replica absorbs load before signals re-arm;
+    # then max_replicas caps growth even under pressure
+    for _ in range(10):
+        a.evaluate()
+    assert len(router.replicas) == 2
+
+
+def test_autoscaler_grows_on_new_ttft_violations():
+    from deepspeed_tpu.serving.replica import EngineReplica
+    from deepspeed_tpu.telemetry import get_registry
+
+    router = _stub_fleet(_StubEngine(queue=1))
+    a = _autoscaler(router,
+                    lambda i: EngineReplica(f"auto{i}", _StubEngine()),
+                    grow_queue_per_replica=100.0, grow_streak=99,
+                    max_replicas=2)
+    assert a.evaluate() is None  # queue alone is quiet
+    get_registry().counter(
+        "deepspeed_tpu_serving_slo_ttft_violations_total",
+        "ttft violations").inc(3)
+    assert a.evaluate() == "grow"  # latency debt is the leading signal
+
+
+def test_autoscaler_shrinks_lifo_via_evacuation_never_drops():
+    from deepspeed_tpu.serving.replica import EngineReplica
+
+    base, extra = _StubEngine(), _StubEngine(uids=[7, 8])
+    router = _stub_fleet(base)
+    router.add_replica(EngineReplica("auto0", extra))
+    a = _autoscaler(router, shrink_queue_per_replica=0.5,
+                    shrink_streak=2, min_replicas=1, cooldown_pumps=0,
+                    grow_streak=99, grow_on_ttft_violations=False)
+    a.grown = ["auto0"]
+    assert a.evaluate() is None
+    assert a.evaluate() == "shrink"
+    r = router.replicas["auto0"]
+    assert r.retired and not extra.uids  # engine left empty...
+    assert sorted(base.imported) == [7, 8]  # ...streams MIGRATED out
+    assert a.grown == []
+    # min_replicas floor: never shrinks the last replica
+    for _ in range(8):
+        assert a.evaluate() is None
+    assert not router.replicas["s0"].retired
+
+
+def test_autoscaler_spawn_failure_backs_off_bounded():
+    router = _stub_fleet(_StubEngine(queue=50))
+
+    def bad_spawn(i):
+        raise RuntimeError("factory broke")
+
+    a = _autoscaler(router, bad_spawn, grow_queue_per_replica=1.0,
+                    grow_streak=1, max_replicas=4, cooldown_pumps=0)
+    fails, skips = 0, 0
+    for _ in range(40):
+        a.evaluate()
+        if a._spawn_backoff and a._spawn_failures:
+            skips += 1
+        fails = a._spawn_failures
+    # pressure is constant, but attempts decay exponentially: far
+    # fewer than 40 factory calls, and the backoff keeps growing
+    assert 0 < fails < 8 and skips > fails
+    assert len(router.replicas) == 1
+
+
+def test_autoscale_config_validation():
+    from deepspeed_tpu.serving import AutoscaleConfig
+
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError, match="hysteresis"):
+        AutoscaleConfig(grow_queue_per_replica=1.0,
+                        shrink_queue_per_replica=2.0).validate()
+    sc = ServingConfig(autoscale={"enabled": True, "max_replicas": 3})
+    sc.validate()
+    assert sc.autoscale.max_replicas == 3
+
+
 # ----------------------------- slow: engine oracles -------------------------
 @pytest.mark.slow
 @pytest.mark.parametrize("cache", [False, True])

@@ -88,6 +88,40 @@ def test_hazard_item_in_decode_loop_fails(tmp_path):
     assert hl.check(root2) == []
 
 
+def test_hazard_blocking_socket_in_step_root_fails(tmp_path):
+    """Seeded fail-by-name: a blocking socket ``recv`` reachable from a
+    router/engine step root is a host-sync-class hazard (``socket-hot``)
+    — the cross-process transport keeps ALL socket I/O on its sender
+    thread precisely so the real tree stays clean of this."""
+    hl = _hazard_lint()
+    root = _write_tree(tmp_path, {
+        "deepspeed_tpu/serving/router.py":
+            "def step(self):\n"
+            "    return self._poll_remote()\n"
+            "def _poll_remote(self):\n"
+            "    data = self._sock.recv(4096)\n"
+            "    return data\n"})
+    violations = hl.check(root)
+    assert [v.rule for v in violations] == ["socket-hot"]
+    assert ".recv()" in violations[0].message
+    assert "_poll_remote" in violations[0].message
+    # accept() inside an engine step root fails too
+    root2 = _write_tree(tmp_path / "acc", {
+        "deepspeed_tpu/inference/v2/engine_v2.py":
+            "def step(self):\n"
+            "    conn, _ = self.listener.accept()\n"
+            "    return conn\n"})
+    violations = hl.check(root2)
+    assert [v.rule for v in violations] == ["socket-hot"]
+    # the SAME call outside any hot root passes: the server/sender
+    # threads are exactly where blocking socket I/O belongs
+    root3 = _write_tree(tmp_path / "cold", {
+        "deepspeed_tpu/serving/router.py":
+            "def _sender_thread(self):\n"
+            "    return self._sock.recv(4096)\n"})
+    assert hl.check(root3) == []
+
+
 def test_hazard_reachability_through_helpers(tmp_path):
     """A sync hidden two calls deep under train_batch is still found."""
     hl = _hazard_lint()

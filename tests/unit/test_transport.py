@@ -1,0 +1,320 @@
+"""Cross-process transport tests (serving/transport.py).
+
+All FAST tier: the frame protocol, the engine proxy's typed error
+mapping, and the wire-hardening contract — torn / truncated /
+bit-flipped bundle frames over a REAL socket are refused with
+``CorruptBundleError`` naming the page while the fake engine stays
+untouched — plus the bounded, seeded backoff schedule (injectable
+sleep, so the schedule is asserted, not waited out).  The engine here
+is a pure-python fake speaking the dispatch surface; the true
+cross-PROCESS oracle (spawned child, bit-identical streams) lives in
+``tools/fleet_drill.py`` leg 9 and the slow tier.
+"""
+
+import json
+import socket
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import KVPageBundle
+from deepspeed_tpu.serving.admission import RejectedError
+from deepspeed_tpu.serving.config import TransportConfig
+from deepspeed_tpu.serving.kv_transfer import (CorruptBundleError,
+                                               bundle_to_bytes)
+from deepspeed_tpu.serving.transport import (_FRAME_BUNDLE, _FRAME_JSON,
+                                             BundleSender, EngineServer,
+                                             RemoteEngineProxy,
+                                             TransportError, recv_frame,
+                                             send_frame)
+
+
+def _bundle(uid=7):
+    arrays = {"k": np.arange(1 * 1 * 8 * 2 * 2, dtype=np.float32)
+              .reshape(1, 1, 8, 2, 2)}
+    return KVPageBundle(uid=uid, tokens=list(range(10)), prompt_len=9,
+                        max_new_tokens=4, temperature=0.0, eos_id=None,
+                        prefilled=9, decode_entry=False, page_size=8,
+                        page_keys=[b"\x07" * 32],
+                        src_pages=[{"page": 1, "refcount": 1,
+                                    "key": b"\x07" * 32}],
+                        arrays=arrays, model_sig=(1, 2, 2), kv_quant=False,
+                        dtype="fp32")
+
+
+class FakeEngine:
+    """Pure-python engine surface for the dispatch table — records
+    every mutating call so refusal tests can assert 'nothing adopted'."""
+
+    def __init__(self):
+        self.block = SimpleNamespace(page_size=8)
+        self.max_seq_len = 64
+        self.allocator = SimpleNamespace(free_pages=40, num_pages=64)
+        self.queue_depth = 2
+        self.active_count = 1
+        self.puts = []
+        self.imported = []
+        self.released = []
+        self.closed = False
+        self.reject_puts = False
+
+    def has_work(self):
+        return True
+
+    def inflight_uids(self):
+        return [11]
+
+    def ready_uids(self):
+        return [11]
+
+    def put(self, request, *, record_shed=True):
+        if self.reject_puts:
+            raise RejectedError("kv_pressure", retry_after_s=2.5,
+                                priority=request.priority)
+        self.puts.append(request)
+        return int(request.uid)
+
+    def step(self):
+        return {11: {"tokens": [3, 4], "done": False}}
+
+    def export_sequence(self, uid):
+        return _bundle(uid)
+
+    def import_sequence(self, bundle):
+        self.imported.append(bundle)
+        return True
+
+    def release_sequence(self, uid, reason="migrated"):
+        self.released.append((uid, reason))
+
+    def abort_all(self, reason="abort"):
+        return [11]
+
+    def drain(self, max_steps=10_000):
+        fin = SimpleNamespace(uid=11, tokens=[3, 4, 5], prompt_len=9,
+                              finish_reason="eos")
+        return {"finished": {11: fin}, "pending": []}
+
+    def assert_no_leaks(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture
+def served(tmp_path):
+    """A FakeEngine behind a real AF_UNIX EngineServer on a thread."""
+    address = str(tmp_path / "engine.sock")
+    engine = FakeEngine()
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(address)
+    listener.listen(1)
+    t = threading.Thread(target=EngineServer(engine, listener).serve,
+                         daemon=True)
+    t.start()
+    yield engine, address
+    t.join(timeout=5.0)
+
+
+def _fast_cfg(**kw):
+    kw.setdefault("connect_retries", 5)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("io_timeout_s", 5.0)
+    return TransportConfig(**kw)
+
+
+# ----------------------------- frame protocol -------------------------------
+def test_frame_roundtrip_and_desync_refusal():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, _FRAME_JSON, b'{"op":"x"}')
+        send_frame(a, _FRAME_BUNDLE, b"\x00" * 1000)
+        assert recv_frame(b) == (_FRAME_JSON, b'{"op":"x"}')
+        assert recv_frame(b) == (_FRAME_BUNDLE, b"\x00" * 1000)
+        # unknown kind byte = desynchronized stream, refused
+        a.sendall(b"Z" + (0).to_bytes(8, "little"))
+        with pytest.raises(TransportError, match="frame kind"):
+            recv_frame(b)
+        # absurd length = desynchronized stream, refused before reading
+        a.sendall(_FRAME_JSON + (1 << 40).to_bytes(8, "little"))
+        with pytest.raises(TransportError, match="frame length"):
+            recv_frame(b)
+    finally:
+        a.close(), b.close()
+
+
+def test_peer_close_mid_frame_is_transport_error():
+    a, b = socket.socketpair()
+    # promise 100 bytes, deliver 10, hang up: a TRANSPORT error (retry),
+    # never a corrupt-bundle refusal
+    a.sendall(_FRAME_BUNDLE + (100).to_bytes(8, "little") + b"x" * 10)
+    a.close()
+    try:
+        with pytest.raises(TransportError, match="10/100 bytes"):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+# ----------------------------- proxy surface --------------------------------
+def test_proxy_engine_surface_and_typed_errors(served):
+    engine, address = served
+    proxy = RemoteEngineProxy(address, _fast_cfg())
+    assert proxy.block.page_size == 8 and proxy.max_seq_len == 64
+    assert proxy.queue_depth == 2 and proxy.active_count == 1
+    assert proxy.allocator.free_pages == 40
+    assert proxy.allocator.num_pages == 64
+    assert proxy.has_work() and proxy.inflight_uids() == [11]
+    req = SimpleNamespace(prompt_ids=[1, 2, 3], max_new_tokens=4,
+                          temperature=0.0, eos_id=None, uid=21,
+                          priority=1, deadline_s=None, trace_id="t-21")
+    assert proxy.put(req) == 21
+    assert engine.puts[0].prompt_ids == [1, 2, 3]
+    assert engine.puts[0].trace_id == "t-21"
+    out = proxy.step()
+    assert out == {11: {"tokens": [3, 4], "done": False}}
+    assert 11 in out  # uids survive the JSON hop as ints
+    assert proxy.ready_uids() == [11]
+    # export = pull: bundle re-verified CLIENT-side, bit identical
+    rt = proxy.export_sequence(11)
+    assert rt.uid == 11 and np.array_equal(
+        rt.arrays["k"], _bundle().arrays["k"])
+    proxy.release_sequence(11, reason="migrated")
+    assert engine.released == [(11, "migrated")]
+    assert proxy.abort_all() == [11]
+    d = proxy.drain()
+    assert d["finished"][11].tokens == [3, 4, 5]
+    assert d["finished"][11].finish_reason == "eos"
+    proxy.assert_no_leaks()
+    # a remote RejectedError crosses the wire typed, hint intact
+    engine.reject_puts = True
+    with pytest.raises(RejectedError) as exc:
+        proxy.put(req)
+    assert exc.value.reason == "kv_pressure"
+    assert exc.value.retry_after_s == 2.5
+    proxy.close()
+    assert engine.closed
+
+
+# ----------------------------- wire hardening -------------------------------
+def _import_raw(proxy, blob):
+    """Push raw bytes through the real socket as an import and run the
+    reply through the proxy's typed-error mapping."""
+    reply, _ = proxy._sender.request({"op": "import"}, blob)
+    return proxy._check(reply)
+
+
+def test_bitflip_refused_naming_page_and_nothing_adopted(served):
+    engine, address = served
+    proxy = RemoteEngineProxy(address, _fast_cfg())
+    wire = bundle_to_bytes(_bundle())
+    flipped = bytearray(wire)
+    flipped[-5] ^= 0xFF  # one bit-flip in the last leaf's bytes
+    with pytest.raises(CorruptBundleError, match=r"page\(s\)"):
+        _import_raw(proxy, bytes(flipped))
+    assert engine.imported == []  # refused BEFORE adoption
+    # the intact bytes then import fine on the same connection — the
+    # refusal cost one reply, not the session
+    assert _import_raw(proxy, wire)["ok"] is True
+    assert len(engine.imported) == 1
+    proxy.close()
+
+
+def test_truncated_and_torn_header_refused(served):
+    engine, address = served
+    proxy = RemoteEngineProxy(address, _fast_cfg())
+    wire = bundle_to_bytes(_bundle())
+    with pytest.raises(CorruptBundleError, match="truncated"):
+        _import_raw(proxy, wire[:-7])  # torn mid-leaf
+    with pytest.raises(CorruptBundleError, match="truncated"):
+        _import_raw(proxy, wire[:20])  # torn inside the header
+    with pytest.raises(CorruptBundleError):
+        _import_raw(proxy, b"GARBAGE!" + wire[8:])  # wrong magic
+    assert engine.imported == []
+    proxy.close()
+
+
+def test_refused_bundle_counter_and_export_pull_verified(tmp_path):
+    """The RECEIVING side re-verifies whichever direction the bundle
+    flows: a raw server replying with a corrupted bundle frame to an
+    export (pull) is refused client-side, by name."""
+    from deepspeed_tpu.telemetry import get_registry
+
+    address = str(tmp_path / "raw.sock")
+    wire = bytearray(bundle_to_bytes(_bundle()))
+    wire[-5] ^= 0xFF
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(address)
+    listener.listen(1)
+
+    def _raw_server():
+        conn, _ = listener.accept()
+        with conn:
+            recv_frame(conn)  # the export request
+            send_frame(conn, _FRAME_JSON, json.dumps(
+                {"ok": True, "bundle_follows": True}).encode())
+            send_frame(conn, _FRAME_BUNDLE, bytes(wire))
+        listener.close()
+
+    t = threading.Thread(target=_raw_server, daemon=True)
+    t.start()
+    refused = get_registry().get(
+        "deepspeed_tpu_serving_transport_refused_bundles_total")
+    before = refused.total()
+    sender = BundleSender(address, _fast_cfg())
+    try:
+        reply, blob = sender.request({"op": "export", "uid": 7})
+        assert reply["ok"] and blob is not None
+        from deepspeed_tpu.serving.kv_transfer import bundle_from_bytes
+        with pytest.raises(CorruptBundleError, match=r"page\(s\)"):
+            bundle_from_bytes(blob)
+    finally:
+        sender.close()
+        t.join(timeout=5.0)
+    # (the proxy's export_sequence wraps exactly this path and counts
+    # the refusal; here we asserted the verification itself)
+    assert refused.total() >= before
+
+
+# ----------------------------- bounded backoff ------------------------------
+def test_backoff_is_bounded_seeded_and_exponential(tmp_path):
+    """A dead peer costs exactly ``connect_retries`` attempts on the
+    documented schedule — asserted via injected sleep, not waited out."""
+    import random as _random
+
+    cfg = TransportConfig(connect_retries=5, backoff_base_s=0.05,
+                          backoff_max_s=2.0, backoff_jitter=0.25)
+    slept = []
+    sender = BundleSender(str(tmp_path / "nobody.sock"), cfg, seed=7,
+                          sleep=slept.append)
+    with pytest.raises(TransportError, match="5 bounded attempts"):
+        sender.request({"op": "hello"})
+    assert sender.connect_attempts == 5
+    assert len(sender.backoffs_taken) == 4  # no sleep after the last
+    assert slept == sender.backoffs_taken
+    # the exact elastic-agent schedule: capped exponential, seeded jitter
+    r = _random.Random(7)
+    expect = [min(0.05 * 2 ** (f - 1), 2.0) * (1 + 0.25 * r.random())
+              for f in range(1, 5)]
+    assert sender.backoffs_taken == pytest.approx(expect)
+    assert max(sender.backoffs_taken) <= 2.0 * 1.25
+    sender.close()
+    # determinism: same seed, same dead peer -> the identical schedule
+    sender2 = BundleSender(str(tmp_path / "nobody.sock"), cfg, seed=7,
+                           sleep=lambda _d: None)
+    with pytest.raises(TransportError):
+        sender2.request({"op": "hello"})
+    assert sender2.backoffs_taken == pytest.approx(sender.backoffs_taken)
+    sender2.close()
+
+
+def test_sender_refuses_after_close(tmp_path):
+    sender = BundleSender(str(tmp_path / "nobody.sock"),
+                          _fast_cfg(connect_retries=1),
+                          sleep=lambda _d: None)
+    sender.close()
+    with pytest.raises(TransportError, match="closed"):
+        sender.request({"op": "hello"})

@@ -47,12 +47,15 @@ Prints ONE JSON line.  Knobs (env):
     DSTPU_SBENCH_CHUNK   chunked-prefill tokens  (default 0 = whole)
     DSTPU_SBENCH_K       speculative draft tokens per step (default 8)
     DSTPU_SBENCH_REPEATS median-of-k wall-time repeats     (default 3)
+    DSTPU_SBENCH_NVME    1 = --ab-kv-tier caps the host tier and adds
+                         the file-backed NVMe third tier under it
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -640,6 +643,11 @@ def main_kv_tier() -> None:
     per_fam = _int("DSTPU_SBENCH_NREQ", 2)  # requests per family per round
     slots = _int("DSTPU_SBENCH_SLOTS", 4)
     repeats = max(1, _int("DSTPU_SBENCH_REPEATS", 3))
+    # DSTPU_SBENCH_NVME=1: cap the host tier itself (at the device cache
+    # capacity, below the spilled working set) and hang the NVMe third
+    # tier under it — the same A/B then also proves file demote/promote
+    # keeps bit-identity at a bounded host-RAM budget
+    nvme = os.environ.get("DSTPU_SBENCH_NVME", "") not in ("", "0")
 
     page = 16
     seq_len = n_prefix + n_suffix + gen
@@ -669,12 +677,29 @@ def main_kv_tier() -> None:
         m = get_registry().get("deepspeed_tpu_steady_recompiles_total")
         return m.total() if m is not None else 0.0
 
+    def _tier_cfg(tmp_dirs):
+        if not nvme:
+            return KVTierConfig(enabled=True)
+        import tempfile
+        mc = model.config
+        # one spilled page record: per-layer K+V of
+        # [page, n_kv_heads, head_dim] at the leg's dtype width
+        page_rec = (mc.n_layers * 2 * page * mc.n_kv_heads
+                    * (mc.hidden_size // mc.n_heads)
+                    * (2 if on_tpu else 4))
+        d = tempfile.mkdtemp(prefix="dstpu_sbench_nvme_")
+        tmp_dirs.append(d)
+        return KVTierConfig(enabled=True,
+                            host_bytes=cache_cap * page_rec,
+                            nvme_enabled=True, nvme_dir=d)
+
     def run(tier: bool):
         """One leg: fresh engine per repeat, warmup (cold fill + one
         warm-restore pass) excluded from timing, token streams asserted
         identical ACROSS repeats, wall time as the median."""
         toks_ref, stats, tstats, times = None, None, None, []
         steady_delta, warm_s, tl = 0.0, 0.0, None
+        tmp_dirs = []  # fresh NVMe dir per repeat: no stale-record hits
         for _ in range(repeats):
             eng = InferenceEngineV2(model, RaggedInferenceConfig(
                 dtype="fp32" if not on_tpu else "bf16",
@@ -682,7 +707,7 @@ def main_kv_tier() -> None:
                 num_pages=pages_per_seq * slots + 2 * pages_per_seq,
                 max_seqs=slots, enable_prefix_cache=True,
                 prefix_cache_pages=cache_cap,
-                kv_tier=(KVTierConfig(enabled=True) if tier else None)),
+                kv_tier=(_tier_cfg(tmp_dirs) if tier else None)),
                 params=params)
 
             def play(r, sufs=None):
@@ -721,6 +746,8 @@ def main_kv_tier() -> None:
                     "non-deterministic generations across repeats"
             eng.assert_no_leaks()
             eng.close()
+        for d in tmp_dirs:
+            shutil.rmtree(d, ignore_errors=True)
         return toks_ref, statistics.median(times), stats, tstats, \
             steady_delta, warm_s, tl
 
@@ -766,12 +793,17 @@ def main_kv_tier() -> None:
             "hit_rate": round(ts_on["hit_rate"], 3),
             "corrupt_pages": int(ts_on["corrupt_pages"]),
             "dropped_spills": int(ts_on["dropped_spills"])},
+        "nvme": nvme,
         "identical_generations": identical,
         "mismatched_requests": mismatched,
         "steady_state_recompiles": int(steady),
         "backend": jax.default_backend(),
         "device_kind": str(getattr(dev, "device_kind", "unknown")),
     }
+    if nvme:
+        result["kv_nvme"] = {
+            k: (round(v, 3) if k == "nvme_hit_rate" else int(v))
+            for k, v in ts_on.items() if k.startswith("nvme_")}
     result.update(_observability_sections(
         tl_rec, gp, warm_off + warm_on,
         (dt_off + dt_on) * repeats,
@@ -782,9 +814,16 @@ def main_kv_tier() -> None:
     print(json.dumps(_stamp_contract_hash(result)))
     # hard gates on the deterministic CPU tier: bit-identity, the
     # >= 1.5x acceptance bar, and zero steady-state recompiles — the
-    # tier's claims are machine-checked, not eyeballed
+    # tier's claims are machine-checked, not eyeballed.  The NVMe arm
+    # additionally requires real file demote/promote traffic with zero
+    # corrupt records
+    nvme_ok = (not nvme) or (
+        ts_on.get("nvme_spilled_pages", 0) > 0
+        and ts_on.get("nvme_restored_pages", 0) > 0
+        and ts_on.get("nvme_corrupt_pages", 0) == 0)
     if jax.default_backend() == "cpu" and (
-            not identical or reduction < 1.5 or steady > 0):
+            not identical or reduction < 1.5 or steady > 0
+            or not nvme_ok):
         sys.exit(1)
 
 

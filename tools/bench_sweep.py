@@ -187,6 +187,17 @@ RUNGS = {
                             "DSTPU_SBENCH_PREFIX": "256",
                             "DSTPU_SBENCH_SUFFIX": "32",
                             "DSTPU_SBENCH_GEN": "32"},
+    # NVMe third KV tier (serving/kv_tier.py): same tiered A/B but with
+    # the host tier itself byte-budgeted and the file-backed third tier
+    # under it — demote/promote traffic must be real and the run
+    # additionally hard-gates zero corrupt NVMe records
+    "serving-160m-nvme": {"_tool": "bench_serving",
+                          "_args": ["--ab-kv-tier"],
+                          "DSTPU_SBENCH_SIZE": "160m",
+                          "DSTPU_SBENCH_PREFIX": "256",
+                          "DSTPU_SBENCH_SUFFIX": "32",
+                          "DSTPU_SBENCH_GEN": "32",
+                          "DSTPU_SBENCH_NVME": "1"},
     # fused multi-step decode (decode_horizon): K tokens per host
     # round-trip through one on-device decode scan — host syncs per
     # token is the figure of merit; the run hard-gates bit-identity

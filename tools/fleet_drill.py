@@ -54,9 +54,27 @@ weights:
   end-to-end latency; and the forced TTFT violations (unmeetable
   ``slo_ttft_s``) must carry exemplars resolving to traces present in
   the artifact.
+* **NVMe-tier leg** (fresh 1+1 fleet) — the host-RAM tier itself is
+  budgeted at three page records with the NVMe third tier on: cold
+  families demote host -> ``.kvpage`` file on LRU pressure and promote
+  back (CRC re-verified) when they return; streams must be
+  **bit-identical** to the uncapped single-engine control with zero
+  corrupt records and no leaked pages.
+* **Cross-process leg** — a REAL child-process replica is spawned
+  behind the socket transport; the autoscaler grows it into the fleet
+  under queue pressure, live decode rebalancing migrates running
+  streams across the process boundary, and the scale-down path retires
+  it mid-run via drain/evacuation (its streams come BACK over the
+  socket).  Every stream must complete **bit-identical** to the
+  single-engine control, the allocator audit must pass on BOTH sides
+  of the socket (the remote audited over the wire), and the child must
+  exit 0.
 * **Metric-name lint** — the run registers the
   ``deepspeed_tpu_serving_fleet_*`` + ``deepspeed_tpu_serving_slo_*``
-  + ``deepspeed_tpu_serving_kv_tier_*`` families, then
+  + ``deepspeed_tpu_serving_kv_tier_*`` +
+  ``deepspeed_tpu_serving_kv_nvme_*`` +
+  ``deepspeed_tpu_serving_transport_*`` +
+  ``deepspeed_tpu_serving_autoscale_*`` families, then
   ``tools/check_metric_names.py`` must pass over the tree and see
   them.
 
@@ -217,6 +235,65 @@ def _build(n_requests: int, new_tokens: int, seed: int = 7):
                     max_new_tokens=new_tokens) for i in range(per_fam)])
         return waves
 
+    def build_mp_fleet():
+        """One-replica MIXED fleet with live decode rebalancing on,
+        plus the spawn spec for a cross-process peer: the child
+        re-derives the SAME weights from ``init_params(PRNGKey(0))``
+        and the same engine config, so a stream decodes bit-identically
+        on either side of the socket."""
+        from deepspeed_tpu.inference.v2 import InferenceEngineV2 as Eng
+        from deepspeed_tpu.serving.replica import EngineReplica
+        from deepspeed_tpu.serving.router import FleetRouter
+
+        mp_serving = ServingConfig(
+            enabled=True, disaggregated=False, rebalance_enabled=True,
+            rebalance_load_gap=1, rebalance_max_per_pump=2)
+        local = EngineReplica("local0", Eng(model, base, params=params))
+        fl = FleetRouter([local], mp_serving)
+        spec = {"model": "tiny", "max_seq_len": 128, "seed": 0,
+                "engine_config": base}
+        return fl, spec
+
+    def build_nvme_fleet(nvme_dir):
+        """Fresh 1-prefill + 1-decode fleet with BOTH spill tiers
+        capped: the device prefix cache below the working set (as the
+        tier leg) AND the host-RAM tier budgeted at three page records,
+        with the NVMe third tier on under ``nvme_dir`` — cold families
+        must demote host -> file and promote back (CRC-verified,
+        bit-identical) when they return.  Control stays the UNCAPPED
+        single engine."""
+        from deepspeed_tpu.serving import KVTierConfig
+
+        mc = model.config
+        # one spilled prefix-page record: per-layer K+V blocks of
+        # [page_size, n_kv_heads, head_dim] fp32
+        page_nb = (mc.n_layers * 2 * PAGE_SIZE * mc.n_kv_heads
+                   * (mc.hidden_size // mc.n_heads) * 4)
+        nvme_base = RaggedInferenceConfig(
+            dtype="fp32", page_size=PAGE_SIZE, num_pages=48, max_seqs=4,
+            max_pages_per_seq=12, enable_prefix_cache=True,
+            prefix_cache_pages=3)
+        nvme_serving = ServingConfig(
+            enabled=True, prefill_replicas=1, decode_replicas=1,
+            disaggregated=True, affinity_pages=2, prefill_chunk=PAGE_SIZE,
+            kv_tier=KVTierConfig(enabled=True,
+                                 host_bytes=3 * page_nb + 64,
+                                 nvme_enabled=True, nvme_dir=nvme_dir))
+        fl = build_fleet(model, nvme_serving, engine_config=nvme_base,
+                         params=params)
+        uncapped = RaggedInferenceConfig(
+            dtype="fp32", page_size=PAGE_SIZE, num_pages=64, max_seqs=4,
+            max_pages_per_seq=12, enable_prefix_cache=True)
+        ctl = InferenceEngineV2(model, uncapped, params=params)
+
+        def nvme_control(requests):
+            got = ctl.generate_all([RaggedRequest(
+                prompt_ids=list(r.prompt_ids),
+                max_new_tokens=r.max_new_tokens) for r in requests])
+            return [got[u] for u in sorted(got)]
+
+        return fl, nvme_control
+
     def build_trace_fleet():
         """Fresh 1-prefill + 2-decode disaggregated fleet on a FRESH
         request-trace ledger, with an unmeetable TTFT SLO
@@ -253,7 +330,7 @@ def _build(n_requests: int, new_tokens: int, seed: int = 7):
 
     return (fleet, make_requests, control_run, build_slo_fleet,
             build_tier_fleet, make_tier_waves, build_multistep_fleet,
-            build_trace_fleet)
+            build_trace_fleet, build_nvme_fleet, build_mp_fleet)
 
 
 def run_demo(out: str, n_requests: int, new_tokens: int,
@@ -266,7 +343,8 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
           f"1 prefill + 2 decode replicas, seed {seed} -> {out}")
     (fleet, make_requests, control_run, build_slo_fleet,
      build_tier_fleet, make_tier_waves, build_multistep_fleet,
-     build_trace_fleet) = _build(n_requests, new_tokens, seed)
+     build_trace_fleet, build_nvme_fleet, build_mp_fleet) = \
+        _build(n_requests, new_tokens, seed)
     reg = get_registry()
 
     def counter(name):
@@ -686,6 +764,156 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
            f"{len(resolved)}/{len(exs)} exemplars resolve "
            f"({sorted(tr_led.exemplars())})")
 
+    # ---- leg 9: NVMe third tier — host budget capped at 3 page records
+    print("  leg 9: NVMe third KV tier (host -> file demote & promote)")
+    nvme_dir = os.path.join(out, "kv_nvme")
+    nvme_fleet, nvme_control = build_nvme_fleet(nvme_dir)
+    nsp0 = counter("deepspeed_tpu_serving_kv_nvme_spilled_pages_total")
+    nrs0 = counter("deepspeed_tpu_serving_kv_nvme_restored_pages_total")
+    nbad0 = counter("deepspeed_tpu_serving_kv_nvme_corrupt_pages_total")
+    got_nv, want_nv = [], []
+    for wave in make_tier_waves(new_tokens, salt=14):
+        want_nv.extend(nvme_control(wave))
+        wave_uids = [nvme_fleet.submit(r) for r in wave]
+        for _ in range(300):
+            if not nvme_fleet.has_work():
+                break
+            nvme_fleet.step()
+        got_nv.extend(nvme_fleet.request_state(u)["emitted"]
+                      for u in wave_uids)
+    nsp = counter("deepspeed_tpu_serving_kv_nvme_spilled_pages_total") - nsp0
+    nrs = counter("deepspeed_tpu_serving_kv_nvme_restored_pages_total") - nrs0
+    nbad = counter("deepspeed_tpu_serving_kv_nvme_corrupt_pages_total") \
+        - nbad0
+    _check(checks, "kv_nvme_demotes_and_promotes_ran",
+           nsp > 0 and nrs > 0,
+           f"{nsp:.0f} pages demoted to file, {nrs:.0f} promoted back")
+    _check(checks, "kv_nvme_no_corrupt_records", nbad == 0,
+           f"{nbad:.0f} refused")
+    nvme_files = [f for f in os.listdir(nvme_dir)
+                  if f.endswith(".kvpage")] if os.path.isdir(nvme_dir) \
+        else []
+    _check(checks, "kv_nvme_records_on_disk", bool(nvme_files),
+           f"{len(nvme_files)} .kvpage files under {nvme_dir}")
+    _check(checks, "kv_nvme_streams_bit_identical_to_uncapped_control",
+           got_nv == want_nv,
+           f"{sum(g == w for g, w in zip(got_nv, want_nv))}"
+           f"/{len(want_nv)} match")
+    nv_stats = {}
+    for name, rep in nvme_fleet.replicas.items():
+        tier = getattr(rep.engine, "kv_tier", None)
+        if tier is not None:
+            nv_stats[name] = {k: v for k, v in tier.stats().items()
+                              if k.startswith("nvme_")}
+    _check(checks, "kv_nvme_occupancy_in_tier_stats",
+           any(s.get("nvme_spilled_pages", 0) > 0
+               for s in nv_stats.values()),
+           {n: s.get("nvme_pages") for n, s in nv_stats.items()})
+    nv_leaks = []
+    for name, rep in nvme_fleet.replicas.items():
+        try:
+            rep.engine.assert_no_leaks()
+        except AssertionError as e:
+            nv_leaks.append(f"{name}: {e}")
+    _check(checks, "kv_nvme_no_leaks_after_churn", not nv_leaks,
+           nv_leaks[:2] if nv_leaks else
+           f"{len(nvme_fleet.replicas)} replicas audited")
+
+    # ---- leg 10: cross-process replica — KV over a real socket, elastic
+    # grow (autoscaler spawns the remote into the fleet), live decode
+    # rebalancing across the process boundary, then scale-down
+    # evacuating the remote's streams BACK over the socket; hard-gated
+    # bit-identical against the single-engine control
+    print("  leg 10: cross-process replica (socket transport + elastic "
+          "scale)")
+    from deepspeed_tpu.serving import (AutoscaleConfig, FleetAutoscaler,
+                                       RemoteEngineProxy,
+                                       spawn_engine_server)
+    from deepspeed_tpu.serving.replica import EngineReplica
+
+    mp_fleet, mp_spec = build_mp_fleet()
+    print("    spawning child engine server (cold JAX import; "
+          "this takes a while)...")
+    proc, address = spawn_engine_server(mp_spec)
+    proxy = RemoteEngineProxy(address, seed=seed)
+    mp_reqs = make_requests(6, salt=41)
+    want_mp = control_run(mp_reqs)
+    fs0 = counter("deepspeed_tpu_serving_transport_frames_sent_total")
+    bs0 = counter("deepspeed_tpu_serving_transport_bytes_sent_total")
+    rb0 = counter("deepspeed_tpu_serving_fleet_rebalanced_total")
+    ad0 = counter("deepspeed_tpu_serving_fleet_replicas_added_total")
+    gr0 = counter("deepspeed_tpu_serving_autoscale_grow_total")
+    sh0 = counter("deepspeed_tpu_serving_autoscale_shrink_total")
+    scaler = FleetAutoscaler(
+        mp_fleet,
+        AutoscaleConfig(enabled=True, min_replicas=1, max_replicas=2,
+                        grow_queue_per_replica=1.0, grow_streak=1,
+                        grow_on_ttft_violations=False,
+                        shrink_queue_per_replica=0.25, shrink_streak=3,
+                        cooldown_pumps=2),
+        spawn_replica=lambda i: EngineReplica(f"remote{i}", proxy),
+        seed=seed)
+    mp_uids = [mp_fleet.submit(r) for r in mp_reqs]
+    remote_saw = 0
+    for _ in range(400):
+        if not mp_fleet.has_work():
+            break
+        mp_fleet.step()
+        scaler.evaluate()
+        for name, rep in mp_fleet.replicas.items():
+            if name.startswith("remote") and rep.alive and not rep.retired:
+                remote_saw = max(remote_saw, rep.load())
+    got_mp = [mp_fleet.request_state(u)["emitted"] for u in mp_uids]
+    # grow/shrink can legitimately cycle under these aggressive knobs
+    # (evacuated streams re-queue and re-trigger pressure), so gate on
+    # "at least one" of each, not an exact count
+    _check(checks, "mp_autoscaler_grew_remote_replica_into_fleet",
+           counter("deepspeed_tpu_serving_autoscale_grow_total") >= gr0 + 1
+           and counter("deepspeed_tpu_serving_fleet_replicas_added_total")
+           >= ad0 + 1,
+           f"replicas now {sorted(mp_fleet.replicas)}")
+    _check(checks, "mp_rebalance_moved_streams_across_socket",
+           counter("deepspeed_tpu_serving_fleet_rebalanced_total") > rb0
+           and remote_saw > 0,
+           f"{counter('deepspeed_tpu_serving_fleet_rebalanced_total') - rb0:.0f}"
+           f" stream(s) rebalanced, remote peak load {remote_saw}")
+    _check(checks, "mp_scale_down_evacuated_remote_mid_run",
+           counter("deepspeed_tpu_serving_autoscale_shrink_total")
+           >= sh0 + 1
+           and any(r.retired for n, r in mp_fleet.replicas.items()
+                   if n.startswith("remote")),
+           "remote retired via drain/evacuation")
+    _check(checks, "mp_all_streams_complete_no_drops",
+           not mp_fleet.has_work()
+           and all(not mp_fleet.request_state(u)["failed"]
+                   for u in mp_uids))
+    _check(checks, "mp_bit_identical_to_single_engine",
+           got_mp == want_mp,
+           f"{sum(g == w for g, w in zip(got_mp, want_mp))}"
+           f"/{len(want_mp)} match")
+    mp_frames = \
+        counter("deepspeed_tpu_serving_transport_frames_sent_total") - fs0
+    mp_bytes = \
+        counter("deepspeed_tpu_serving_transport_bytes_sent_total") - bs0
+    _check(checks, "mp_kv_actually_crossed_the_wire",
+           mp_frames > 0 and mp_bytes > 0,
+           f"{mp_frames:.0f} frames / {mp_bytes:.0f} B sent")
+    mp_leaks = []
+    try:
+        mp_fleet.replicas["local0"].engine.assert_no_leaks()
+    except AssertionError as e:
+        mp_leaks.append(f"local0: {e}")
+    try:
+        proxy.assert_no_leaks()  # audits the CHILD engine over the wire
+    except AssertionError as e:
+        mp_leaks.append(f"remote: {e}")
+    _check(checks, "mp_no_leaks_both_sides_of_socket", not mp_leaks,
+           mp_leaks[:2] if mp_leaks else "local + remote audited")
+    proxy.close()  # shuts the child server down cleanly
+    proc.join(timeout=60)
+    _check(checks, "mp_child_process_exited_clean", proc.exitcode == 0,
+           f"exitcode {proc.exitcode}")
+
     # ---- metric-name lint over the tree (fleet family included)
     import check_metric_names as lint
 
@@ -715,11 +943,25 @@ def run_demo(out: str, n_requests: int, new_tokens: int,
     ms_names = sorted(n for n in lint.collect(_REPO_DIR) if n in ms_family)
     _check(checks, "multistep_metric_family_registered",
            len(ms_names) == len(ms_family), ms_names)
+    tp_names = sorted(n for n in lint.collect(_REPO_DIR)
+                      if n.startswith("deepspeed_tpu_serving_transport_"))
+    _check(checks, "transport_metric_family_registered",
+           len(tp_names) >= 8, tp_names[:4])
+    as_names = sorted(n for n in lint.collect(_REPO_DIR)
+                      if n.startswith("deepspeed_tpu_serving_autoscale_"))
+    _check(checks, "autoscale_metric_family_registered",
+           len(as_names) >= 4, as_names[:4])
+    nv_names = sorted(n for n in lint.collect(_REPO_DIR)
+                      if n.startswith("deepspeed_tpu_serving_kv_nvme_"))
+    _check(checks, "kv_nvme_metric_family_registered",
+           len(nv_names) >= 5, nv_names[:4])
 
     ok = all(c["ok"] for c in checks)
     summary = {"demo": "fleet_drill", "ok": ok, "out": out, "seed": seed,
                "requests": n_requests + len(reqs2),
                "victim": victim, "slow_replica": slow_name,
+               "mp_child_exit": proc.exitcode,
+               "nvme_stats": nv_stats,
                "health": fleet.health(),
                "slo_health": slo_fleet.health(),
                "fleet_metrics": fleet_names, "slo_metrics": slo_names,
